@@ -1,0 +1,3 @@
+"""L0 — data layer: deterministic cross-rank partitioning + dataset pipelines."""
+
+from .partition import Partition, DataPartitioner, partition_dataset  # noqa: F401

@@ -1,0 +1,3 @@
+"""TPU ops: Gram-Schmidt orthogonalization (XLA fori_loop + Pallas variants)."""
+
+from .orthogonalize import orthogonalize  # noqa: F401

@@ -1,0 +1,47 @@
+"""Column-sequential Gram-Schmidt orthogonalization.
+
+Semantic parity with the reference's TorchScript kernel
+(``reducer.py:180-191``): for each column i — normalize by
+``sqrt(sum(col^2)) + eps``, then subtract ``sum(col * rest, dim=0) * col``
+from every later column. The sequential-column order matters: PowerSGD's
+P-hat depends on it, so golden tests pin this exact recurrence (NOT
+``jnp.linalg.qr``, which differs by column signs/pivoting).
+
+TPU-native form: the column loop is a ``lax.fori_loop`` with a fixed-shape
+carry (the whole matrix), so the whole thing stays inside one XLA
+computation. r is tiny (4-16) while n is large, so each iteration is a
+rank-1 update — bandwidth-bound, which XLA fuses well. A Pallas variant
+that keeps the matrix resident in VMEM across all r iterations lives in
+``ops.pallas_orthogonalize``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def orthogonalize(matrix: jax.Array, eps: float = 1e-8) -> jax.Array:
+    """Orthonormalize the columns of an (n, r) matrix, sequentially.
+
+    Pure-functional mirror of the in-place reference kernel
+    (``reducer.py:183-191``).
+    """
+    n, r = matrix.shape
+    if r == 1:
+        col = matrix / (jnp.sqrt(jnp.sum(matrix**2)) + eps)
+        return col
+
+    col_ids = jnp.arange(r)
+
+    def body(i, mat):
+        col = mat[:, i]
+        col = col / (jnp.sqrt(jnp.sum(col**2)) + eps)
+        # project the normalized column out of all LATER columns only
+        proj = col @ mat  # (r,) dot of col with every column
+        mask = (col_ids > i).astype(mat.dtype)
+        mat = mat - jnp.outer(col, proj * mask)
+        return mat.at[:, i].set(col)
+
+    return lax.fori_loop(0, r, body, matrix)

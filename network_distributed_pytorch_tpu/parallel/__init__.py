@@ -1,0 +1,18 @@
+"""Parallelism layers: mesh (L1), comm (L2), packing, reducers (L3), trainer (L4)."""
+
+from .mesh import (  # noqa: F401
+    DATA_AXIS,
+    DistributedConfig,
+    initialize_distributed,
+    make_mesh,
+    data_sharding,
+    replicated_sharding,
+)
+from .comm import (  # noqa: F401
+    n_bits,
+    all_reduce_sum,
+    all_reduce_mean,
+    all_gather,
+)
+from .packing import TensorPacker  # noqa: F401
+from .reducers import ExactReducer, PowerSGDReducer  # noqa: F401

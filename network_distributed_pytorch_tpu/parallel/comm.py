@@ -1,0 +1,75 @@
+"""L2 — communication primitives with bits-on-wire accounting.
+
+The reference wraps ``torch.distributed`` collectives in free functions that
+no-op when ``world_size <= 1`` (``reducer.py:193-195``,
+``tensor_buffer.py:59-69``) and counts every payload with
+``n_bits(t) = 8 * nelement * element_size`` (``reducer.py:197-198``).
+
+TPU-native design: collectives are ``jax.lax`` ops *inside* a traced
+``shard_map`` region, addressed by mesh axis name; XLA lowers them to ICI/DCN
+collectives. The single-process fallback is the same shape here: when
+``axis_name is None`` the wrappers are identity (no mesh axis → no wire).
+
+Bits accounting is **static** — computed from shapes/dtypes at trace time, so
+it composes with ``jit`` at zero runtime cost (the reference computes the same
+number at runtime from tensor metadata). Like the reference, bits are counted
+per logical collective payload regardless of world size
+(``reducer.py:127,133,146`` increment unconditionally).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def n_bits(x: jax.Array | jax.ShapeDtypeStruct) -> int:
+    """Payload size in bits: ``8 * nelement * element_size`` (reference
+    ``reducer.py:197-198``). Static — usable inside jit (returns a Python int)."""
+    return 8 * int(x.size) * x.dtype.itemsize
+
+
+def all_reduce_sum(x: jax.Array, axis_name: Optional[str]) -> jax.Array:
+    """``dist.all_reduce(SUM)`` analogue (``ddp_guide_cifar10/ddp_init.py:61``).
+
+    Identity when ``axis_name`` is None — the reference's single-process no-op
+    (``reducer.py:193-195``).
+    """
+    if axis_name is None:
+        return x
+    return jax.lax.psum(x, axis_name)
+
+
+def all_reduce_mean(x: jax.Array, axis_name: Optional[str]) -> jax.Array:
+    """allreduce-then-divide-by-world-size, fused (reference does
+    ``all_reduce(buf); buf /= n_workers`` — ``reducer.py:126-128``)."""
+    if axis_name is None:
+        return x
+    return jax.lax.pmean(x, axis_name)
+
+
+def all_gather(x: jax.Array, axis_name: Optional[str]) -> jax.Array:
+    """``dist.all_gather`` analogue (``tensor_buffer.py:50-57``): returns the
+    per-worker values stacked on a new leading axis. Single-process fallback
+    returns ``x[None]`` — the reference's one-element copy
+    (``tensor_buffer.py:64-69``)."""
+    if axis_name is None:
+        return x[None]
+    return jax.lax.all_gather(x, axis_name)
+
+
+def axis_size(axis_name: Optional[str]) -> int:
+    """World size along the collective axis; 1 outside any mesh (the
+    reference's ``n_workers=1`` fallback, ``reducer.py:13-18``). Static."""
+    if axis_name is None:
+        return 1
+    return jax.lax.axis_size(axis_name)
+
+
+def axis_index(axis_name: Optional[str]) -> jax.Array | int:
+    """Rank along the collective axis (``dist.get_rank()`` analogue)."""
+    if axis_name is None:
+        return 0
+    return jax.lax.axis_index(axis_name)

@@ -1,0 +1,120 @@
+"""L1 — process group / rendezvous, TPU-native.
+
+The reference establishes its "process group" with
+``torch.distributed.init_process_group`` over NCCL with a ``file://`` or
+``tcp://`` rendezvous and a timeout (reference ``ddp_guide/ddp_init.py:37-45``,
+``ddp_guide_cifar10/ddp_init.py:82-95``), and tears it down with
+``dist.destroy_process_group()`` (``ddp_guide_cifar10/ddp_init.py:132-137``).
+
+TPU-native equivalents:
+
+- cross-host coordination —  ``jax.distributed.initialize(coordinator_address,
+  num_processes, process_id)`` (DCN coordination service; the tcp:// rendezvous
+  analogue).
+- the collective fabric    —  a ``jax.sharding.Mesh`` over the local + remote
+  TPU devices; collectives ride ICI within a slice.
+
+Single-process use (the reference's ``world_size <= 1`` fallback,
+``reducer.py:13-18``) needs no rendezvous at all: a mesh over however many
+local devices exist.
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# The reference's only parallel axis is data parallelism (SURVEY §2.3); the
+# mesh helper still accepts arbitrary axis layouts so tensor/pipeline/sequence
+# axes are available to future strategies without API change.
+DATA_AXIS = "data"
+
+
+@dataclass
+class DistributedConfig:
+    """Mirror of the reference's module-level ``config`` dict rendezvous keys
+    (``ddp_guide/ddp_init.py:9-17``), renamed for JAX.
+
+    ``coordinator_address`` replaces ``init_method`` ("tcp://host:port" →
+    "host:port"); ``num_processes`` replaces ``n_workers``; ``process_id``
+    replaces ``rank``. ``backend`` is retained for interface parity but the
+    only real backend is XLA's (NCCL/Gloo have no meaning on TPU).
+    """
+
+    seed: int = 714
+    process_id: int = 0
+    num_processes: int = 1
+    coordinator_address: Optional[str] = None  # e.g. "10.0.0.1:7392"
+    timeout_seconds: int = 600  # ddp_guide_cifar10/ddp_init.py:92
+    backend: str = "xla"
+    local_device_ids: Optional[Sequence[int]] = None
+    mesh_axes: Tuple[str, ...] = (DATA_AXIS,)
+
+
+def initialize_distributed(config: DistributedConfig) -> None:
+    """Rendezvous with the coordinator (multi-host only).
+
+    Mirrors ``dist.init_process_group`` (``ddp_guide_cifar10/ddp_init.py:82-95``)
+    including its explicit timeout. Unlike the reference — which prints a
+    failure banner and falls through on error (``ddp_init.py:98-99``), crashing
+    later — a failed rendezvous here raises immediately.
+    """
+    if config.num_processes <= 1:
+        return  # single-process fallback, reference reducer.py:13-18
+    if config.coordinator_address is None:
+        raise ValueError(
+            "multi-process initialization requires coordinator_address "
+            "(the reference's init_method tcp://host:port)"
+        )
+    jax.distributed.initialize(
+        coordinator_address=config.coordinator_address,
+        num_processes=config.num_processes,
+        process_id=config.process_id,
+        local_device_ids=config.local_device_ids,
+        initialization_timeout=config.timeout_seconds,
+    )
+
+
+def shutdown_distributed() -> None:
+    """``dist.destroy_process_group()`` analogue (``ddp_guide_cifar10/ddp_init.py:132-137``)."""
+    try:
+        jax.distributed.shutdown()
+    except (RuntimeError, ValueError):
+        pass  # never initialized (single-process) — a no-op, like the reference fallback
+
+
+def make_mesh(
+    axis_sizes: Optional[Sequence[int]] = None,
+    axis_names: Sequence[str] = (DATA_AXIS,),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the device mesh the collectives run over.
+
+    With no arguments: a 1-D ``data`` mesh over every visible device — the
+    TPU-native analogue of the reference's world of ``n_workers`` NCCL ranks.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if axis_sizes is None:
+        axis_sizes = (len(devices),) + (1,) * (len(axis_names) - 1)
+    if int(np.prod(axis_sizes)) != len(devices):
+        raise ValueError(
+            f"mesh axis sizes {tuple(axis_sizes)} do not cover {len(devices)} devices"
+        )
+    dev_array = np.asarray(devices).reshape(tuple(axis_sizes))
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def data_sharding(mesh: Mesh, axis_name: str = DATA_AXIS) -> NamedSharding:
+    """Sharding for a batch split along its leading dim across the data axis."""
+    return NamedSharding(mesh, PartitionSpec(axis_name))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for fully-replicated values (params, like DDP replicas)."""
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,66 @@
+"""Flat-buffer packing — the ``TensorBuffer`` equivalent.
+
+The reference packs a list of small tensors into one contiguous buffer so that
+many tiny tensors cost ONE collective (``tensor_buffer.py:4-57``): start/end
+index bookkeeping, ``pack``/``unpack``, shaped views, and
+``bits() = 8 * nelement * element_size``.
+
+TPU-native design: a ``TensorPacker`` is built once from *static* shapes, and
+``pack``/``unpack`` are pure functions over arrays — they trace into a single
+concatenate / set of slices under ``jit``, which XLA fuses. There is no
+mutable buffer; the packed flat array IS the collective payload.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .comm import n_bits
+
+
+class TensorPacker:
+    """Pack/unpack a fixed list of array shapes into one flat vector.
+
+    Mirrors ``TensorBuffer`` (``tensor_buffer.py:9-45``): the constructor
+    computes start/end indices from element counts; ``pack`` concatenates,
+    ``unpack`` slices and reshapes. Shapes and dtype are static so the class
+    composes with jit (all bookkeeping happens at trace time).
+    """
+
+    def __init__(self, shapes: Sequence[Tuple[int, ...]], dtype=jnp.float32):
+        self.shapes = [tuple(int(d) for d in s) for s in shapes]
+        self.dtype = jnp.dtype(dtype)
+        sizes = [int(np.prod(s, dtype=np.int64)) if s else 1 for s in self.shapes]
+        ends = np.cumsum(sizes).tolist()
+        self._start_idx = [0] + ends[:-1]
+        self._end_idx = ends
+        self.total_size = ends[-1] if ends else 0
+
+    @classmethod
+    def for_arrays(cls, arrays: Sequence[jax.Array]) -> "TensorPacker":
+        dtype = arrays[0].dtype if arrays else jnp.float32
+        return cls([a.shape for a in arrays], dtype=dtype)
+
+    def __len__(self) -> int:
+        return len(self.shapes)
+
+    def pack(self, arrays: Sequence[jax.Array]) -> jax.Array:
+        """One flat buffer from many arrays (``tensor_buffer.py:19,27-32``)."""
+        if not arrays:
+            return jnp.zeros((0,), dtype=self.dtype)
+        return jnp.concatenate([jnp.ravel(a).astype(self.dtype) for a in arrays])
+
+    def unpack(self, flat: jax.Array) -> List[jax.Array]:
+        """Shaped views back out of the flat buffer (``tensor_buffer.py:21-22,34-36``)."""
+        return [
+            jax.lax.slice(flat, (s,), (e,)).reshape(shape)
+            for s, e, shape in zip(self._start_idx, self._end_idx, self.shapes)
+        ]
+
+    def bits(self) -> int:
+        """``8 * nelement * element_size`` (``tensor_buffer.py:44-45``). Static."""
+        return 8 * self.total_size * self.dtype.itemsize

@@ -1,0 +1,34 @@
+"""Test harness: an 8-device virtual CPU mesh.
+
+The reference had no tests at all (SURVEY §4); its only harness was the
+single-process no-op fallback in every collective. JAX makes real distributed
+testing cheap: ``--xla_force_host_platform_device_count=8`` gives eight CPU
+"devices" in one process, and the exact same ``shard_map``/``psum`` code path
+that runs over TPU ICI runs over them.
+
+This must run before jax initializes its backends, hence module-import time.
+"""
+
+import os
+
+# Force CPU even when the environment pre-sets a TPU platform: tests exercise
+# the distributed code path on 8 virtual devices, which needs the host platform.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# jax snapshots JAX_PLATFORMS at import time; if anything imported jax before
+# this conftest ran, the env var alone is too late — set the config directly.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

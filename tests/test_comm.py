@@ -1,0 +1,73 @@
+"""Collective wrappers over the real shard_map/psum path on 8 virtual devices
+(the reference's collectives are NCCL calls it could only test on a lab
+cluster; SURVEY §4 'distributed-without-a-cluster')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from network_distributed_pytorch_tpu.parallel import (
+    DATA_AXIS,
+    all_gather,
+    all_reduce_mean,
+    all_reduce_sum,
+    make_mesh,
+)
+from network_distributed_pytorch_tpu.parallel.comm import axis_index, axis_size
+
+
+def test_all_reduce_sum_and_mean(devices):
+    mesh = make_mesh()
+    x = jnp.arange(8.0).reshape(8, 1)  # one row per device
+
+    def f(xs):
+        return all_reduce_sum(xs, DATA_AXIS), all_reduce_mean(xs, DATA_AXIS)
+
+    s, m = jax.jit(
+        jax.shard_map(f, mesh=mesh, in_specs=P(DATA_AXIS), out_specs=(P(DATA_AXIS), P(DATA_AXIS)))
+    )(x)
+    np.testing.assert_allclose(np.asarray(s), np.full((8, 1), 28.0))
+    np.testing.assert_allclose(np.asarray(m), np.full((8, 1), 3.5))
+
+
+def test_all_gather(devices):
+    mesh = make_mesh()
+    x = jnp.arange(8.0).reshape(8, 1)
+
+    def f(xs):
+        g = all_gather(xs, DATA_AXIS)  # (8, 1, 1) on each device
+        return g.reshape(1, -1)
+
+    g = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS)))(x)
+    np.testing.assert_allclose(np.asarray(g), np.tile(np.arange(8.0), (8, 1)))
+
+
+def test_axis_helpers(devices):
+    mesh = make_mesh()
+
+    def f(xs):
+        return xs * 0 + axis_size(DATA_AXIS), xs * 0 + axis_index(DATA_AXIS)
+
+    size, idx = jax.jit(
+        jax.shard_map(f, mesh=mesh, in_specs=P(DATA_AXIS), out_specs=(P(DATA_AXIS), P(DATA_AXIS)))
+    )(jnp.zeros((8, 1)))
+    np.testing.assert_allclose(np.asarray(size), np.full((8, 1), 8.0))
+    np.testing.assert_allclose(np.asarray(idx)[:, 0], np.arange(8.0))
+
+
+def test_single_process_fallbacks():
+    # axis_name=None -> identity / stack-of-one (reducer.py:193-195, tensor_buffer.py:64-69)
+    x = jnp.arange(4.0)
+    np.testing.assert_array_equal(np.asarray(all_reduce_sum(x, None)), np.asarray(x))
+    np.testing.assert_array_equal(np.asarray(all_reduce_mean(x, None)), np.asarray(x))
+    assert all_gather(x, None).shape == (1, 4)
+    assert axis_size(None) == 1
+    assert axis_index(None) == 0
+
+
+def test_mesh_shape_validation():
+    import pytest
+
+    with pytest.raises(ValueError):
+        make_mesh(axis_sizes=(3,), axis_names=("data",))

@@ -1,0 +1,57 @@
+"""TensorPacker round-trip + bits arithmetic (reference ``tensor_buffer.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from network_distributed_pytorch_tpu.parallel import TensorPacker
+from network_distributed_pytorch_tpu.parallel.comm import n_bits
+
+
+def _arrays():
+    k = jax.random.PRNGKey(0)
+    ks = jax.random.split(k, 3)
+    return [
+        jax.random.normal(ks[0], (4, 5)),
+        jax.random.normal(ks[1], (7,)),
+        jax.random.normal(ks[2], (2, 3, 2)),
+    ]
+
+
+def test_pack_unpack_roundtrip():
+    arrays = _arrays()
+    packer = TensorPacker.for_arrays(arrays)
+    flat = packer.pack(arrays)
+    assert flat.shape == (4 * 5 + 7 + 2 * 3 * 2,)
+    out = packer.unpack(flat)
+    for a, b in zip(arrays, out):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pack_unpack_under_jit():
+    arrays = _arrays()
+    packer = TensorPacker.for_arrays(arrays)
+
+    @jax.jit
+    def roundtrip(xs):
+        return packer.unpack(packer.pack(xs))
+
+    out = roundtrip(arrays)
+    for a, b in zip(arrays, out):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_bits():
+    # 8 * nelement * element_size (tensor_buffer.py:44-45)
+    packer = TensorPacker([(4, 5), (7,)], dtype=jnp.float32)
+    assert packer.bits() == 8 * 27 * 4
+    assert n_bits(jnp.zeros((4, 5), jnp.float32)) == 8 * 20 * 4
+    assert n_bits(jnp.zeros((3,), jnp.bfloat16)) == 8 * 3 * 2
+    assert n_bits(jax.ShapeDtypeStruct((10, 10), jnp.float32)) == 8 * 100 * 4
+
+
+def test_empty():
+    packer = TensorPacker([])
+    assert packer.pack([]).shape == (0,)
+    assert packer.unpack(jnp.zeros((0,))) == []
+    assert packer.bits() == 0

@@ -1,0 +1,56 @@
+"""DataPartitioner unit tests (SURVEY §4: determinism / disjointness / coverage),
+including an oracle check against the reference's exact shuffle semantics
+(``partition_helper.py:20-32``: ``random.Random(1234).shuffle`` + fractional cuts)."""
+
+import random
+
+from network_distributed_pytorch_tpu.data import DataPartitioner, partition_dataset
+from network_distributed_pytorch_tpu.data.partition import per_worker_batch_size
+
+
+def test_determinism_across_ranks():
+    # every rank constructs its own partitioner; permutations must agree
+    data = list(range(1000))
+    parts = [DataPartitioner(data, [0.25] * 4) for _ in range(4)]
+    for rank in range(4):
+        idx0 = parts[0].use(rank).index
+        for p in parts[1:]:
+            assert p.use(rank).index == idx0
+
+
+def test_disjoint_and_coverage():
+    data = list(range(1000))
+    p = DataPartitioner(data, [0.25] * 4)
+    all_idx = [i for r in range(4) for i in p.use(r).index]
+    assert len(all_idx) == len(set(all_idx)) == 1000
+    assert sorted(all_idx) == list(range(1000))
+
+
+def test_fractional_truncation_drops_remainder():
+    # int(frac * len) truncation: 10 items over 3 ranks -> 3+3+3, one dropped
+    p = DataPartitioner(list(range(10)), [1 / 3] * 3)
+    assert [len(p.use(r)) for r in range(3)] == [3, 3, 3]
+
+
+def test_oracle_shuffle_semantics():
+    # independently recompute the reference permutation
+    data = list(range(100))
+    rng = random.Random()
+    rng.seed(1234)
+    idx = list(range(100))
+    rng.shuffle(idx)
+    p = DataPartitioner(data, [0.5, 0.5])
+    assert p.use(0).index == idx[:50]
+    assert p.use(1).index == idx[50:]
+
+
+def test_partition_view_remaps():
+    data = [x * 10 for x in range(100)]
+    part = partition_dataset(data, world_size=4, rank=2)
+    for i in range(len(part)):
+        assert part[i] == data[part.index[i]]
+
+
+def test_per_worker_batch_size():
+    assert per_worker_batch_size(256, 8) == 32  # ddp_guide_cifar10/ddp_init.py:49
+    assert per_worker_batch_size(512, 4) == 128  # ddp_powersgd_guide_cifar10/ddp_init.py:52
