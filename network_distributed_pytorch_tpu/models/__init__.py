@@ -1,0 +1,10 @@
+"""First-party flax models.
+
+The reference has no first-party models (SURVEY §1: torchvision ResNet-50/152,
+HuggingFace DistilBERT); this package provides TPU-native equivalents plus the
+small models the test tier needs.
+"""
+
+from .mlp import MLP  # noqa: F401
+from .cnn import SmallCNN  # noqa: F401
+from .resnet import ResNet, resnet18, resnet50, resnet152  # noqa: F401

@@ -1,0 +1,25 @@
+"""Small CNN for the CPU-testable tier (SURVEY §4: "tiny CNN on synthetic
+CIFAR-shaped data, N steps, loss decreases"). NHWC layout — the TPU-natural
+image layout (the reference's torch models are NCHW)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+
+
+class SmallCNN(nn.Module):
+    num_classes: int = 10
+    width: int = 16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = nn.Conv(self.width, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(self.width * 2, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(self.width * 4)(x))
+        return nn.Dense(self.num_classes)(x)

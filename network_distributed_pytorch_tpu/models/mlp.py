@@ -1,0 +1,22 @@
+"""Toy MLP — the BASELINE.json ``ddp_guide`` tier model ("toy MLP, 2-proc
+exact allreduce"); the reference's bare-init guide has no model at all
+(``ddp_guide/ddp_init.py``), so this is the smallest thing its path can train.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (64, 64, 10)
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.reshape(x.shape[0], -1)
+        for f in self.features[:-1]:
+            x = nn.relu(nn.Dense(f)(x))
+        return nn.Dense(self.features[-1])(x)

@@ -91,10 +91,10 @@ class _MatrixMeta(NamedTuple):
     r: int  # min(n, m, compression_rank), reducer.py:78
 
 
-@dataclass
-class PowerSGDState:
-    """Carried across steps: the warm-start Q buffer (``reducer.py:100-111``)
-    and the PRNG key used when ``reuse_query=False`` re-randomizes."""
+class PowerSGDState(NamedTuple):
+    """Carried across steps (a pytree, so it jits/shard_maps as part of
+    TrainState): the warm-start Q buffer (``reducer.py:100-111``) and the PRNG
+    key used when ``reuse_query=False`` re-randomizes."""
 
     q_memory: jax.Array
     key: jax.Array

@@ -1,0 +1,277 @@
+"""L4 — the trainer: one jitted, mesh-parallel training step.
+
+Two reference training loops are reproduced as pure step functions:
+
+- **Exact DDP** (``ddp_guide_cifar10/ddp_init.py:114-127``): forward → backward
+  → allreduce-mean gradients → torch-style SGD with momentum
+  (``v ← μ·v + g; p ← p − lr·v``).
+- **Error-feedback SGD with momentum** (PowerSGD Algorithm 2,
+  ``ddp_powersgd_guide_cifar10/ddp_init.py:125-181``): ``send ← g + e`` →
+  ``reducer.reduce`` (compress/allreduce/decompress, e updated) →
+  ``m ← λ·m + Δ`` → ``p ← p − lr·(Δ + m)``. The reference's first-step
+  ``momentum = Δ.clone()`` special case (``ddp_init.py:166-172``) is exactly
+  equivalent to zero-initialized momenta (λ·0 + Δ = Δ), so no step-0 branch
+  is needed — the whole step is branch-free and jit-pure.
+
+TPU-native design: the entire step — forward, backward, compression,
+collectives, optimizer — is ONE ``shard_map`` region over ``Mesh(['data'])``,
+traced once and compiled by XLA. Gradient synchronization is **hand-rolled
+through the reducer**, NOT left to automatic SPMD psum insertion: that is the
+reference's load-bearing design decision (it never uses torch DDP either,
+SURVEY §2.3) — it is exactly what makes compression pluggable.
+
+Bytes-on-wire are static per step, so they are returned as a Python int on
+the compiled step object and accumulated host-side — closing the reference's
+unfinished ``bits_communicated`` loop (SURVEY C9: collected, never reported).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .comm import all_reduce_mean
+from .mesh import DATA_AXIS
+
+PyTree = Any
+# (params, model_state, batch) -> (scalar loss, new_model_state).
+# model_state carries non-gradient model variables (e.g. BatchNorm running
+# stats — the reference's torchvision ResNets have them; torch DDP keeps them
+# per-rank-local and unsynced, here they are pmean-synced which only affects
+# eval, never the training math). Stateless models pass {} through.
+LossFn = Callable[[PyTree, PyTree, Any], Tuple[jax.Array, PyTree]]
+
+
+class TrainState(NamedTuple):
+    """The full per-step carry, a pytree (mirrors the buffers the reference
+    allocates up front, ``ddp_powersgd_guide_cifar10/ddp_init.py:130-135``).
+
+    Replication structure (what is per-worker vs identical-everywhere) follows
+    the reference exactly: params, momenta and reducer state are identical on
+    every rank (their updates flow only through allreduced values), while the
+    **error-feedback memories are genuinely per-worker state** (each rank
+    stores its own residual ``send - decompressed``, ``reducer.py:163``).
+    In the distributed step, ``memories`` therefore carries a leading
+    ``num_devices`` axis sharded over the data axis; everything else is
+    replicated.
+    """
+
+    params: PyTree
+    momenta: PyTree   # momenta  (zeros ≡ the reference's first-step clone-init)
+    memories: PyTree  # error-feedback memories e (Algo 2 line 4: zeros); per-worker
+    reducer_state: Any
+    model_state: PyTree  # e.g. {'batch_stats': ...}; {} for stateless models
+
+
+def init_train_state(
+    params: PyTree, reducer, model_state: PyTree = None, num_devices: Optional[int] = None
+) -> TrainState:
+    """Zero-init the carry. ``num_devices`` adds the per-worker leading axis on
+    the error memories for the distributed step (None → single-process)."""
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    if num_devices is None:
+        memories = zeros
+    else:
+        memories = jax.tree_util.tree_map(
+            lambda p: jnp.zeros((num_devices,) + p.shape, p.dtype), params
+        )
+    return TrainState(
+        params=params,
+        momenta=zeros,
+        memories=memories,
+        reducer_state=reducer.init(params),
+        model_state={} if model_state is None else model_state,
+    )
+
+
+def stateless_loss(fn: Callable[[PyTree, Any], jax.Array]) -> LossFn:
+    """Adapt a ``(params, batch) -> loss`` function to the trainer signature."""
+
+    def wrapped(params, model_state, batch):
+        return fn(params, batch), model_state
+
+    return wrapped
+
+
+def make_step_fn(
+    loss_fn: LossFn,
+    reducer,
+    learning_rate: float,
+    momentum: float = 0.9,
+    algorithm: str = "ef_momentum",
+    axis_name: Optional[str] = DATA_AXIS,
+) -> Callable[[TrainState, Any], Tuple[TrainState, jax.Array]]:
+    """Build the per-device step body: ``(state, local_batch) -> (state, loss)``.
+
+    ``algorithm``:
+      - ``"ef_momentum"`` — PowerSGD Algorithm 2 (the reference's hand-rolled
+        update, ``ddp_init.py:156-178``); pair with any reducer.
+      - ``"sgd"``         — torch-style SGD+momentum (``optim.SGD`` semantics
+        used by the exact-DDP trainer, ``ddp_guide_cifar10/ddp_init.py:110``).
+      - ``"sgd_plain"``   — SGD without momentum.
+
+    The returned callable is pure; use it directly on one device
+    (``axis_name=None``) or inside ``shard_map`` (see ``make_train_step``).
+    """
+    assert algorithm in ("ef_momentum", "sgd", "sgd_plain")
+
+    def step(state: TrainState, batch) -> Tuple[TrainState, jax.Array]:
+        # (Algo 2 line 6) local stochastic gradient. Params enter the shard_map
+        # region replicated; they must be cast to device-varying BEFORE
+        # differentiation, otherwise jax's replication-tracking transpose
+        # inserts an automatic psum and the reducer would see pre-synchronized
+        # gradients — defeating the hand-rolled (compress-then-communicate)
+        # sync that is the whole point of the reference design.
+        diff_params = state.params
+        if axis_name is not None:
+            diff_params = jax.tree_util.tree_map(
+                lambda p: jax.lax.pcast(p, axis_name, to="varying"), state.params
+            )
+        (loss, model_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            diff_params, state.model_state, batch
+        )
+        # sync non-gradient state (BN running stats) so it stays replicated;
+        # the training forward uses LOCAL batch stats either way, matching the
+        # reference's unsynced-BN DDP behavior in the training math
+        if axis_name is not None:
+            model_state = jax.tree_util.tree_map(
+                lambda x: all_reduce_mean(x, axis_name), model_state
+            )
+
+        if algorithm == "ef_momentum":
+            # (Algo 2 line 7) send = g + e  (ddp_init.py:156-157)
+            send = jax.tree_util.tree_map(jnp.add, grads, state.memories)
+            # (Algo 2 lines 8-11) compress → allreduce → decompress; e updated
+            reducer_state, delta, memories, _ = reducer.reduce(
+                state.reducer_state, send, axis_name
+            )
+            # (Algo 2 line 12) m ← λ·m + Δ  (ddp_init.py:166-172)
+            momenta = jax.tree_util.tree_map(
+                lambda m, d: momentum * m + d, state.momenta, delta
+            )
+            # (Algo 2 line 13) p ← p − lr·(Δ + m)  (ddp_init.py:172-178)
+            params = jax.tree_util.tree_map(
+                lambda p, d, m: p - learning_rate * (d + m),
+                state.params,
+                delta,
+                momenta,
+            )
+        else:
+            # exact-DDP path: allreduce-mean the raw gradients
+            reducer_state, delta, memories, _ = reducer.reduce(
+                state.reducer_state, grads, axis_name
+            )
+            if algorithm == "sgd":
+                # torch SGD: v ← μ·v + g; p ← p − lr·v
+                momenta = jax.tree_util.tree_map(
+                    lambda m, d: momentum * m + d, state.momenta, delta
+                )
+                update = momenta
+            else:
+                momenta = state.momenta
+                update = delta
+            params = jax.tree_util.tree_map(
+                lambda p, u: p - learning_rate * u, state.params, update
+            )
+
+        # report the globally-averaged loss (the reference prints per-rank
+        # epoch means, ddp_init.py:183; global mean is strictly more useful)
+        loss = all_reduce_mean(loss, axis_name)
+        return TrainState(params, momenta, memories, reducer_state, model_state), loss
+
+    return step
+
+
+class CompiledStep(NamedTuple):
+    """A jitted distributed step plus its static per-step wire cost."""
+
+    fn: Callable[[TrainState, Any], Tuple[TrainState, jax.Array]]
+    bits_per_step: int
+    mesh: Optional[Mesh]
+    reducer: Any
+
+    def __call__(self, state, batch):
+        return self.fn(state, batch)
+
+    @property
+    def num_devices(self) -> Optional[int]:
+        return self.mesh.size if self.mesh is not None else None
+
+    def init_state(self, params: PyTree, model_state: PyTree = None) -> TrainState:
+        """Build a correctly-shaped TrainState for this step (adds the
+        per-worker leading axis on error memories in the distributed case)."""
+        return init_train_state(params, self.reducer, model_state, self.num_devices)
+
+
+def _reducer_bits(reducer, params_template: PyTree) -> int:
+    """Static bits-on-wire for one reduction of ``params_template``."""
+    if hasattr(reducer, "bits_per_step"):
+        return reducer.bits_per_step(params_template)
+    leaves = jax.tree_util.tree_leaves(params_template)
+    return sum(8 * int(l.size) * l.dtype.itemsize for l in leaves)
+
+
+def make_train_step(
+    loss_fn: LossFn,
+    reducer,
+    params_template: PyTree,
+    learning_rate: float,
+    momentum: float = 0.9,
+    algorithm: str = "ef_momentum",
+    mesh: Optional[Mesh] = None,
+    axis_name: str = DATA_AXIS,
+    donate_state: bool = True,
+) -> CompiledStep:
+    """Compile the full distributed training step.
+
+    With a mesh: params/momenta/reducer/model state are replicated, the batch
+    and the per-worker error memories are sharded on their leading axis over
+    ``axis_name``, and the step body runs under ``shard_map`` with the
+    reducer's collectives riding the mesh (ICI on TPU). Without a mesh: the
+    single-process fallback (reference ``reducer.py:13-18``) — same code, no
+    collectives.
+    """
+    if mesh is None:
+        body = make_step_fn(
+            loss_fn, reducer, learning_rate, momentum, algorithm, axis_name=None
+        )
+        fn = jax.jit(body, donate_argnums=(0,) if donate_state else ())
+        return CompiledStep(fn, _reducer_bits(reducer, params_template), None, reducer)
+
+    body = make_step_fn(
+        loss_fn, reducer, learning_rate, momentum, algorithm, axis_name=axis_name
+    )
+
+    def sharded_body(state: TrainState, batch):
+        # strip the per-worker leading axis off the error memories:
+        # global (num_devices, *shape) → this device's (*shape)
+        local = state._replace(
+            memories=jax.tree_util.tree_map(lambda m: m[0], state.memories)
+        )
+        new_state, loss = body(local, batch)
+        return (
+            new_state._replace(
+                memories=jax.tree_util.tree_map(lambda m: m[None], new_state.memories)
+            ),
+            loss,
+        )
+
+    state_specs = TrainState(
+        params=PartitionSpec(),
+        momenta=PartitionSpec(),
+        memories=PartitionSpec(axis_name),
+        reducer_state=PartitionSpec(),
+        model_state=PartitionSpec(),
+    )
+    sharded = jax.shard_map(
+        sharded_body,
+        mesh=mesh,
+        in_specs=(state_specs, PartitionSpec(axis_name)),
+        out_specs=(state_specs, PartitionSpec()),
+    )
+    fn = jax.jit(sharded, donate_argnums=(0,) if donate_state else ())
+    return CompiledStep(fn, _reducer_bits(reducer, params_template), mesh, reducer)

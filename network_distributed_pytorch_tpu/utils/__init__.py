@@ -1,0 +1,4 @@
+"""Config, losses, metrics, bandwidth model."""
+
+from .losses import cross_entropy_loss  # noqa: F401
+from .config import ExperimentConfig  # noqa: F401

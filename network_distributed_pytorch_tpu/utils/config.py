@@ -1,0 +1,40 @@
+"""Experiment configuration.
+
+One typed dataclass replacing the reference's four module-level mutable
+``config`` dicts (``ddp_guide/ddp_init.py:9-17``,
+``ddp_powersgd_guide_cifar10/ddp_init.py:22-37``,
+``ddp_powersgd_distillBERT_IMDb/ddp_init.py:23-39``) — same key set, renamed
+to JAX terms where the torch term has no TPU meaning (``cuda_rank`` dropped;
+``distributed_backend`` is always XLA; ``init_method`` →
+``coordinator_address``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class ExperimentConfig:
+    # rendezvous (reference: seed/rank/n_workers/init_method keys)
+    seed: int = 714
+    process_id: int = 0
+    num_processes: int = 1
+    coordinator_address: Optional[str] = None
+    timeout_seconds: int = 600
+
+    # optimization (reference: learning_rate/momentum/nesterov/... keys)
+    learning_rate: float = 0.001
+    momentum: float = 0.9
+    nesterov: bool = False  # declared-but-unused in the reference too (ddp_init.py:33)
+    training_epochs: int = 100
+    global_batch_size: int = 256
+
+    # compression (reference: reducer_rank)
+    reducer_rank: int = 4
+    reuse_query: bool = True
+
+    # TPU-native extras
+    compute_dtype: str = "float32"  # "bfloat16" for MXU mixed precision
+    log_every: int = 10

@@ -1,0 +1,17 @@
+"""Loss functions. The reference uses ``nn.CrossEntropyLoss()`` (mean
+reduction over the batch, integer targets) everywhere
+(``ddp_guide_cifar10/ddp_init.py:110``; HF models compute the same internally,
+``ddp_powersgd_distillBERT_IMDb/ddp_init.py:186-190``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Softmax cross-entropy with integer labels, mean over the batch —
+    ``torch.nn.CrossEntropyLoss`` semantics."""
+    logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logprobs, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(nll)
