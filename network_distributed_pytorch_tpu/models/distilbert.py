@@ -1,0 +1,153 @@
+"""DistilBERT — first-party flax implementation, TPU-first.
+
+The reference consumes HuggingFace
+``DistilBertForSequenceClassification.from_pretrained('distilbert-base-uncased')``
+(``ddp_powersgd_distillBERT_IMDb/ddp_init.py:150``) for IMDb sentiment
+fine-tuning. This is the same architecture (Sanh et al. 2019): learned word +
+position embeddings → LayerNorm → 6 post-LN transformer blocks (12 heads,
+GELU FFN ×4) → sequence classification head over the first token
+(pre_classifier → ReLU → classifier), returning the CE loss like the HF model
+does when given labels (``ddp_init.py:186-190`` uses ``outputs[0]`` as loss).
+
+TPU-first choices: a ``dtype`` knob runs attention/FFN matmuls in bfloat16 on
+the MXU with fp32 params; shapes are fully static (tokenizer pads to a fixed
+``max_len``, as the reference's tokenizer call does with
+``truncation=True, padding=True``, ``ddp_init.py:74-77``); attention is plain
+``einsum`` that XLA fuses — no data-dependent control flow.
+
+``DistilBertConfig`` defaults match distilbert-base-uncased so pretrained
+weights import 1:1 (see ``models.import_weights``); the test tier shrinks it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class DistilBertConfig:
+    vocab_size: int = 30522
+    max_position_embeddings: int = 512
+    dim: int = 768
+    n_layers: int = 6
+    n_heads: int = 12
+    hidden_dim: int = 3072
+    dropout: float = 0.1
+    attention_dropout: float = 0.1
+    num_labels: int = 2
+    dtype: Any = jnp.float32
+
+
+class MultiHeadSelfAttention(nn.Module):
+    config: DistilBertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.config
+        head_dim = cfg.dim // cfg.n_heads
+        dense = lambda name: nn.Dense(cfg.dim, dtype=cfg.dtype, name=name)
+        q = dense("q_lin")(x)
+        k = dense("k_lin")(x)
+        v = dense("v_lin")(x)
+
+        def split(t):
+            return t.reshape(t.shape[0], t.shape[1], cfg.n_heads, head_dim)
+
+        q, k, v = split(q), split(k), split(v)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(head_dim).astype(cfg.dtype)
+        # additive mask: 0 for real tokens, -inf for padding
+        scores = scores + mask[:, None, None, :]
+        weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        weights = nn.Dropout(cfg.attention_dropout)(weights, deterministic=deterministic)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+        ctx = ctx.reshape(ctx.shape[0], ctx.shape[1], cfg.dim)
+        return dense("out_lin")(ctx)
+
+
+class TransformerBlock(nn.Module):
+    """Post-LN block, DistilBERT layout: LN after attention residual and after
+    FFN residual."""
+
+    config: DistilBertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.config
+        attn = MultiHeadSelfAttention(cfg, name="attention")(x, mask, deterministic)
+        x = nn.LayerNorm(epsilon=1e-12, dtype=cfg.dtype, name="sa_layer_norm")(x + attn)
+        h = nn.Dense(cfg.hidden_dim, dtype=cfg.dtype, name="ffn_lin1")(x)
+        h = nn.gelu(h, approximate=False)
+        h = nn.Dense(cfg.dim, dtype=cfg.dtype, name="ffn_lin2")(h)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return nn.LayerNorm(epsilon=1e-12, dtype=cfg.dtype, name="output_layer_norm")(x + h)
+
+
+class DistilBertEncoder(nn.Module):
+    config: DistilBertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask, deterministic: bool = True):
+        cfg = self.config
+        positions = jnp.arange(input_ids.shape[1])[None, :]
+        x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype, name="word_embeddings")(input_ids)
+        x = x + nn.Embed(
+            cfg.max_position_embeddings, cfg.dim, dtype=cfg.dtype, name="position_embeddings"
+        )(positions)
+        x = nn.LayerNorm(epsilon=1e-12, dtype=cfg.dtype, name="embed_layer_norm")(x)
+        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+
+        neg_inf = jnp.asarray(jnp.finfo(jnp.float32).min, dtype=cfg.dtype)
+        mask = jnp.where(attention_mask > 0, 0.0, neg_inf).astype(cfg.dtype)
+        for i in range(cfg.n_layers):
+            x = TransformerBlock(cfg, name=f"layer_{i}")(x, mask, deterministic)
+        return x
+
+
+class DistilBertForSequenceClassification(nn.Module):
+    """HF-equivalent classifier head: first-token pooling → pre_classifier →
+    ReLU → dropout → classifier (returns logits; pair with
+    ``utils.cross_entropy_loss`` for the HF loss-from-labels behavior)."""
+
+    config: DistilBertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask, deterministic: bool = True):
+        cfg = self.config
+        hidden = DistilBertEncoder(cfg, name="distilbert")(
+            input_ids, attention_mask, deterministic
+        )
+        pooled = hidden[:, 0]
+        pooled = nn.Dense(cfg.dim, dtype=cfg.dtype, name="pre_classifier")(pooled)
+        pooled = nn.relu(pooled)
+        pooled = nn.Dropout(cfg.dropout)(pooled, deterministic=deterministic)
+        logits = nn.Dense(cfg.num_labels, dtype=cfg.dtype, name="classifier")(pooled)
+        return logits.astype(jnp.float32)
+
+
+def distilbert_base(num_labels: int = 2, dtype=jnp.float32) -> DistilBertForSequenceClassification:
+    """distilbert-base-uncased shape (the reference's checkpoint,
+    ``ddp_powersgd_distillBERT_IMDb/ddp_init.py:150``)."""
+    return DistilBertForSequenceClassification(
+        DistilBertConfig(num_labels=num_labels, dtype=dtype)
+    )
+
+
+def distilbert_tiny(num_labels: int = 2, dtype=jnp.float32) -> DistilBertForSequenceClassification:
+    """Test-tier configuration (SURVEY §4: 'DistilBERT-shaped toy transformer')."""
+    return DistilBertForSequenceClassification(
+        DistilBertConfig(
+            vocab_size=1024,
+            max_position_embeddings=64,
+            dim=32,
+            n_layers=2,
+            n_heads=4,
+            hidden_dim=64,
+            num_labels=num_labels,
+            dtype=dtype,
+        )
+    )
