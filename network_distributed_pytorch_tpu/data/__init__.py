@@ -1,3 +1,7 @@
 """L0 — data layer: deterministic cross-rank partitioning + dataset pipelines."""
 
 from .partition import Partition, DataPartitioner, partition_dataset  # noqa: F401
+
+from .loader import iterate_batches, steps_per_epoch  # noqa: F401
+from .cifar10 import load_cifar10, load_cifar10_or_synthetic, synthetic_cifar10  # noqa: F401
+from .imdb import HashTokenizer, prepare_imdb, read_imdb_split, synthetic_imdb  # noqa: F401

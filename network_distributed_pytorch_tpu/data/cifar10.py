@@ -1,0 +1,81 @@
+"""CIFAR-10 pipeline.
+
+The reference loads CIFAR-10 via
+``torchvision.datasets.CIFAR10("./data", train=True, download=True,
+transform=[ToTensor, Normalize((.5,.5,.5),(.5,.5,.5))])``
+(``ddp_guide_cifar10/ddp_init.py:42-47``). This module reads the SAME on-disk
+format (the ``cifar-10-batches-py`` pickle batches torchvision downloads)
+directly — no torch in the loop — applies the same normalization, and emits
+**NHWC** float32 (TPU-native layout; the reference's NCHW is a GPU-ism).
+
+When the dataset is not on disk (this build environment has no egress), a
+deterministic synthetic stand-in with identical shapes/dtypes/semantics keeps
+every pipeline and test runnable; real data is a drop-in swap.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional, Tuple
+
+import numpy as np
+
+_MEAN = 0.5  # transforms.Normalize((0.5,0.5,0.5),(0.5,0.5,0.5)) — ddp_init.py:44
+_STD = 0.5
+
+
+def _normalize(images_u8: np.ndarray) -> np.ndarray:
+    return ((images_u8.astype(np.float32) / 255.0) - _MEAN) / _STD
+
+
+def cifar10_on_disk(data_dir: str = "./data") -> Optional[str]:
+    """Path of an extracted ``cifar-10-batches-py`` directory, if present."""
+    p = os.path.join(data_dir, "cifar-10-batches-py")
+    return p if os.path.isdir(p) else None
+
+
+def load_cifar10(
+    data_dir: str = "./data", train: bool = True
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(images NHWC float32 normalized, labels int32). Raises if absent —
+    use ``load_cifar10_or_synthetic`` for the gated fallback."""
+    base = cifar10_on_disk(data_dir)
+    if base is None:
+        raise FileNotFoundError(
+            f"CIFAR-10 not found under {data_dir!r} (expected cifar-10-batches-py/; "
+            "the reference downloads it via torchvision, ddp_guide_cifar10/ddp_init.py:45)"
+        )
+    names = [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
+    xs, ys = [], []
+    for name in names:
+        with open(os.path.join(base, name), "rb") as f:
+            entry = pickle.load(f, encoding="latin1")
+        xs.append(np.asarray(entry["data"], dtype=np.uint8))
+        ys.append(np.asarray(entry["labels"], dtype=np.int32))
+    data = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)  # NCHW→NHWC
+    return _normalize(data), np.concatenate(ys)
+
+
+def synthetic_cifar10(
+    n: int = 50000, seed: int = 0, num_classes: int = 10
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic CIFAR-shaped class-blob data (32×32×3, normalized range),
+    learnable by the real models — the test/no-egress stand-in."""
+    rng = np.random.RandomState(seed)
+    means = rng.randn(num_classes, 32, 32, 3).astype(np.float32) * 0.5
+    labels = rng.randint(0, num_classes, size=n).astype(np.int32)
+    images = means[labels] + 0.25 * rng.randn(n, 32, 32, 3).astype(np.float32)
+    return np.clip(images, -1.0, 1.0), labels
+
+
+def load_cifar10_or_synthetic(
+    data_dir: str = "./data", train: bool = True, synthetic_n: int = 4096, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray, bool]:
+    """(images, labels, is_real). Real data when on disk, synthetic otherwise."""
+    try:
+        x, y = load_cifar10(data_dir, train)
+        return x, y, True
+    except FileNotFoundError:
+        x, y = synthetic_cifar10(synthetic_n, seed=seed)
+        return x, y, False

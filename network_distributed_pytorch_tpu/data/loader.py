@@ -1,0 +1,43 @@
+"""Batch iteration over in-memory numpy datasets.
+
+Replaces the reference's ``DataLoader(partition, batch_size=bsz,
+shuffle=True)`` (``ddp_guide_cifar10/ddp_init.py:52-54``). TPU-first
+differences:
+
+- batches are **static-shape**: the trailing partial batch is dropped by
+  default (a torch DataLoader yields it; a ragged last batch would force an
+  XLA recompile every epoch — the classic TPU anti-pattern).
+- shuffling is seeded and epoch-keyed, so every run (and every host in a
+  multi-host setup feeding the same partition logic) is reproducible.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Sequence, Tuple
+
+import numpy as np
+
+
+def iterate_batches(
+    arrays: Sequence[np.ndarray],
+    batch_size: int,
+    seed: int = 0,
+    epoch: int = 0,
+    shuffle: bool = True,
+    drop_last: bool = True,
+) -> Iterator[Tuple[np.ndarray, ...]]:
+    """Yield aligned minibatch tuples from equal-length arrays."""
+    n = len(arrays[0])
+    for a in arrays:
+        assert len(a) == n, "batch arrays must be aligned"
+    idx = np.arange(n)
+    if shuffle:
+        np.random.RandomState(seed + epoch).shuffle(idx)
+    end = (n // batch_size) * batch_size if drop_last else n
+    for start in range(0, end, batch_size):
+        sel = idx[start : start + batch_size]
+        yield tuple(a[sel] for a in arrays)
+
+
+def steps_per_epoch(n: int, batch_size: int, drop_last: bool = True) -> int:
+    return n // batch_size if drop_last else -(-n // batch_size)
