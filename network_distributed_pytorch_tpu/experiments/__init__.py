@@ -1,0 +1,11 @@
+"""The reference's four guides (plus its single-node baseline and the
+bandwidth study they were all built for), as library entry points."""
+
+from . import (  # noqa: F401
+    bandwidth_study,
+    bare_init,
+    exact_cifar10,
+    imdb_baseline,
+    powersgd_cifar10,
+    powersgd_imdb,
+)

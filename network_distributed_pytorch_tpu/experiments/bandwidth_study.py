@@ -1,0 +1,99 @@
+"""The bandwidth study harness — the experiment the reference was built for
+but never reports (README.md:1-2 promises "Internel / 1Gb / 10Gb / 100Gb
+distributed learning experiment"; no numbers exist anywhere, SURVEY §6).
+
+Measures real per-step compute+ICI time for the exact and PowerSGD paths on
+whatever devices are present, takes the static bytes-on-wire from the
+reducers, and projects total step time over each of the reference's fabrics
+(1/10/100 GbE) and TPU ICI via the ring-allreduce model in
+``utils.bandwidth``. One run ⇒ the full comparison table.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..data import synthetic_cifar10
+from ..models import resnet18
+from ..parallel import ExactReducer, PowerSGDReducer, make_mesh
+from ..parallel.trainer import make_train_step
+from ..utils.bandwidth import bandwidth_table, format_table
+from ..utils.config import ExperimentConfig
+from .common import image_classifier_loss
+
+
+def _measure_step_time(step, state, batch, steps: int = 5) -> float:
+    state, loss = step(state, batch)  # compile + warmup
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step(state, batch)
+    jax.block_until_ready(loss)
+    return (time.perf_counter() - t0) / steps
+
+
+def run(
+    config: Optional[ExperimentConfig] = None,
+    preset: str = "small",
+    mesh=None,
+    global_batch: int = 256,
+    reducer_ranks=(1, 2, 4),
+) -> Dict:
+    config = config or ExperimentConfig()
+    mesh = mesh or make_mesh()
+    n_workers = mesh.size
+
+    if preset == "full":
+        from ..models import resnet152
+
+        model = resnet152(num_classes=10, norm="batch", stem="imagenet")
+    else:
+        model = resnet18(num_classes=10, norm="batch", stem="cifar", width=16)
+
+    images, labels = synthetic_cifar10(global_batch, seed=config.seed)
+    batch = (jnp.asarray(images), jnp.asarray(labels))
+    variables = model.init(
+        jax.random.PRNGKey(config.seed), jnp.zeros((1, 32, 32, 3)), train=True
+    )
+    loss_fn = image_classifier_loss(model, has_batch_stats=True)
+
+    configs = {"exact": (ExactReducer(), "sgd", 1)}
+    for r in reducer_ranks:
+        configs[f"powersgd_r{r}"] = (
+            PowerSGDReducer(random_seed=config.seed, compression_rank=r, matricize="last"),
+            "ef_momentum",
+            3,  # P, Q, rank-1 collectives — reducer.py:126-147
+        )
+
+    tables = {}
+    results = {}
+    for name, (reducer, algorithm, n_coll) in configs.items():
+        step = make_train_step(
+            loss_fn, reducer, variables["params"],
+            learning_rate=config.learning_rate, momentum=config.momentum,
+            algorithm=algorithm, mesh=mesh, donate_state=False,
+        )
+        state = step.init_state(
+            variables["params"], model_state={"batch_stats": variables["batch_stats"]}
+        )
+        compute_s = _measure_step_time(step, state, batch)
+        table = bandwidth_table(step.bits_per_step, compute_s, n_workers, n_coll)
+        tables[name] = table
+        results[name] = {
+            "bits_per_step": step.bits_per_step,
+            "mbytes_per_step": step.bits_per_step / 8e6,
+            "measured_step_s": compute_s,
+            "projected_step_s": {f: e.step_time_s for f, e in table.items()},
+        }
+
+    print(f"\nBandwidth study — {n_workers} workers, global batch {global_batch}")
+    print(format_table(tables))
+    exact_bits = results["exact"]["bits_per_step"]
+    for name, r in results.items():
+        if name != "exact":
+            r["compression_ratio"] = exact_bits / r["bits_per_step"]
+    return {"experiment": "bandwidth_study", "num_devices": n_workers, "results": results}

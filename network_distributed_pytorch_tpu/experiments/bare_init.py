@@ -1,0 +1,49 @@
+"""Entry point D — bare distributed init (the reference's ``ddp_guide``).
+
+Mirrors ``ddp_guide/ddp_init.py:19-47``: seed with ``seed + rank``
+(``:20-21``), rendezvous (file:// there, coordinator address here), print the
+lifecycle banners, and tear down. The "hello world" of L1: proves the
+coordination service and the mesh come up.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from ..parallel.mesh import (
+    DistributedConfig,
+    initialize_distributed,
+    make_mesh,
+    shutdown_distributed,
+)
+from ..utils.config import ExperimentConfig
+
+
+def run(config: Optional[ExperimentConfig] = None) -> Dict:
+    config = config or ExperimentConfig(training_epochs=0)
+    np.random.seed(config.seed + config.process_id)  # ddp_guide/ddp_init.py:20-21
+
+    print("==============================")
+    print(">>>>> Distributed Initialization (TPU/XLA) <<<<<")
+    print(
+        f"Init: process {config.process_id}/{config.num_processes - 1} "
+        f"(total {config.num_processes}) - coordinator ({config.coordinator_address})"
+    )
+    initialize_distributed(
+        DistributedConfig(
+            seed=config.seed,
+            process_id=config.process_id,
+            num_processes=config.num_processes,
+            coordinator_address=config.coordinator_address,
+            timeout_seconds=config.timeout_seconds,
+        )
+    )
+    mesh = make_mesh()
+    n = mesh.size
+    print(f"All processes initialized; mesh axes {mesh.axis_names}, {n} devices")
+    print("==============================\n")
+    shutdown_distributed()
+    return {"experiment": "bare_init", "num_devices": n, "process_id": config.process_id}

@@ -1,0 +1,71 @@
+"""Shared experiment machinery: the epoch/step loop with metrics.
+
+The reference duplicates its ``setup()/run_task()/cleanup()`` lifecycle and
+training loop in four directories (SURVEY §2.4); here it exists once. The
+loop is host-side Python feeding a single compiled step — all math, including
+the collectives, lives in the jitted ``shard_map`` step (trainer.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import flax.linen as nn
+import jax
+
+from ..parallel.trainer import CompiledStep, TrainState
+from ..utils.metrics import MetricsLogger
+
+
+def train_loop(
+    step: CompiledStep,
+    state: TrainState,
+    batches_for_epoch: Callable[[int], Iterator[Any]],
+    epochs: int,
+    rank: int = 0,
+    log_every: int = 0,
+) -> Tuple[TrainState, MetricsLogger]:
+    """Run ``epochs`` passes, logging loss / step-time / cumulative bits
+    (the reference's per-epoch banner + the bits it never reported)."""
+    logger = MetricsLogger(bits_per_step=step.bits_per_step, log_every=log_every)
+    for epoch in range(epochs):
+        for batch in batches_for_epoch(epoch):
+            logger.start_step()
+            state, loss = step(state, batch)
+            logger.end_step(epoch, jax.device_get(loss))
+        logger.end_epoch(epoch, rank=rank)
+    return state, logger
+
+
+def image_classifier_loss(model: nn.Module, has_batch_stats: bool):
+    """Trainer loss_fn for NHWC image classifiers (CE loss, the reference's
+    ``nn.CrossEntropyLoss()`` — ``ddp_guide_cifar10/ddp_init.py:110``)."""
+    from ..utils.losses import cross_entropy_loss
+
+    if not has_batch_stats:
+
+        def loss_fn(params, model_state, batch):
+            x, y = batch
+            logits = model.apply({"params": params}, x, train=True)
+            return cross_entropy_loss(logits, y), model_state
+
+        return loss_fn
+
+    def loss_fn(params, model_state, batch):
+        x, y = batch
+        logits, new_vars = model.apply(
+            {"params": params, "batch_stats": model_state["batch_stats"]},
+            x,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        return cross_entropy_loss(logits, y), {"batch_stats": new_vars["batch_stats"]}
+
+    return loss_fn
+
+
+def summarize(name: str, logger: MetricsLogger, extra: Optional[Dict] = None) -> Dict:
+    out = {"experiment": name, **logger.summary()}
+    if extra:
+        out.update(extra)
+    return out

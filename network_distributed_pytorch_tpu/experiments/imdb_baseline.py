@@ -1,0 +1,93 @@
+"""Entry point E — single-node IMDb fine-tuning baseline
+(the reference's ``IMDb_distillBERT_example.py``).
+
+Reference: DistilBERT, SGD lr 5e-5 nesterov momentum .9 (``:57``), batch 16,
+5 epochs, per-epoch mean-loss print (``:61-73``). This is the accuracy/loss
+yardstick the compressed distributed run must match (SURVEY §3.5). No mesh,
+no collectives — the single-process fallback path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..data import iterate_batches, prepare_imdb
+from ..models.distilbert import distilbert_base, distilbert_tiny
+from ..parallel import ExactReducer
+from ..parallel.trainer import make_train_step
+from ..utils.config import ExperimentConfig
+from ..utils.losses import cross_entropy_loss
+from .common import summarize, train_loop
+
+
+def run(
+    config: Optional[ExperimentConfig] = None,
+    preset: str = "small",
+    data_dir: Optional[str] = None,
+    tokenizer=None,
+    pretrained_variables=None,
+    max_len: int = 256,
+    max_steps_per_epoch: Optional[int] = None,
+) -> Dict:
+    config = config or ExperimentConfig(
+        training_epochs=5, learning_rate=5e-5, global_batch_size=16
+    )
+    if preset == "full":
+        model = distilbert_base(num_labels=2, dtype=jnp.dtype(config.compute_dtype))
+    else:
+        model = distilbert_tiny(num_labels=2, dtype=jnp.dtype(config.compute_dtype))
+        max_len = min(max_len, model.config.max_position_embeddings)
+
+    train_split, _val, is_real = prepare_imdb(
+        data_dir=data_dir, tokenizer=tokenizer, max_len=max_len,
+        vocab_size=model.config.vocab_size, seed=config.seed,
+    )
+
+    if pretrained_variables is None:
+        variables = model.init(
+            jax.random.PRNGKey(config.seed),
+            jnp.zeros((1, max_len), jnp.int32),
+            jnp.ones((1, max_len), jnp.int32),
+        )
+    else:
+        variables = pretrained_variables
+    params = variables["params"]
+
+    def loss_fn(params, model_state, batch):
+        logits = model.apply(
+            {"params": params}, batch["input_ids"], batch["attention_mask"],
+            deterministic=True,
+        )
+        return cross_entropy_loss(logits, batch["labels"]), model_state
+
+    step = make_train_step(
+        loss_fn,
+        ExactReducer(),
+        params,
+        learning_rate=config.learning_rate,
+        momentum=config.momentum,
+        algorithm="sgd_nesterov",  # IMDb_distillBERT_example.py:57
+        mesh=None,
+    )
+    state = step.init_state(params)
+
+    arrays = [train_split["input_ids"], train_split["attention_mask"], train_split["labels"]]
+
+    def batches(epoch):
+        it = iterate_batches(arrays, config.global_batch_size, seed=config.seed, epoch=epoch)
+        for i, (ids, mask, y) in enumerate(it):
+            if max_steps_per_epoch is not None and i >= max_steps_per_epoch:
+                return
+            yield {
+                "input_ids": jnp.asarray(ids),
+                "attention_mask": jnp.asarray(mask),
+                "labels": jnp.asarray(y),
+            }
+
+    state, logger = train_loop(
+        step, state, batches, config.training_epochs, log_every=config.log_every
+    )
+    return summarize("imdb_baseline", logger, {"preset": preset, "real_data": is_real})

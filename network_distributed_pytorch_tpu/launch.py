@@ -1,0 +1,121 @@
+"""L5 — launcher CLI.
+
+Mirrors the reference's per-rank ``run_script.py`` launchers: ``-rank``
+(``ddp_guide/run_script.py:27-28``), ``-world_size`` / ``-init_method``
+(``ddp_powersgd_distillBERT_IMDb/run_script.py:27-31``), which mutate the
+config and call the experiment lifecycle. One launcher serves every
+experiment (the reference copies the script four times); the ``cuda_rnak``
+typo and hard-coded lab IPs are not reproduced (SURVEY §7).
+
+Usage::
+
+    python -m network_distributed_pytorch_tpu.launch powersgd_cifar10 \
+        --process-id 0 --num-processes 1 --preset small --epochs 1
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from .experiments import (
+    bandwidth_study,
+    bare_init,
+    exact_cifar10,
+    imdb_baseline,
+    powersgd_cifar10,
+    powersgd_imdb,
+)
+from .parallel.mesh import DistributedConfig, initialize_distributed
+from .utils.config import ExperimentConfig
+
+EXPERIMENTS = {
+    "bare_init": bare_init.run,
+    "exact_cifar10": exact_cifar10.run,
+    "powersgd_cifar10": powersgd_cifar10.run,
+    "powersgd_imdb": powersgd_imdb.run,
+    "imdb_baseline": imdb_baseline.run,
+    "bandwidth_study": bandwidth_study.run,
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("experiment", choices=sorted(EXPERIMENTS))
+    # the reference's -rank / -world_size / -init_method flags
+    p.add_argument("--process-id", type=int, default=0, help="rank of this host process")
+    p.add_argument("--num-processes", type=int, default=1, help="world size (host processes)")
+    p.add_argument("--coordinator", type=str, default=None, help="host:port rendezvous")
+    p.add_argument("--seed", type=int, default=714)
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--global-batch", type=int, default=None)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--momentum", type=float, default=None)
+    p.add_argument("--reducer-rank", type=int, default=None)
+    p.add_argument("--preset", choices=["small", "full"], default="small")
+    p.add_argument("--data-dir", type=str, default="./data")
+    p.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32")
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--max-steps-per-epoch", type=int, default=None)
+    p.add_argument("--json", action="store_true", help="print the summary as JSON")
+    return p
+
+
+def config_from_args(args) -> ExperimentConfig:
+    cfg = ExperimentConfig(
+        seed=args.seed,
+        process_id=args.process_id,
+        num_processes=args.num_processes,
+        coordinator_address=args.coordinator,
+        compute_dtype=args.dtype,
+        log_every=args.log_every,
+    )
+    if args.epochs is not None:
+        cfg.training_epochs = args.epochs
+    if args.global_batch is not None:
+        cfg.global_batch_size = args.global_batch
+    if args.lr is not None:
+        cfg.learning_rate = args.lr
+    if args.momentum is not None:
+        cfg.momentum = args.momentum
+    if args.reducer_rank is not None:
+        cfg.reducer_rank = args.reducer_rank
+    return cfg
+
+
+def main(argv=None) -> dict:
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+
+    # multi-host rendezvous before any experiment touches devices
+    # (the reference's setup() does the same before run_task())
+    if args.num_processes > 1 and args.experiment != "bare_init":
+        initialize_distributed(
+            DistributedConfig(
+                process_id=cfg.process_id,
+                num_processes=cfg.num_processes,
+                coordinator_address=cfg.coordinator_address,
+                timeout_seconds=cfg.timeout_seconds,
+            )
+        )
+
+    fn = EXPERIMENTS[args.experiment]
+    kwargs = {"config": cfg}
+    if args.experiment in ("exact_cifar10", "powersgd_cifar10"):
+        kwargs.update(preset=args.preset, data_dir=args.data_dir,
+                      max_steps_per_epoch=args.max_steps_per_epoch)
+    elif args.experiment in ("powersgd_imdb", "imdb_baseline"):
+        kwargs.update(preset=args.preset,
+                      data_dir=None if args.data_dir == "./data" else args.data_dir,
+                      max_steps_per_epoch=args.max_steps_per_epoch)
+    elif args.experiment == "bandwidth_study":
+        kwargs.update(preset=args.preset)
+
+    result = fn(**kwargs)
+    if args.json:
+        print(json.dumps(result, default=str))
+    return result
+
+
+if __name__ == "__main__":
+    main()

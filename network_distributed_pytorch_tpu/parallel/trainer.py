@@ -112,12 +112,14 @@ def make_step_fn(
         update, ``ddp_init.py:156-178``); pair with any reducer.
       - ``"sgd"``         — torch-style SGD+momentum (``optim.SGD`` semantics
         used by the exact-DDP trainer, ``ddp_guide_cifar10/ddp_init.py:110``).
+      - ``"sgd_nesterov"``— torch SGD with nesterov momentum (the reference's
+        single-node IMDb baseline, ``IMDb_distillBERT_example.py:57``).
       - ``"sgd_plain"``   — SGD without momentum.
 
     The returned callable is pure; use it directly on one device
     (``axis_name=None``) or inside ``shard_map`` (see ``make_train_step``).
     """
-    assert algorithm in ("ef_momentum", "sgd", "sgd_plain")
+    assert algorithm in ("ef_momentum", "sgd", "sgd_nesterov", "sgd_plain")
 
     def step(state: TrainState, batch) -> Tuple[TrainState, jax.Array]:
         # (Algo 2 line 6) local stochastic gradient. Params enter the shard_map
@@ -171,6 +173,14 @@ def make_step_fn(
                     lambda m, d: momentum * m + d, state.momenta, delta
                 )
                 update = momenta
+            elif algorithm == "sgd_nesterov":
+                # torch SGD nesterov: v ← μ·v + g; p ← p − lr·(g + μ·v)
+                momenta = jax.tree_util.tree_map(
+                    lambda m, d: momentum * m + d, state.momenta, delta
+                )
+                update = jax.tree_util.tree_map(
+                    lambda d, m: d + momentum * m, delta, momenta
+                )
             else:
                 momenta = state.momenta
                 update = delta

@@ -1,0 +1,88 @@
+"""The bandwidth study — the reference's raison d'être, made explicit.
+
+The reference exists to compare distributed training over in-node vs
+1/10/100 GbE links (README.md:1-2) but never reports numbers (SURVEY §6).
+This module closes the loop analytically: given a measured per-step wire
+payload (static, from the reducer) and per-step compute time (measured), it
+models the communication time and total step time on each fabric, including
+TPU ICI — so one single-chip run yields the full fabric comparison table the
+reference's lab cluster was built to produce empirically.
+
+Model: allreduce of B bytes over W workers on a fabric with per-link
+bandwidth β uses the standard ring bound ``t = 2·(W-1)/W · B / β`` plus a
+per-collective latency term. This is the same first-order model the PowerSGD
+paper uses for its speedup claims.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Sequence
+
+# bytes/second; ICI figure is v5e per-chip interconnect bandwidth (public
+# spec ~1.6 Tbps aggregate), GbE figures are the reference's fabrics
+FABRICS_BYTES_PER_S: Dict[str, float] = {
+    "1GbE": 0.125e9,
+    "10GbE": 1.25e9,
+    "100GbE": 12.5e9,
+    "ICI(v5e)": 200e9,
+}
+
+LATENCY_S: Dict[str, float] = {
+    "1GbE": 50e-6,
+    "10GbE": 30e-6,
+    "100GbE": 20e-6,
+    "ICI(v5e)": 1e-6,
+}
+
+
+@dataclass
+class FabricEstimate:
+    fabric: str
+    comm_time_s: float
+    step_time_s: float
+    comm_fraction: float
+
+
+def allreduce_time_s(
+    payload_bytes: float, n_workers: int, fabric: str, n_collectives: int = 1
+) -> float:
+    beta = FABRICS_BYTES_PER_S[fabric]
+    ring = 2.0 * (n_workers - 1) / max(n_workers, 1) * payload_bytes / beta
+    return ring + n_collectives * LATENCY_S[fabric]
+
+
+def bandwidth_table(
+    bits_per_step: int,
+    compute_time_s: float,
+    n_workers: int,
+    n_collectives: int = 3,
+    fabrics: Sequence[str] = ("1GbE", "10GbE", "100GbE", "ICI(v5e)"),
+) -> Dict[str, FabricEstimate]:
+    """Per-fabric step-time estimates for one training step. ``n_collectives``
+    is 3 for PowerSGD (P, Q, rank-1 — ``reducer.py:126-147``) and 1 for the
+    packed exact path (the reference's exact path used ~#params collectives;
+    ours packs into one)."""
+    payload = bits_per_step / 8.0
+    out: Dict[str, FabricEstimate] = {}
+    for fabric in fabrics:
+        comm = allreduce_time_s(payload, n_workers, fabric, n_collectives)
+        # serialized comm/compute (upper bound; XLA overlaps some of it)
+        total = compute_time_s + comm
+        out[fabric] = FabricEstimate(fabric, comm, total, comm / total if total else 0.0)
+    return out
+
+
+def format_table(tables: Dict[str, Dict[str, FabricEstimate]]) -> str:
+    """Render {config_name: bandwidth_table(...)} as an aligned text table."""
+    fabrics = None
+    lines = []
+    for name, table in tables.items():
+        if fabrics is None:
+            fabrics = list(table)
+            lines.append("config".ljust(24) + "".join(f.rjust(14) for f in fabrics))
+        row = name.ljust(24)
+        for f in fabrics:
+            row += f"{table[f].step_time_s * 1e3:11.2f} ms"
+        lines.append(row)
+    return "\n".join(lines)

@@ -1,0 +1,86 @@
+"""Metrics: per-step loss, wall-clock, and bytes-on-wire reporting.
+
+This finishes what the reference started and never shipped (SURVEY C9): it
+accumulates ``bits_communicated`` per step
+(``ddp_powersgd_guide_cifar10/ddp_init.py:123,161``) but never prints or
+persists it, and it imports ``time`` without ever measuring anything
+(``ddp_guide/ddp_init.py:4``). Here every step logs loss / step-time /
+cumulative bits, epochs print the reference's per-epoch mean-loss banner
+(``ddp_init.py:183``), and everything can be dumped as JSON lines.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class StepRecord:
+    step: int
+    epoch: int
+    loss: float
+    step_time_s: float
+    bits_cumulative: int
+
+
+@dataclass
+class MetricsLogger:
+    """Host-side accumulator; bits/step is static so the Python-int tally is
+    exact (no device traffic)."""
+
+    bits_per_step: int = 0
+    log_every: int = 0  # 0 = silent per-step
+    records: List[StepRecord] = field(default_factory=list)
+    _epoch_losses: List[float] = field(default_factory=list)
+    _step: int = 0
+    _bits: int = 0
+    _t_last: Optional[float] = None
+
+    def start_step(self) -> None:
+        self._t_last = time.perf_counter()
+
+    def end_step(self, epoch: int, loss: float) -> StepRecord:
+        dt = time.perf_counter() - (self._t_last or time.perf_counter())
+        self._bits += self.bits_per_step
+        rec = StepRecord(self._step, epoch, float(loss), dt, self._bits)
+        self.records.append(rec)
+        self._epoch_losses.append(float(loss))
+        self._step += 1
+        if self.log_every and self._step % self.log_every == 0:
+            print(
+                f"step {rec.step}: loss {rec.loss:.4f}, "
+                f"{rec.step_time_s * 1e3:.1f} ms, "
+                f"{rec.bits_cumulative / 8e6:.2f} MB on wire"
+            )
+        return rec
+
+    def end_epoch(self, epoch: int, rank: int = 0) -> float:
+        """Per-epoch mean loss, printed in the reference's banner style
+        (``ddp_powersgd_guide_cifar10/ddp_init.py:183``)."""
+        mean = sum(self._epoch_losses) / max(len(self._epoch_losses), 1)
+        print(f">>>>> Rank {rank}, epoch {epoch}: mean loss {mean:.4f}, "
+              f"{self.bits_communicated / 8e6:.2f} MB communicated")
+        self._epoch_losses = []
+        return mean
+
+    @property
+    def bits_communicated(self) -> int:
+        return self._bits
+
+    def summary(self) -> Dict:
+        times = [r.step_time_s for r in self.records[1:]]  # drop compile step
+        return {
+            "steps": len(self.records),
+            "final_loss": self.records[-1].loss if self.records else None,
+            "mean_step_time_s": sum(times) / len(times) if times else None,
+            "bits_communicated": self._bits,
+            "bytes_communicated": self._bits // 8,
+        }
+
+    def dump_jsonl(self, path: str) -> None:
+        with open(path, "w") as f:
+            for r in self.records:
+                f.write(json.dumps(r.__dict__) + "\n")
