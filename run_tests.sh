@@ -1,0 +1,9 @@
+#!/bin/sh
+# Test runner: force the CPU backend with 8 virtual devices and skip the
+# axon TPU plugin registration (PALLAS_AXON_POOL_IPS unset ⇒ sitecustomize
+# skips register(); otherwise a hung TPU tunnel can stall even CPU-only jax
+# at backend init).
+exec env -u PALLAS_AXON_POOL_IPS \
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/ "$@"
