@@ -125,13 +125,22 @@ class PowerSGDReducer:
         reuse_query: bool = True,
         compression_rank: int = 1,
         matricize: str = "first",
+        orthogonalize_impl: str = "xla",
     ):
         assert n_power_iterations == 0, "only the fused single power iteration is supported (reducer.py:30)"
         assert matricize in ("first", "last")
+        assert orthogonalize_impl in ("xla", "pallas")
         self.random_seed = random_seed
         self.reuse_query = reuse_query
         self.compression_rank = compression_rank
         self.matricize = matricize
+        if orthogonalize_impl == "pallas":
+            # VMEM-resident Gram-Schmidt TPU kernel (ops.pallas_orthogonalize)
+            from ..ops.pallas_orthogonalize import orthogonalize_pallas
+
+            self._orthogonalize = orthogonalize_pallas
+        else:
+            self._orthogonalize = orthogonalize
 
     # ---- static layout ---------------------------------------------------
 
@@ -246,7 +255,7 @@ class PowerSGDReducer:
             rank1_out = rank1_packer.unpack(rank1_reduced)
 
         # Step 5: P_hat <- ORTHOGONALIZE(P) (reducer.py:135-137)
-        ps = [orthogonalize(p) for p in ps]
+        ps = [self._orthogonalize(p) for p in ps]
 
         # Step 6: Q <- M^T P_hat (reducer.py:139-142)
         qs = [mat.T @ p for mat, p in zip(matrices, ps)]
