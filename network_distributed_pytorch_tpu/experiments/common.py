@@ -64,6 +64,49 @@ def image_classifier_loss(model: nn.Module, has_batch_stats: bool):
     return loss_fn
 
 
+def evaluate_image_classifier(
+    model, params, batch_stats, images, labels, batch_size: int = 256
+) -> float:
+    """Top-1 accuracy, eval mode (BN running stats). The reference never
+    evaluates — convergence was eyeballed from loss prints (SURVEY §4); this
+    provides the accuracy number its north-star targets actually need."""
+    import jax.numpy as jnp
+
+    from ..data import iterate_batches
+
+    @jax.jit
+    def predict(x):
+        logits = model.apply(
+            {"params": params, "batch_stats": batch_stats}, x, train=False
+        )
+        return jnp.argmax(logits, axis=-1)
+
+    correct = total = 0
+    for x, y in iterate_batches([images, labels], batch_size, shuffle=False):
+        correct += int((predict(jnp.asarray(x)) == jnp.asarray(y)).sum())
+        total += len(y)
+    return correct / max(total, 1)
+
+
+def evaluate_text_classifier(model, params, split, batch_size: int = 64) -> float:
+    """Top-1 accuracy for the DistilBERT classifier on an encoded split."""
+    import jax.numpy as jnp
+
+    from ..data import iterate_batches
+
+    @jax.jit
+    def predict(ids, mask):
+        logits = model.apply({"params": params}, ids, mask, deterministic=True)
+        return jnp.argmax(logits, axis=-1)
+
+    arrays = [split["input_ids"], split["attention_mask"], split["labels"]]
+    correct = total = 0
+    for ids, mask, y in iterate_batches(arrays, batch_size, shuffle=False):
+        correct += int((predict(jnp.asarray(ids), jnp.asarray(mask)) == jnp.asarray(y)).sum())
+        total += len(y)
+    return correct / max(total, 1)
+
+
 def summarize(name: str, logger: MetricsLogger, extra: Optional[Dict] = None) -> Dict:
     out = {"experiment": name, **logger.summary()}
     if extra:

@@ -40,6 +40,7 @@ def run(
     mesh=None,
     pretrained_variables=None,
     max_steps_per_epoch: Optional[int] = None,
+    eval_after: bool = False,
 ) -> Dict:
     config = config or ExperimentConfig(
         training_epochs=1, global_batch_size=256, learning_rate=0.001
@@ -83,8 +84,12 @@ def run(
         step, state, batches, config.training_epochs,
         rank=config.process_id, log_every=config.log_every,
     )
-    return summarize(
-        "exact_cifar10",
-        logger,
-        {"preset": preset, "real_data": is_real, "num_devices": mesh.size},
-    )
+    extra = {"preset": preset, "real_data": is_real, "num_devices": mesh.size}
+    if eval_after:
+        from .common import evaluate_image_classifier
+
+        test_x, test_y, _ = load_cifar10_or_synthetic(data_dir, train=False)
+        extra["eval_accuracy"] = evaluate_image_classifier(
+            model, state.params, state.model_state["batch_stats"], test_x, test_y
+        )
+    return summarize("exact_cifar10", logger, extra)

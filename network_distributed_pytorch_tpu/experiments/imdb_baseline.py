@@ -31,9 +31,16 @@ def run(
     pretrained_variables=None,
     max_len: int = 256,
     max_steps_per_epoch: Optional[int] = None,
+    optimizer_name: str = "sgd_nesterov",
 ) -> Dict:
+    """``optimizer_name``: "sgd_nesterov" reproduces
+    ``IMDb_distillBERT_example.py:57`` (5 epochs); "adamw" reproduces the
+    other reference baseline, AdamW lr 5e-5 / 3 epochs
+    (``IMDb_dataset_distributer.py:55-66``)."""
+    assert optimizer_name in ("sgd_nesterov", "adamw")
+    default_epochs = 5 if optimizer_name == "sgd_nesterov" else 3
     config = config or ExperimentConfig(
-        training_epochs=5, learning_rate=5e-5, global_batch_size=16
+        training_epochs=default_epochs, learning_rate=5e-5, global_batch_size=16
     )
     if preset == "full":
         model = distilbert_base(num_labels=2, dtype=jnp.dtype(config.compute_dtype))
@@ -63,14 +70,23 @@ def run(
         )
         return cross_entropy_loss(logits, batch["labels"]), model_state
 
+    if optimizer_name == "adamw":
+        import optax
+
+        optimizer = optax.adamw(config.learning_rate)
+        algorithm = "optax"
+    else:
+        optimizer = None
+        algorithm = "sgd_nesterov"  # IMDb_distillBERT_example.py:57
     step = make_train_step(
         loss_fn,
         ExactReducer(),
         params,
         learning_rate=config.learning_rate,
         momentum=config.momentum,
-        algorithm="sgd_nesterov",  # IMDb_distillBERT_example.py:57
+        algorithm=algorithm,
         mesh=None,
+        optimizer=optimizer,
     )
     state = step.init_state(params)
 
@@ -90,4 +106,8 @@ def run(
     state, logger = train_loop(
         step, state, batches, config.training_epochs, log_every=config.log_every
     )
-    return summarize("imdb_baseline", logger, {"preset": preset, "real_data": is_real})
+    return summarize(
+        "imdb_baseline",
+        logger,
+        {"preset": preset, "real_data": is_real, "optimizer": optimizer_name},
+    )

@@ -38,6 +38,7 @@ def run(
     mesh=None,
     pretrained_variables=None,
     max_steps_per_epoch: Optional[int] = None,
+    eval_after: bool = False,
 ) -> Dict:
     config = config or ExperimentConfig(
         training_epochs=1, global_batch_size=512, learning_rate=0.001, reducer_rank=4
@@ -87,13 +88,17 @@ def run(
         step, state, batches, config.training_epochs,
         rank=config.process_id, log_every=config.log_every,
     )
-    return summarize(
-        "powersgd_cifar10",
-        logger,
-        {
-            "preset": preset,
-            "real_data": is_real,
-            "num_devices": mesh.size,
-            "reducer_rank": config.reducer_rank,
-        },
-    )
+    extra = {
+        "preset": preset,
+        "real_data": is_real,
+        "num_devices": mesh.size,
+        "reducer_rank": config.reducer_rank,
+    }
+    if eval_after:
+        from .common import evaluate_image_classifier
+
+        test_x, test_y, _ = load_cifar10_or_synthetic(data_dir, train=False)
+        extra["eval_accuracy"] = evaluate_image_classifier(
+            model, state.params, state.model_state["batch_stats"], test_x, test_y
+        )
+    return summarize("powersgd_cifar10", logger, extra)

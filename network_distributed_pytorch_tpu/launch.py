@@ -40,11 +40,19 @@ EXPERIMENTS = {
 
 
 def build_parser() -> argparse.ArgumentParser:
+    import os
+
+    # mpirun-style launch (the reference documents the same env-var path,
+    # ``ddp_guide/run_script.py:8-22``): OMPI_COMM_WORLD_RANK/SIZE become the
+    # flag defaults, so `mpirun -np N python -m ...launch exp` just works.
+    env_rank = int(os.environ.get("OMPI_COMM_WORLD_RANK", 0))
+    env_size = int(os.environ.get("OMPI_COMM_WORLD_SIZE", 1))
+
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("experiment", choices=sorted(EXPERIMENTS))
     # the reference's -rank / -world_size / -init_method flags
-    p.add_argument("--process-id", type=int, default=0, help="rank of this host process")
-    p.add_argument("--num-processes", type=int, default=1, help="world size (host processes)")
+    p.add_argument("--process-id", type=int, default=env_rank, help="rank of this host process")
+    p.add_argument("--num-processes", type=int, default=env_size, help="world size (host processes)")
     p.add_argument("--coordinator", type=str, default=None, help="host:port rendezvous")
     p.add_argument("--seed", type=int, default=714)
     p.add_argument("--epochs", type=int, default=None)

@@ -68,10 +68,16 @@ class TrainState(NamedTuple):
 
 
 def init_train_state(
-    params: PyTree, reducer, model_state: PyTree = None, num_devices: Optional[int] = None
+    params: PyTree,
+    reducer,
+    model_state: PyTree = None,
+    num_devices: Optional[int] = None,
+    optimizer=None,
 ) -> TrainState:
     """Zero-init the carry. ``num_devices`` adds the per-worker leading axis on
-    the error memories for the distributed step (None → single-process)."""
+    the error memories for the distributed step (None → single-process).
+    With an optax ``optimizer`` (algorithm="optax"), the ``momenta`` slot
+    holds the optax opt_state instead of raw momentum buffers."""
     zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
     if num_devices is None:
         memories = zeros
@@ -81,7 +87,7 @@ def init_train_state(
         )
     return TrainState(
         params=params,
-        momenta=zeros,
+        momenta=optimizer.init(params) if optimizer is not None else zeros,
         memories=memories,
         reducer_state=reducer.init(params),
         model_state={} if model_state is None else model_state,
@@ -104,6 +110,7 @@ def make_step_fn(
     momentum: float = 0.9,
     algorithm: str = "ef_momentum",
     axis_name: Optional[str] = DATA_AXIS,
+    optimizer=None,
 ) -> Callable[[TrainState, Any], Tuple[TrainState, jax.Array]]:
     """Build the per-device step body: ``(state, local_batch) -> (state, loss)``.
 
@@ -115,11 +122,15 @@ def make_step_fn(
       - ``"sgd_nesterov"``— torch SGD with nesterov momentum (the reference's
         single-node IMDb baseline, ``IMDb_distillBERT_example.py:57``).
       - ``"sgd_plain"``   — SGD without momentum.
+      - ``"optax"``       — any optax GradientTransformation applied to the
+        reduced gradient (used for the reference's AdamW IMDb baseline,
+        ``IMDb_dataset_distributer.py:55-66``); pass ``optimizer=``.
 
     The returned callable is pure; use it directly on one device
     (``axis_name=None``) or inside ``shard_map`` (see ``make_train_step``).
     """
-    assert algorithm in ("ef_momentum", "sgd", "sgd_nesterov", "sgd_plain")
+    assert algorithm in ("ef_momentum", "sgd", "sgd_nesterov", "sgd_plain", "optax")
+    assert (algorithm == "optax") == (optimizer is not None)
 
     def step(state: TrainState, batch) -> Tuple[TrainState, jax.Array]:
         # (Algo 2 line 6) local stochastic gradient. Params enter the shard_map
@@ -162,6 +173,14 @@ def make_step_fn(
                 delta,
                 momenta,
             )
+        elif algorithm == "optax":
+            reducer_state, delta, memories, _ = reducer.reduce(
+                state.reducer_state, grads, axis_name
+            )
+            import optax
+
+            updates, momenta = optimizer.update(delta, state.momenta, state.params)
+            params = optax.apply_updates(state.params, updates)
         else:
             # exact-DDP path: allreduce-mean the raw gradients
             reducer_state, delta, memories, _ = reducer.reduce(
@@ -203,6 +222,7 @@ class CompiledStep(NamedTuple):
     bits_per_step: int
     mesh: Optional[Mesh]
     reducer: Any
+    optimizer: Any = None
 
     def __call__(self, state, batch):
         return self.fn(state, batch)
@@ -214,7 +234,9 @@ class CompiledStep(NamedTuple):
     def init_state(self, params: PyTree, model_state: PyTree = None) -> TrainState:
         """Build a correctly-shaped TrainState for this step (adds the
         per-worker leading axis on error memories in the distributed case)."""
-        return init_train_state(params, self.reducer, model_state, self.num_devices)
+        return init_train_state(
+            params, self.reducer, model_state, self.num_devices, self.optimizer
+        )
 
 
 def _reducer_bits(reducer, params_template: PyTree) -> int:
@@ -235,6 +257,7 @@ def make_train_step(
     mesh: Optional[Mesh] = None,
     axis_name: str = DATA_AXIS,
     donate_state: bool = True,
+    optimizer=None,
 ) -> CompiledStep:
     """Compile the full distributed training step.
 
@@ -247,13 +270,17 @@ def make_train_step(
     """
     if mesh is None:
         body = make_step_fn(
-            loss_fn, reducer, learning_rate, momentum, algorithm, axis_name=None
+            loss_fn, reducer, learning_rate, momentum, algorithm,
+            axis_name=None, optimizer=optimizer,
         )
         fn = jax.jit(body, donate_argnums=(0,) if donate_state else ())
-        return CompiledStep(fn, _reducer_bits(reducer, params_template), None, reducer)
+        return CompiledStep(
+            fn, _reducer_bits(reducer, params_template), None, reducer, optimizer
+        )
 
     body = make_step_fn(
-        loss_fn, reducer, learning_rate, momentum, algorithm, axis_name=axis_name
+        loss_fn, reducer, learning_rate, momentum, algorithm,
+        axis_name=axis_name, optimizer=optimizer,
     )
 
     def sharded_body(state: TrainState, batch):
@@ -284,4 +311,6 @@ def make_train_step(
         out_specs=(state_specs, PartitionSpec()),
     )
     fn = jax.jit(sharded, donate_argnums=(0,) if donate_state else ())
-    return CompiledStep(fn, _reducer_bits(reducer, params_template), mesh, reducer)
+    return CompiledStep(
+        fn, _reducer_bits(reducer, params_template), mesh, reducer, optimizer
+    )

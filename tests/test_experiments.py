@@ -103,3 +103,27 @@ def test_launch_cli(devices):
         ]
     )
     assert out["steps"] == 2
+
+
+def test_imdb_baseline_adamw(devices):
+    out = imdb_baseline.run(
+        _cfg(learning_rate=5e-5, global_batch_size=16),
+        preset="small",
+        max_len=32,
+        max_steps_per_epoch=2,
+        optimizer_name="adamw",  # IMDb_dataset_distributer.py:55-66
+    )
+    assert out["steps"] == 2 and np.isfinite(out["final_loss"])
+    assert out["optimizer"] == "adamw"
+
+
+def test_powersgd_cifar10_eval_accuracy(devices):
+    out = powersgd_cifar10.run(
+        _cfg(global_batch_size=64, reducer_rank=2, training_epochs=2, learning_rate=0.02),
+        preset="small",
+        data_dir="/nonexistent",
+        max_steps_per_epoch=20,
+        eval_after=True,
+    )
+    # synthetic class blobs are very separable; training must beat chance
+    assert out["eval_accuracy"] > 0.2, out
