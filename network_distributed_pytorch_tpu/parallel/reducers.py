@@ -36,7 +36,7 @@ dead ``precalc_numbers`` allocation (``reducer.py:9-12``) and the
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+
 from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax

@@ -27,12 +27,12 @@ unfinished ``bits_communicated`` loop (SURVEY C9: collected, never reported).
 
 from __future__ import annotations
 
-from functools import partial
+
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from jax.sharding import Mesh, PartitionSpec
 
 from .comm import all_reduce_mean
 from .mesh import DATA_AXIS
