@@ -1,0 +1,105 @@
+"""Sequence/context parallelism: ring attention over a mesh axis.
+
+Beyond-parity capability (the reference handles sequence length by tokenizer
+truncation only, ``ddp_powersgd_distillBERT_IMDb/ddp_init.py:75-77`` — SURVEY
+§2.3 marks SP/CP absent). This module makes long sequences a first-class mesh
+axis, the TPU-native way:
+
+- queries, keys and values are sharded along the **sequence** dimension over
+  a ``seq`` mesh axis (``make_mesh(axis_sizes=(dp, sp), axis_names=("data",
+  "seq"))``);
+- K/V blocks rotate around the ring with ``lax.ppermute`` (neighbor ICI hops,
+  never all-to-all), overlapping each hop with the attention compute on the
+  block in hand — the Ring Attention schedule (Liu et al. 2023);
+- softmax is accumulated online, flash-attention style (running max /
+  normalizer / numerator), so the full attention matrix never materializes
+  and the result is EXACT full attention, bit-for-bit up to fp reassociation.
+
+Memory per device drops from O(T²) to O(T·T/N + T·d); max context scales
+linearly with the ring size.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+) -> jax.Array:
+    """Exact multi-head attention with sequence-sharded q/k/v.
+
+    Per-device shapes (inside ``shard_map``):
+      q: (B, Tq, H, D) — this device's query block
+      k, v: (B, Tk, H, D) — this device's key/value block (rotates)
+      mask: (B, Tk) additive mask for the LOCAL key block (0 = attend,
+            -inf = padding); rotates with k/v. None = all tokens attend.
+      causal: apply a global causal mask (token positions are computed from
+              each block's position in the ring).
+
+    Returns (B, Tq, H, D): this device's block of the EXACT full-attention
+    output (online-softmax accumulation over all ring hops).
+    """
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+
+    if mask is None:
+        mask = jnp.zeros((b, tk), jnp.float32)
+
+    q32 = q.astype(jnp.float32)
+    # running (max, normalizer, numerator) per query position/head — marked
+    # device-varying so the fori_loop carry type matches the (varying) updates
+    varying = lambda x: lax.pcast(x, axis_name, to="varying")
+    m0 = varying(jnp.full((b, h, tq, 1), -jnp.inf, jnp.float32))
+    l0 = varying(jnp.zeros((b, h, tq, 1), jnp.float32))
+    acc0 = varying(jnp.zeros((b, h, tq, d), jnp.float32))
+
+    perm = [(i, (i + 1) % n) for i in range(n)]  # ring: pass K/V to the right
+
+    def hop(i, carry):
+        k_blk, v_blk, mask_blk, m, l, acc = carry
+        # the block currently in hand started at device (my_idx - i) mod n
+        src = (my_idx - i) % n
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)
+        ) * scale
+        scores = scores + mask_blk[:, None, None, :]
+        if causal:
+            q_pos = my_idx * tq + jnp.arange(tq)
+            k_pos = src * tk + jnp.arange(tk)
+            allowed = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(allowed[None, None], scores, -jnp.inf)
+
+        blk_max = jnp.max(scores, axis=-1, keepdims=True)
+        new_m = jnp.maximum(m, blk_max)
+        # guard fully-masked rows: exp(-inf - -inf) at new_m=-inf
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        correction = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, -jnp.inf))
+        p = jnp.exp(scores - safe_m)
+        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+        l = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * correction + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        # rotate K/V/mask one hop around the ring (neighbor ICI transfer;
+        # XLA overlaps it with the next hop's einsums)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        mask_blk = lax.ppermute(mask_blk, axis_name, perm)
+        return k_blk, v_blk, mask_blk, new_m, l, acc
+
+    _, _, _, m, l, acc = lax.fori_loop(0, n, hop, (k, v, mask, m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-37)
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
