@@ -41,6 +41,13 @@ class DistilBertConfig:
     attention_dropout: float = 0.1
     num_labels: int = 2
     dtype: Any = jnp.float32
+    # Sequence/context parallelism (beyond-parity; the reference truncates to
+    # 512 tokens instead): name of the mesh axis the sequence dimension is
+    # sharded over. When set, the model must run inside shard_map with
+    # input_ids/attention_mask sharded on that axis; attention becomes ring
+    # attention (parallel.sequence) and positions are ring-offset. LayerNorm,
+    # FFN and embeddings are per-token and need no communication.
+    seq_axis: Any = None
 
 
 class MultiHeadSelfAttention(nn.Module):
@@ -59,12 +66,18 @@ class MultiHeadSelfAttention(nn.Module):
             return t.reshape(t.shape[0], t.shape[1], cfg.n_heads, head_dim)
 
         q, k, v = split(q), split(k), split(v)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(head_dim).astype(cfg.dtype)
-        # additive mask: 0 for real tokens, -inf for padding
-        scores = scores + mask[:, None, None, :]
-        weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
-        weights = nn.Dropout(cfg.attention_dropout)(weights, deterministic=deterministic)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+        if cfg.seq_axis is not None:
+            # sequence-sharded exact attention: K/V ring-rotate over ICI
+            from ..parallel.sequence import ring_attention
+
+            ctx = ring_attention(q, k, v, cfg.seq_axis, mask=mask)
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(head_dim).astype(cfg.dtype)
+            # additive mask: 0 for real tokens, -inf for padding
+            scores = scores + mask[:, None, None, :]
+            weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+            weights = nn.Dropout(cfg.attention_dropout)(weights, deterministic=deterministic)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
         ctx = ctx.reshape(ctx.shape[0], ctx.shape[1], cfg.dim)
         return dense("out_lin")(ctx)
 
@@ -94,6 +107,9 @@ class DistilBertEncoder(nn.Module):
     def __call__(self, input_ids, attention_mask, deterministic: bool = True):
         cfg = self.config
         positions = jnp.arange(input_ids.shape[1])[None, :]
+        if cfg.seq_axis is not None:
+            # global token positions: offset by this device's ring position
+            positions = positions + jax.lax.axis_index(cfg.seq_axis) * input_ids.shape[1]
         x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype, name="word_embeddings")(input_ids)
         x = x + nn.Embed(
             cfg.max_position_embeddings, cfg.dim, dtype=cfg.dtype, name="position_embeddings"
