@@ -8,3 +8,10 @@ small models the test tier needs.
 from .mlp import MLP  # noqa: F401
 from .cnn import SmallCNN  # noqa: F401
 from .resnet import ResNet, resnet18, resnet50, resnet152  # noqa: F401
+from .distilbert import (  # noqa: F401
+    DistilBertConfig,
+    DistilBertEncoder,
+    DistilBertForSequenceClassification,
+    distilbert_base,
+    distilbert_tiny,
+)
