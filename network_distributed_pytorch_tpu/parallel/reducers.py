@@ -56,12 +56,21 @@ class ExactReducer:
     TPU-first improvement over the reference: the reference issues one
     synchronous allreduce **per parameter tensor** (~161 for ResNet-50 — its
     own measured bottleneck); here all leaves are flat-packed so the whole
-    gradient costs ONE collective. Bytes on wire are identical; collective
-    count drops from O(#params) to 1.
+    gradient costs ONE collective by default. Bytes on wire are identical;
+    collective count drops from O(#params) to 1. ``packed=False`` restores
+    the reference's one-collective-per-tensor structure (for the bandwidth
+    study's latency-term comparison).
     """
+
+    def __init__(self, packed: bool = True):
+        self.packed = packed
 
     def init(self, grads_template: PyTree) -> dict:
         return {}
+
+    def n_collectives(self, grads_template: PyTree) -> int:
+        n_leaves = len(jax.tree_util.tree_leaves(grads_template))
+        return 1 if self.packed else n_leaves
 
     def reduce(
         self, state: dict, send: PyTree, axis_name: Optional[str]
@@ -69,13 +78,19 @@ class ExactReducer:
         leaves, treedef = jax.tree_util.tree_flatten(send)
         if not leaves:
             return state, send, send, 0
-        packer = TensorPacker.for_arrays(leaves)
-        flat = packer.pack(leaves)
-        reduced = all_reduce_mean(flat, axis_name)
-        bits = packer.bits()
-        out_leaves = [
-            o.astype(l.dtype) for o, l in zip(packer.unpack(reduced), leaves)
-        ]
+        if self.packed:
+            packer = TensorPacker.for_arrays(leaves)
+            flat = packer.pack(leaves)
+            reduced = all_reduce_mean(flat, axis_name)
+            bits = packer.bits()
+            out_leaves = [
+                o.astype(l.dtype) for o, l in zip(packer.unpack(reduced), leaves)
+            ]
+        else:
+            # reference structure: one allreduce per parameter tensor
+            # (ddp_guide_cifar10/ddp_init.py:57-62)
+            out_leaves = [all_reduce_mean(l, axis_name) for l in leaves]
+            bits = sum(n_bits(l) for l in leaves)
         out = jax.tree_util.tree_unflatten(treedef, out_leaves)
         new_memory = jax.tree_util.tree_map(jnp.zeros_like, send)
         return state, out, new_memory, bits
@@ -126,6 +141,7 @@ class PowerSGDReducer:
         compression_rank: int = 1,
         matricize: str = "first",
         orthogonalize_impl: str = "xla",
+        compression_dtype=None,
     ):
         assert n_power_iterations == 0, "only the fused single power iteration is supported (reducer.py:30)"
         assert matricize in ("first", "last")
@@ -134,6 +150,12 @@ class PowerSGDReducer:
         self.reuse_query = reuse_query
         self.compression_rank = compression_rank
         self.matricize = matricize
+        # Wire dtype for the P/Q/rank-1 payloads. bfloat16 halves bytes-on-
+        # wire on top of the rank-r compression; the quantization error joins
+        # the error-feedback memory, so the EF chain absorbs it (the same
+        # argument the PowerSGD paper makes for rank truncation). None = the
+        # gradients' own dtype (the reference's fp32 behavior).
+        self.compression_dtype = jnp.dtype(compression_dtype) if compression_dtype else None
         if orthogonalize_impl == "pallas":
             # VMEM-resident Gram-Schmidt TPU kernel (ops.pallas_orthogonalize)
             from ..ops.pallas_orthogonalize import orthogonalize_pallas
@@ -177,6 +199,8 @@ class PowerSGDReducer:
     def _packers(self, leaves: Sequence[jax.Array], metas: List[_MatrixMeta]):
         rank1, _ = self._split(leaves)
         dtype = leaves[0].dtype if leaves else jnp.float32
+        if self.compression_dtype is not None:
+            dtype = self.compression_dtype
         p_packer = TensorPacker([(meta.n, meta.r) for meta in metas], dtype=dtype)
         q_packer = TensorPacker([(meta.m, meta.r) for meta in metas], dtype=dtype)
         rank1_packer = TensorPacker([tuple(leaves[i].shape) for i in rank1], dtype=dtype)
@@ -241,7 +265,8 @@ class PowerSGDReducer:
         if ps:
             p_flat = all_reduce_mean(p_packer.pack(ps), axis_name)
             bits += n_bits(p_flat)
-            ps = p_packer.unpack(p_flat)
+            math_dtype = matrices[0].dtype
+            ps = [p.astype(math_dtype) for p in p_packer.unpack(p_flat)]
 
         # Rank-1 tensors: flat-pack and reduce uncompressed. The reference
         # launches this async here to overlap with orthogonalization
@@ -252,7 +277,10 @@ class PowerSGDReducer:
             rank1_flat = rank1_packer.pack([leaves[i] for i in rank1_idx])
             rank1_reduced = all_reduce_mean(rank1_flat, axis_name)
             bits += rank1_packer.bits()
-            rank1_out = rank1_packer.unpack(rank1_reduced)
+            rank1_out = [
+                o.astype(leaves[i].dtype)
+                for i, o in zip(rank1_idx, rank1_packer.unpack(rank1_reduced))
+            ]
 
         # Step 5: P_hat <- ORTHOGONALIZE(P) (reducer.py:135-137)
         ps = [self._orthogonalize(p) for p in ps]
@@ -265,7 +293,7 @@ class PowerSGDReducer:
         if qs:
             q_flat = all_reduce_mean(q_packer.pack(qs), axis_name)
             bits += n_bits(q_flat)
-            qs = q_packer.unpack(q_flat)
+            qs = [q.astype(matrices[0].dtype) for q in q_packer.unpack(q_flat)]
             new_q_memory = q_flat
         else:
             new_q_memory = state.q_memory
