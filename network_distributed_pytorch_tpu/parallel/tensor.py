@@ -1,0 +1,72 @@
+"""Tensor parallelism primitives (beyond-parity; SURVEY §2.3: TP absent from
+the reference, but "the comm layer should be designed so mesh axes beyond
+`data` are possible").
+
+Megatron-style sharded linear layers over a ``model`` mesh axis:
+
+- **column-parallel**: the kernel's OUTPUT features are sharded; each device
+  computes its slice of the activations, no communication (outputs stay
+  feature-sharded).
+- **row-parallel**: the kernel's INPUT features are sharded; each device
+  holds the matching slice of the (feature-sharded) activations, computes a
+  partial product, and ONE ``psum`` restores the replicated result.
+
+A column→row pair (e.g. an MLP's up/down projections, or attention's
+QKV/out projections) therefore costs exactly one allreduce — the standard
+TP recipe, expressed with the same shard_map/psum vocabulary as the data-
+parallel reducers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .comm import all_reduce_sum
+
+MODEL_AXIS = "model"
+
+
+def column_parallel_dense(
+    x: jax.Array,
+    kernel_shard: jax.Array,
+    bias_shard: Optional[jax.Array] = None,
+) -> jax.Array:
+    """x: (..., in) replicated; kernel_shard: (in, out/N) this device's
+    columns. Returns (..., out/N) — feature-sharded, no communication."""
+    y = x @ kernel_shard
+    if bias_shard is not None:
+        y = y + bias_shard
+    return y
+
+
+def row_parallel_dense(
+    x_shard: jax.Array,
+    kernel_shard: jax.Array,
+    bias: Optional[jax.Array] = None,
+    axis_name: str = MODEL_AXIS,
+) -> jax.Array:
+    """x_shard: (..., in/N) feature-sharded; kernel_shard: (in/N, out) this
+    device's rows. ONE psum restores the replicated (..., out)."""
+    partial = x_shard @ kernel_shard
+    y = all_reduce_sum(partial, axis_name)
+    if bias is not None:
+        y = y + bias  # bias added once, post-reduction
+    return y
+
+
+def tp_mlp(
+    x: jax.Array,
+    w_up_shard: jax.Array,
+    b_up_shard: jax.Array,
+    w_down_shard: jax.Array,
+    b_down: jax.Array,
+    axis_name: str = MODEL_AXIS,
+    activation=jax.nn.relu,
+) -> jax.Array:
+    """The canonical TP block: column-parallel up-projection → elementwise
+    activation (local) → row-parallel down-projection (one allreduce)."""
+    h = activation(column_parallel_dense(x, w_up_shard, b_up_shard))
+    return row_parallel_dense(h, w_down_shard, b_down, axis_name)
