@@ -1,4 +1,6 @@
-"""Config, losses, metrics, bandwidth model."""
+"""Config, losses, metrics, bandwidth model, checkpointing, profiling."""
 
 from .losses import cross_entropy_loss  # noqa: F401
 from .config import ExperimentConfig  # noqa: F401
+from .metrics import MetricsLogger, StepRecord  # noqa: F401
+from .bandwidth import allreduce_time_s, bandwidth_table, format_table  # noqa: F401

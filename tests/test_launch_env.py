@@ -1,0 +1,29 @@
+"""Launcher parser: mpirun-style env-var defaults (the reference documents
+the OMPI_COMM_WORLD_* path, ddp_guide/run_script.py:8-22)."""
+
+import os
+
+
+def test_env_var_rank_defaults(monkeypatch):
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "3")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    from network_distributed_pytorch_tpu.launch import build_parser
+
+    args = build_parser().parse_args(["bare_init"])
+    assert args.process_id == 3
+    assert args.num_processes == 4
+    # explicit flags still win
+    args = build_parser().parse_args(["bare_init", "--process-id", "1"])
+    assert args.process_id == 1
+
+
+def test_config_from_args_overrides():
+    from network_distributed_pytorch_tpu.launch import build_parser, config_from_args
+
+    args = build_parser().parse_args(
+        ["powersgd_cifar10", "--lr", "0.01", "--reducer-rank", "8", "--epochs", "2"]
+    )
+    cfg = config_from_args(args)
+    assert cfg.learning_rate == 0.01
+    assert cfg.reducer_rank == 8
+    assert cfg.training_epochs == 2
