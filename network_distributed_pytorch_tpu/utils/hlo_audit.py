@@ -1,0 +1,84 @@
+"""HLO collective audit — honesty check for the bits-on-wire model.
+
+SURVEY §7 flags the hard part: "honest bytes-on-wire accounting when XLA
+fuses collectives — derive from HLO or keep the analytic model". This module
+does BOTH: the framework reports the analytic (reference-equivalent) number,
+and this auditor extracts every collective op and its payload from the
+actually-compiled HLO so tests can assert the two agree (and reveal what the
+all-reduce combiner pass did to the collective count).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List
+
+import numpy as np
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2,
+    "s64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1,
+}
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter", "collective-permute", "all-to-all")
+
+# result type of a collective op: a single typed shape ("f32[1234,8]{1,0}")
+# or — after XLA's all-reduce combiner merges compatible collectives — a
+# TUPLE of typed shapes ("(f32[1106]{0}, f32[])").
+_SHAPE = r"(\w+)\[([\d,]*)\](?:\{[\d,]*\})?"
+_OP_RE = re.compile(
+    r"((?:" + _SHAPE + r")|(?:\([^)]*\)))\s+"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)(?:-start)?\("
+)
+_SHAPE_RE = re.compile(_SHAPE)
+
+
+@dataclass
+class CollectiveOp:
+    kind: str
+    dtype: str
+    shape: tuple
+    payload_bytes: int
+
+
+def audit_hlo(hlo_text: str) -> List[CollectiveOp]:
+    """All collective ops in a compiled HLO module, with payload sizes.
+    A tuple-typed (combiner-merged) collective is reported as ONE op whose
+    payload sums its components."""
+    ops = []
+    for m in _OP_RE.finditer(hlo_text):
+        result_type, kind = m.group(1), m.group(4)
+        payload = 0
+        shapes = []
+        dtypes = []
+        for sm in _SHAPE_RE.finditer(result_type):
+            dtype, dims = sm.group(1), sm.group(2)
+            shape = tuple(int(d) for d in dims.split(",") if d) if dims else ()
+            n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            payload += n * _DTYPE_BYTES.get(dtype, 4)
+            shapes.append(shape)
+            dtypes.append(dtype)
+        ops.append(
+            CollectiveOp(kind, "+".join(dtypes), tuple(shapes), payload)
+        )
+    return ops
+
+
+def collective_summary(hlo_text: str) -> Dict[str, object]:
+    ops = audit_hlo(hlo_text)
+    return {
+        "count": len(ops),
+        "by_kind": {
+            k: sum(1 for o in ops if o.kind == k)
+            for k in sorted({o.kind for o in ops})
+        },
+        "total_payload_bytes": sum(o.payload_bytes for o in ops),
+        "ops": ops,
+    }
+
+
+def compiled_hlo_text(jitted_fn, *example_args) -> str:
+    """The post-optimization HLO XLA actually runs (combiner passes applied)."""
+    compiled = jitted_fn.lower(*example_args).compile()
+    return "\n".join(m.to_string() for m in compiled.runtime_executable().hlo_modules())
