@@ -18,6 +18,25 @@ from typing import Iterator, Sequence, Tuple
 import numpy as np
 
 
+def epoch_order(
+    n: int,
+    batch_size: int,
+    seed: int = 0,
+    epoch: int = 0,
+    shuffle: bool = True,
+    drop_last: bool = True,
+) -> np.ndarray:
+    """The epoch's example order: seeded epoch-keyed shuffle, truncated to
+    whole batches when ``drop_last``. The single source of the framework's
+    batch-order semantics — both the Python iterator below and the native
+    (C++) prefetch loader consume it."""
+    idx = np.arange(n)
+    if shuffle:
+        np.random.RandomState(seed + epoch).shuffle(idx)
+    end = (n // batch_size) * batch_size if drop_last else n
+    return idx[:end]
+
+
 def iterate_batches(
     arrays: Sequence[np.ndarray],
     batch_size: int,
@@ -30,11 +49,8 @@ def iterate_batches(
     n = len(arrays[0])
     for a in arrays:
         assert len(a) == n, "batch arrays must be aligned"
-    idx = np.arange(n)
-    if shuffle:
-        np.random.RandomState(seed + epoch).shuffle(idx)
-    end = (n // batch_size) * batch_size if drop_last else n
-    for start in range(0, end, batch_size):
+    idx = epoch_order(n, batch_size, seed, epoch, shuffle, drop_last)
+    for start in range(0, len(idx), batch_size):
         sel = idx[start : start + batch_size]
         yield tuple(a[sel] for a in arrays)
 

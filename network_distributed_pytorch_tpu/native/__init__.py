@@ -1,0 +1,22 @@
+"""Native (C++) host runtime — build + ctypes bindings.
+
+See ``dataloader.cpp`` for what lives here and why. The library is compiled
+on demand with the in-image ``g++`` (no pybind11 in this environment; plain C
+ABI + ctypes per the build constraints) and cached next to the source. Set
+``NDP_TPU_NO_NATIVE=1`` to force the pure-numpy fallbacks.
+"""
+
+from .build import load_library, native_available
+from .loader import (
+    NativeBatchLoader,
+    decode_cifar10_bin,
+    gather_normalize_u8,
+)
+
+__all__ = [
+    "load_library",
+    "native_available",
+    "NativeBatchLoader",
+    "decode_cifar10_bin",
+    "gather_normalize_u8",
+]

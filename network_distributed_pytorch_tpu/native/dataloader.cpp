@@ -1,0 +1,222 @@
+// Native host-side data runtime.
+//
+// The reference's data layer is `torchvision.datasets.CIFAR10` + torch
+// `DataLoader` (ddp_guide_cifar10/ddp_init.py:42-54): Python orchestration
+// over torchvision/torch *native* decode + collate kernels. This is the
+// TPU-framework equivalent: the per-step host work (index gather, u8→f32
+// normalize, batch assembly) in multithreaded C++, with a prefetching
+// pipeline so batch N+1 is assembled while the TPU runs step N.
+//
+// Exposed as a plain C API consumed via ctypes (no pybind11 in this image).
+//
+// Functions:
+//   ndp_decode_cifar10_bin  — decode the cifar-10-batches-bin record format
+//                             (1 label byte + 3072 CHW bytes) to NHWC float32
+//                             normalized, plus int32 labels.
+//   ndp_gather_normalize_u8 — fused gather+normalize: rows of a uint8 dataset
+//                             selected by an index vector, emitted as float32
+//                             (x/255 - mean)/std. One pass over memory.
+//   ndp_gather_f32/i32      — plain multithreaded row gathers.
+//   ndp_loader_*            — a prefetching batch loader: worker thread
+//                             assembles batches (from a Python-provided epoch
+//                             permutation, preserving the framework's seeded
+//                             shuffle semantics) into a bounded ring buffer.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+// ---------------------------------------------------------------- threading
+static void parallel_for(int64_t n, int n_threads,
+                         const std::function<void(int64_t, int64_t)>& body) {
+  if (n_threads <= 1 || n < 2) {
+    body(0, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk, hi = lo + chunk > n ? n : lo + chunk;
+    if (lo >= hi) break;
+    ts.emplace_back([&body, lo, hi] { body(lo, hi); });
+  }
+  for (auto& t : ts) t.join();
+}
+
+// Thread churn guard: spawning/joining threads costs ~100µs; below this much
+// moved memory a single thread wins (worst case otherwise: 8 threads for a
+// few-hundred-byte label gather).
+static int effective_threads(int64_t work_bytes, int n_threads) {
+  return work_bytes < (int64_t)1 << 18 ? 1 : n_threads;
+}
+
+extern "C" {
+
+// ------------------------------------------------------------------ decode
+// cifar-10-batches-bin record: [label u8][R 32x32][G 32x32][B 32x32].
+// Emits NHWC float32 (x/255 - mean)/std and int32 labels.
+// Normalization matches numpy's float32 op order bit-exactly:
+// ((x / 255.0f) - mean) / std — golden-parity tests assert equality.
+static inline float norm_px(uint8_t v, float mean, float std_) {
+  return ((float)v / 255.0f - mean) / std_;
+}
+
+void ndp_decode_cifar10_bin(const uint8_t* records, int64_t n_records,
+                            float mean, float std_, float* out_images,
+                            int32_t* out_labels, int n_threads) {
+  n_threads = effective_threads(n_records * 3073, n_threads);
+  parallel_for(n_records, n_threads, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const uint8_t* rec = records + i * 3073;
+      out_labels[i] = (int32_t)rec[0];
+      const uint8_t* chw = rec + 1;
+      float* img = out_images + i * 3072;
+      for (int h = 0; h < 32; ++h)
+        for (int w = 0; w < 32; ++w) {
+          int64_t hw = h * 32 + w;
+          float* px = img + hw * 3;
+          px[0] = norm_px(chw[hw], mean, std_);
+          px[1] = norm_px(chw[1024 + hw], mean, std_);
+          px[2] = norm_px(chw[2048 + hw], mean, std_);
+        }
+    }
+  });
+}
+
+// ----------------------------------------------------------------- gathers
+void ndp_gather_normalize_u8(const uint8_t* src, const int64_t* idx,
+                             int64_t n_idx, int64_t row_elems, float mean,
+                             float std_, float* dst, int n_threads) {
+  n_threads = effective_threads(n_idx * row_elems, n_threads);
+  parallel_for(n_idx, n_threads, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const uint8_t* s = src + idx[i] * row_elems;
+      float* d = dst + i * row_elems;
+      for (int64_t j = 0; j < row_elems; ++j)
+        d[j] = norm_px(s[j], mean, std_);
+    }
+  });
+}
+
+void ndp_gather_f32(const float* src, const int64_t* idx, int64_t n_idx,
+                    int64_t row_elems, float* dst, int n_threads) {
+  n_threads = effective_threads(n_idx * row_elems * 4, n_threads);
+  parallel_for(n_idx, n_threads, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i)
+      std::memcpy(dst + i * row_elems, src + idx[i] * row_elems,
+                  row_elems * sizeof(float));
+  });
+}
+
+void ndp_gather_i32(const int32_t* src, const int64_t* idx, int64_t n_idx,
+                    int64_t row_elems, int32_t* dst, int n_threads) {
+  n_threads = effective_threads(n_idx * row_elems * 4, n_threads);
+  parallel_for(n_idx, n_threads, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i)
+      std::memcpy(dst + i * row_elems, src + idx[i] * row_elems,
+                  row_elems * sizeof(int32_t));
+  });
+}
+
+// ------------------------------------------------------------- prefetcher
+// Assembles (x, y) batches on a worker thread into a bounded queue. The
+// dataset stays uint8 (or f32) in place; each batch is gathered (+normalized
+// when u8) by the worker so the consumer only ever copies a ready buffer.
+struct NdpLoader {
+  // dataset (borrowed pointers — Python keeps the arrays alive)
+  const uint8_t* x_u8 = nullptr;  // either u8 (fused normalize) ...
+  const float* x_f32 = nullptr;   // ... or f32 passthrough
+  const int32_t* y = nullptr;
+  int64_t row_elems = 0, y_elems = 0;
+  float mean = 0.f, std_ = 1.f;
+  // epoch order (owned copy)
+  std::vector<int64_t> order;
+  int64_t batch = 0, n_batches = 0, next_emit = 0;
+  int n_threads = 1;
+
+  struct Slot {
+    std::vector<float> x;
+    std::vector<int32_t> y;
+  };
+  std::queue<Slot> ready;
+  size_t depth = 2;
+  std::mutex mu;
+  std::condition_variable cv_space, cv_item;
+  std::atomic<bool> stop{false};
+  std::thread worker;
+
+  void run() {
+    for (int64_t b = 0; b < n_batches && !stop.load(); ++b) {
+      Slot s;
+      s.x.resize(batch * row_elems);
+      s.y.resize(batch * y_elems);
+      const int64_t* idx = order.data() + b * batch;
+      if (x_u8)
+        ndp_gather_normalize_u8(x_u8, idx, batch, row_elems, mean, std_,
+                                s.x.data(), n_threads);
+      else
+        ndp_gather_f32(x_f32, idx, batch, row_elems, s.x.data(), n_threads);
+      ndp_gather_i32(y, idx, batch, y_elems, s.y.data(), n_threads);
+      std::unique_lock<std::mutex> lk(mu);
+      cv_space.wait(lk, [&] { return ready.size() < depth || stop.load(); });
+      if (stop.load()) return;
+      ready.push(std::move(s));
+      cv_item.notify_one();
+    }
+  }
+};
+
+void* ndp_loader_create(const uint8_t* x_u8, const float* x_f32,
+                        const int32_t* y, int64_t row_elems, int64_t y_elems,
+                        float mean, float std_, const int64_t* order,
+                        int64_t n_order, int64_t batch, int64_t depth,
+                        int n_threads) {
+  auto* L = new NdpLoader();
+  L->x_u8 = x_u8;
+  L->x_f32 = x_f32;
+  L->y = y;
+  L->row_elems = row_elems;
+  L->y_elems = y_elems;
+  L->mean = mean;
+  L->std_ = std_;
+  L->order.assign(order, order + n_order);
+  L->batch = batch;
+  L->n_batches = n_order / batch;
+  L->depth = depth < 1 ? 1 : (size_t)depth;
+  L->n_threads = n_threads;
+  L->worker = std::thread([L] { L->run(); });
+  return L;
+}
+
+// Blocks until a batch is ready; copies it out. Returns 1 on success, 0 when
+// the epoch is exhausted.
+int ndp_loader_next(void* loader, float* out_x, int32_t* out_y) {
+  auto* L = (NdpLoader*)loader;
+  if (L->next_emit >= L->n_batches) return 0;
+  std::unique_lock<std::mutex> lk(L->mu);
+  L->cv_item.wait(lk, [&] { return !L->ready.empty(); });
+  NdpLoader::Slot s = std::move(L->ready.front());
+  L->ready.pop();
+  L->cv_space.notify_one();
+  lk.unlock();
+  std::memcpy(out_x, s.x.data(), s.x.size() * sizeof(float));
+  std::memcpy(out_y, s.y.data(), s.y.size() * sizeof(int32_t));
+  L->next_emit++;
+  return 1;
+}
+
+void ndp_loader_destroy(void* loader) {
+  auto* L = (NdpLoader*)loader;
+  L->stop.store(true);
+  L->cv_space.notify_all();
+  if (L->worker.joinable()) L->worker.join();
+  delete L;
+}
+
+}  // extern "C"

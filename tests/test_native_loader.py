@@ -1,0 +1,93 @@
+"""Native (C++) data runtime: exact agreement with the numpy semantics.
+
+The native path must be a pure accelerant — byte-identical outputs to the
+Python reference implementations in ``data/`` (SURVEY.md §4's golden-parity
+test style, applied to our own native layer).
+"""
+
+import numpy as np
+import pytest
+
+from network_distributed_pytorch_tpu.data.loader import iterate_batches
+from network_distributed_pytorch_tpu.native import (
+    NativeBatchLoader,
+    decode_cifar10_bin,
+    gather_normalize_u8,
+    native_available,
+)
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="native library unavailable"
+)
+
+
+def test_native_builds():
+    # g++ is part of the image toolchain; the native runtime must come up.
+    assert native_available()
+
+
+@needs_native
+def test_decode_cifar10_bin_matches_numpy():
+    rng = np.random.RandomState(0)
+    records = rng.randint(0, 256, size=(64, 3073), dtype=np.uint8)
+    images, labels = decode_cifar10_bin(records)
+    assert images.shape == (64, 32, 32, 3) and images.dtype == np.float32
+    np.testing.assert_array_equal(labels, records[:, 0].astype(np.int32))
+    chw = records[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    expect = ((chw.astype(np.float32) / 255.0) - 0.5) / 0.5
+    np.testing.assert_array_equal(images, expect)
+
+
+@needs_native
+def test_gather_normalize_matches_numpy():
+    rng = np.random.RandomState(1)
+    src = rng.randint(0, 256, size=(100, 7, 3), dtype=np.uint8)
+    idx = rng.randint(0, 100, size=33)
+    out = gather_normalize_u8(src, idx, mean=0.4, std=0.25)
+    expect = ((src[idx].astype(np.float32) / 255.0) - 0.4) / 0.25
+    np.testing.assert_array_equal(out, expect)
+
+
+@needs_native
+def test_gather_bounds_checked_like_numpy():
+    src = np.zeros((10, 3), np.uint8)
+    with pytest.raises(IndexError):
+        gather_normalize_u8(src, np.array([0, 10]))
+    with pytest.raises(IndexError):
+        gather_normalize_u8(src, np.array([-1]))
+
+
+@pytest.mark.parametrize("dtype", [np.uint8, np.float32])
+def test_prefetch_loader_matches_iterate_batches(dtype):
+    rng = np.random.RandomState(2)
+    n, batch = 70, 16
+    if dtype == np.uint8:
+        x_store = rng.randint(0, 256, size=(n, 4, 4, 3), dtype=np.uint8)
+        x_ref = ((x_store.astype(np.float32) / 255.0) - 0.5) / 0.5
+    else:
+        x_store = rng.randn(n, 4, 4, 3).astype(np.float32)
+        x_ref = x_store
+    y = rng.randint(0, 10, size=n).astype(np.int32)
+
+    loader = NativeBatchLoader(x_store, y, batch, seed=5)
+    for epoch in range(2):
+        got = list(loader.epoch(epoch))
+        want = list(iterate_batches([x_ref, y], batch, seed=5, epoch=epoch))
+        assert len(got) == len(want) == loader.steps_per_epoch()
+        for (gx, gy), (wx, wy) in zip(got, want):
+            np.testing.assert_allclose(gx, wx, rtol=0, atol=1e-6)
+            np.testing.assert_array_equal(gy, wy)
+
+
+def test_fallback_matches_native(monkeypatch):
+    # With NDP_TPU_NO_NATIVE the loader must produce identical batches.
+    rng = np.random.RandomState(3)
+    x = rng.randint(0, 256, size=(40, 2, 2), dtype=np.uint8)
+    y = rng.randint(0, 5, size=40).astype(np.int32)
+    native = list(NativeBatchLoader(x, y, 8, seed=9).epoch(0))
+    loader = NativeBatchLoader(x, y, 8, seed=9)
+    loader._lib = None  # force the numpy path
+    fallback = list(loader.epoch(0))
+    for (nx, ny), (fx, fy) in zip(native, fallback):
+        np.testing.assert_allclose(nx, fx, rtol=0, atol=1e-6)
+        np.testing.assert_array_equal(ny, fy)
