@@ -91,6 +91,9 @@ class NativeBatchLoader:
     ):
         assert len(x) == len(y), "batch arrays must be aligned"
         assert x.dtype in (np.uint8, np.float32), x.dtype
+        assert np.issubdtype(y.dtype, np.integer), (
+            f"labels must be integer (classification targets), got {y.dtype}"
+        )
         self._x = np.ascontiguousarray(x)
         self._y = np.ascontiguousarray(
             y.reshape(len(y), -1) if y.ndim > 1 else y[:, None], np.int32

@@ -13,6 +13,12 @@ from .comm import (  # noqa: F401
     all_reduce_sum,
     all_reduce_mean,
     all_gather,
+    all_gather_replicated,
 )
 from .packing import TensorPacker  # noqa: F401
 from .reducers import ExactReducer, PowerSGDReducer  # noqa: F401
+from .compression import (  # noqa: F401
+    TopKReducer,
+    SignSGDReducer,
+    QSGDReducer,
+)

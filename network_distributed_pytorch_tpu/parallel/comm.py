@@ -60,6 +60,25 @@ def all_gather(x: jax.Array, axis_name: Optional[str]) -> jax.Array:
     return jax.lax.all_gather(x, axis_name)
 
 
+def all_gather_replicated(x: jax.Array, axis_name: Optional[str]) -> jax.Array:
+    """``all_gather`` whose output is typed **replicated** (invariant) over the
+    mesh axis, not varying.
+
+    The gathered value is mathematically identical on every worker either way;
+    this variant tells shard_map's replication checker so, which lets reducers
+    built on gathers (top-k / sign / int8 payload exchange) feed the trainer's
+    replicated ``params``/``momenta`` out_specs without a spurious
+    re-synchronizing psum. Wire cost is identical to ``all_gather``.
+    """
+    if axis_name is None:
+        return x[None]
+    try:
+        from jax.lax import all_gather_invariant  # newer jax exports it
+    except ImportError:
+        from jax._src.lax.parallel import all_gather_invariant
+    return all_gather_invariant(x, axis_name)
+
+
 def axis_size(axis_name: Optional[str]) -> int:
     """World size along the collective axis; 1 outside any mesh (the
     reference's ``n_workers=1`` fallback, ``reducer.py:13-18``). Static."""

@@ -1,0 +1,273 @@
+"""L3 — additional gradient compressors: top-k, 1-bit sign, int8 quantization.
+
+Beyond-parity capability. The reference implements exactly one compressed
+reduction — PowerSGD rank-r (``reducer.py:26-170``) — but its architecture
+(hand-rolled gradient sync so compression is pluggable, SURVEY §2.3) exists
+precisely so other compressors can slot in. These are the other three classic
+points on the bandwidth/fidelity curve from the gradient-compression
+literature, under the same pure-functional reducer interface::
+
+    state, out, new_memory, bits = reducer.reduce(state, send, axis_name)
+
+All three pair with ``algorithm="ef_momentum"`` (PowerSGD Algorithm 2): the
+compression residual lands in the error-feedback memory, exactly as the
+PowerSGD rank-truncation residual does.
+
+Honest wire accounting: each compressor communicates its *actual* compressed
+payload (bit-packed signs ride as uint8 bitmaps, quantized gradients as int8,
+sparse values+indices as fp32+int32) via ``all_gather`` — never a widened
+psum that would silently restore full bandwidth. Bits are counted per
+collective as the LOCAL payload size, the reference's convention for gathers
+(``tensor_buffer.py:44-45,50-57``).
+
+Unlike PowerSGD there is no rank-1/high-rank split (``reducer.py:53-62``) —
+that split exists because rank-r factorization needs matrices; element-wise
+compressors apply uniformly, so the whole gradient rides one flat buffer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .comm import all_gather_replicated as all_gather
+from .packing import TensorPacker
+
+PyTree = Any
+
+
+def _flatten(send: PyTree):
+    leaves, treedef = jax.tree_util.tree_flatten(send)
+    packer = TensorPacker.for_arrays(leaves)
+    return leaves, treedef, packer, packer.pack(leaves)
+
+
+def _per_leaf_mean(
+    gathered_payload: jax.Array,  # (W, n) decoded per-worker contributions
+    per_worker_scales: jax.Array,  # (W, L) per-leaf scales
+    packer: TensorPacker,
+) -> List[jax.Array]:
+    """mean over workers of ``scale[w, leaf] * payload[w, elements-of-leaf]``,
+    computed leaf-by-leaf so no (W, n) fp32 scale matrix materializes."""
+    w = gathered_payload.shape[0]
+    out = []
+    for t, (s, e, shape) in enumerate(packer.slices()):
+        block = gathered_payload[:, s:e].astype(jnp.float32)
+        leaf = jnp.einsum("w,we->e", per_worker_scales[:, t], block) / w
+        out.append(leaf.reshape(shape))
+    return out
+
+
+class TopKReducer:
+    """Top-k gradient sparsification with error feedback.
+
+    Each worker keeps the ``k`` largest-magnitude elements of its (flat-packed)
+    send buffer, exchanges ``(values, indices)`` with one ``all_gather`` each,
+    and averages the scattered contributions. Everything not sent stays in the
+    error memory and re-enters next step's send (Algorithm-2 chain, same as
+    PowerSGD's residual — ``ddp_powersgd_guide_cifar10/ddp_init.py:156-163``).
+
+    ``k_fraction`` is the kept fraction of ALL gradient elements (k computed
+    statically at trace time). Wire cost: k·(32 + 32) bits per step
+    (fp32 values + int32 indices).
+    """
+
+    def __init__(self, k_fraction: float = 0.01, min_k: int = 1):
+        assert 0.0 < k_fraction <= 1.0
+        self.k_fraction = k_fraction
+        self.min_k = min_k
+
+    def _k(self, total: int) -> int:
+        return max(self.min_k, min(total, int(round(self.k_fraction * total))))
+
+    def init(self, grads_template: PyTree) -> dict:
+        return {}
+
+    def reduce(
+        self, state: dict, send: PyTree, axis_name: Optional[str]
+    ) -> Tuple[dict, PyTree, PyTree, int]:
+        leaves, treedef, packer, flat = _flatten(send)
+        n = packer.total_size
+        k = self._k(n)
+
+        _, idx = jax.lax.top_k(jnp.abs(flat), k)
+        vals = jnp.take(flat, idx)
+
+        vals_all = all_gather(vals, axis_name)  # (W, k)
+        idx_all = all_gather(idx, axis_name)    # (W, k)
+        w = vals_all.shape[0]
+        # fresh zeros (not zeros_like(flat)): the scatter target must be
+        # replicated-typed so the output of the gathered scatter is too
+        out_flat = (
+            jnp.zeros(flat.shape, flat.dtype)
+            .at[idx_all.reshape(-1)]
+            .add(vals_all.reshape(-1))
+            / w
+        )
+        local = jnp.zeros_like(flat).at[idx].set(vals)
+        mem_flat = flat - local
+
+        out = jax.tree_util.tree_unflatten(treedef, [
+            o.astype(l.dtype) for o, l in zip(packer.unpack(out_flat), leaves)
+        ])
+        new_memory = jax.tree_util.tree_unflatten(treedef, [
+            m.astype(l.dtype) for m, l in zip(packer.unpack(mem_flat), leaves)
+        ])
+        bits = k * (32 + 32)
+        return state, out, new_memory, bits
+
+    def bits_per_step(self, grads_template: PyTree) -> int:
+        leaves = jax.tree_util.tree_leaves(grads_template)
+        total = sum(int(l.size) for l in leaves)
+        return self._k(total) * (32 + 32)
+
+
+class SignSGDReducer:
+    """1-bit sign compression with per-tensor scale and error feedback
+    (EF-signSGD, Karimireddy et al. 2019).
+
+    Each worker sends ``sign(send)`` bit-packed 8-per-byte as a uint8 bitmap
+    plus one fp32 scale ``mean(|leaf|)`` per tensor; contributions decode to
+    ``scale · sign`` and are averaged. Wire cost: 1 bit per gradient element
+    (rounded up to whole bytes) + 32 bits per tensor — a 32× reduction, the
+    densest point on the compression curve.
+
+    The bitmap genuinely rides the wire as uint8 (gather, never a widened
+    psum), so the accounting is honest under the HLO audit.
+    """
+
+    def __init__(self):
+        pass
+
+    def init(self, grads_template: PyTree) -> dict:
+        return {}
+
+    @staticmethod
+    def _pack_bits(positive: jax.Array) -> jax.Array:
+        """(n,) bool → (ceil(n/8),) uint8, little-endian bit order."""
+        n = positive.shape[0]
+        nb = -(-n // 8)
+        padded = jnp.zeros((nb * 8,), jnp.uint8).at[:n].set(positive.astype(jnp.uint8))
+        weights = (1 << jnp.arange(8, dtype=jnp.uint8)).astype(jnp.uint8)
+        return jnp.sum(
+            padded.reshape(nb, 8).astype(jnp.int32) * weights.astype(jnp.int32), axis=1
+        ).astype(jnp.uint8)
+
+    @staticmethod
+    def _unpack_signs(bitmap: jax.Array, n: int) -> jax.Array:
+        """(..., nb) uint8 → (..., n) int8 in {−1, +1}."""
+        bits = (bitmap[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+        bits = bits.reshape(*bitmap.shape[:-1], -1)[..., :n]
+        return (2 * bits.astype(jnp.int8) - 1).astype(jnp.int8)
+
+    def reduce(
+        self, state: dict, send: PyTree, axis_name: Optional[str]
+    ) -> Tuple[dict, PyTree, PyTree, int]:
+        leaves, treedef, packer, flat = _flatten(send)
+        n = packer.total_size
+
+        scales = jnp.stack([jnp.mean(jnp.abs(l)) for l in leaves])  # (L,)
+        bitmap = self._pack_bits(flat >= 0)
+
+        bitmap_all = all_gather(bitmap, axis_name)  # (W, nb) uint8
+        scales_all = all_gather(scales, axis_name)  # (W, L) fp32
+        signs_all = self._unpack_signs(bitmap_all, n)  # (W, n) int8
+
+        out_leaves = _per_leaf_mean(signs_all, scales_all, packer)
+
+        # this worker's own contribution, for the EF residual
+        local_signs = self._unpack_signs(bitmap, n).astype(jnp.float32)
+        mem_leaves = []
+        for t, ((s, e, _), sl, leaf) in enumerate(
+            zip(packer.slices(), packer.unpack(flat), leaves)
+        ):
+            local = (scales[t] * local_signs[s:e]).reshape(leaf.shape)
+            mem_leaves.append((sl.reshape(leaf.shape) - local).astype(leaf.dtype))
+
+        out = jax.tree_util.tree_unflatten(
+            treedef, [o.astype(l.dtype) for o, l in zip(out_leaves, leaves)]
+        )
+        new_memory = jax.tree_util.tree_unflatten(treedef, mem_leaves)
+        bits = 8 * int(-(-n // 8)) + 32 * len(leaves)
+        return state, out, new_memory, bits
+
+    def bits_per_step(self, grads_template: PyTree) -> int:
+        leaves = jax.tree_util.tree_leaves(grads_template)
+        n = sum(int(l.size) for l in leaves)
+        return 8 * (-(-n // 8)) + 32 * len(leaves)
+
+
+class QSGDState(NamedTuple):
+    key: jax.Array
+
+
+class QSGDReducer:
+    """Stochastic int8 uniform quantization with error feedback (QSGD-style,
+    Alistarh et al. 2017, at the s=127 operating point).
+
+    Per tensor: scale = max|x|/127; each element is stochastically rounded to
+    an int8 level (unbiased: E[q·scale] = x), int8 payloads + fp32 scales ride
+    one ``all_gather`` each, contributions dequantize and average. Stochastic
+    rounding noise and clip residue land in the EF memory. Wire cost: 8 bits
+    per element + 32 per tensor — 4× under fp32, with far better fidelity than
+    1-bit sign.
+    """
+
+    def __init__(self, random_seed: int = 714, stochastic: bool = True):
+        self.random_seed = random_seed
+        self.stochastic = stochastic
+
+    def init(self, grads_template: PyTree) -> QSGDState:
+        return QSGDState(key=jax.random.PRNGKey(self.random_seed))
+
+    def reduce(
+        self, state: QSGDState, send: PyTree, axis_name: Optional[str]
+    ) -> Tuple[QSGDState, PyTree, PyTree, int]:
+        leaves, treedef, packer, flat = _flatten(send)
+        n = packer.total_size
+
+        maxabs = jnp.stack([jnp.max(jnp.abs(l)) for l in leaves])
+        scales = jnp.where(maxabs > 0, maxabs / 127.0, 1.0)  # (L,)
+        inv = jnp.concatenate([
+            jnp.full((int(l.size),), 1.0, jnp.float32) / scales[t]
+            for t, l in enumerate(leaves)
+        ])
+        levels = flat.astype(jnp.float32) * inv
+
+        key = state.key
+        if self.stochastic:
+            key, sub = jax.random.split(key)
+            # decorrelate rounding noise across workers without communication
+            if axis_name is not None:
+                sub = jax.random.fold_in(sub, jax.lax.axis_index(axis_name))
+            noise = jax.random.uniform(sub, levels.shape)
+            q = jnp.floor(levels + noise)
+        else:
+            q = jnp.round(levels)
+        q = jnp.clip(q, -127, 127).astype(jnp.int8)
+
+        q_all = all_gather(q, axis_name)          # (W, n) int8
+        scales_all = all_gather(scales, axis_name)  # (W, L) fp32
+
+        out_leaves = _per_leaf_mean(q_all, scales_all, packer)
+
+        mem_leaves = []
+        for t, ((s, e, _), sl, leaf) in enumerate(
+            zip(packer.slices(), packer.unpack(flat), leaves)
+        ):
+            local = (scales[t] * q[s:e].astype(jnp.float32)).reshape(leaf.shape)
+            mem_leaves.append((sl.reshape(leaf.shape) - local).astype(leaf.dtype))
+
+        out = jax.tree_util.tree_unflatten(
+            treedef, [o.astype(l.dtype) for o, l in zip(out_leaves, leaves)]
+        )
+        new_memory = jax.tree_util.tree_unflatten(treedef, mem_leaves)
+        bits = 8 * n + 32 * len(leaves)
+        return QSGDState(key=key), out, new_memory, bits
+
+    def bits_per_step(self, grads_template: PyTree) -> int:
+        leaves = jax.tree_util.tree_leaves(grads_template)
+        n = sum(int(l.size) for l in leaves)
+        return 8 * n + 32 * len(leaves)

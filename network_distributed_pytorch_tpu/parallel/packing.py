@@ -61,6 +61,12 @@ class TensorPacker:
             for s, e, shape in zip(self._start_idx, self._end_idx, self.shapes)
         ]
 
+    def slices(self) -> List[Tuple[int, int, Tuple[int, ...]]]:
+        """Per-leaf ``(start, end, shape)`` layout triples — the public face of
+        the index bookkeeping, for code that operates on sub-ranges of the
+        flat buffer without unpacking it."""
+        return list(zip(self._start_idx, self._end_idx, self.shapes))
+
     def bits(self) -> int:
         """``8 * nelement * element_size`` (``tensor_buffer.py:44-45``). Static."""
         return 8 * self.total_size * self.dtype.itemsize

@@ -1,0 +1,278 @@
+"""Top-k / sign / int8 compressors: NumPy oracles on the single-process path,
+real 8-device gather path, EF-chain training, and bits accounting."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from network_distributed_pytorch_tpu.parallel import DATA_AXIS, make_mesh
+from network_distributed_pytorch_tpu.parallel.compression import (
+    QSGDReducer,
+    SignSGDReducer,
+    TopKReducer,
+)
+
+W = 8
+
+
+def _leaves(seed):
+    rng = np.random.RandomState(seed)
+    return [
+        jnp.asarray(rng.randn(4, 3, 2), jnp.float32),
+        jnp.asarray(rng.randn(5, 4), jnp.float32),
+        jnp.asarray(rng.randn(7), jnp.float32),
+    ]
+
+
+def _np(leaves):
+    return [np.asarray(l) for l in leaves]
+
+
+def _run_multiworker(reducer, sends_per_worker, n_leaves):
+    """Run reducer.reduce inside shard_map on the 8-device mesh; returns
+    per-device (out, mem) stacked on axis 0."""
+    mesh = make_mesh()
+    state = reducer.init(sends_per_worker[0])
+    stacked = [
+        jnp.stack([w[i] for w in sends_per_worker]) for i in range(n_leaves)
+    ]
+
+    def f(*send):
+        send = [s[0] for s in send]
+        _, out, mem, _ = reducer.reduce(state, send, DATA_AXIS)
+        return [o[None] for o in out], [m[None] for m in mem]
+
+    out, mem = jax.jit(
+        jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS),) * n_leaves,
+            out_specs=([P(DATA_AXIS)] * n_leaves, [P(DATA_AXIS)] * n_leaves),
+        )
+    )(*stacked)
+    return out, mem
+
+
+# ---------------------------------------------------------------- top-k
+
+
+def test_topk_full_k_is_identity():
+    reducer = TopKReducer(k_fraction=1.0)
+    send = _leaves(0)
+    _, out, mem, bits = reducer.reduce({}, send, None)
+    total = sum(l.size for l in send)
+    assert bits == total * 64
+    for s, o, m in zip(send, out, mem):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(s), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(m), 0.0)
+
+
+def _topk_oracle(sends_np, k):
+    """Per-worker top-k scatter on the flat concat, then mean."""
+    flats = [np.concatenate([l.ravel() for l in s]) for s in sends_np]
+    n = flats[0].size
+    locals_ = []
+    for f in flats:
+        idx = np.argsort(-np.abs(f), kind="stable")[:k]
+        loc = np.zeros(n, np.float32)
+        loc[idx] = f[idx]
+        locals_.append(loc)
+    mean = np.mean(locals_, axis=0)
+    return locals_, mean
+
+
+def _unflatten(flat, template):
+    out, off = [], 0
+    for l in template:
+        out.append(flat[off : off + l.size].reshape(l.shape))
+        off += l.size
+    return out
+
+
+def test_topk_single_worker_oracle():
+    send = _leaves(3)
+    n = sum(l.size for l in send)
+    reducer = TopKReducer(k_fraction=0.25)
+    k = reducer._k(n)
+    locals_, mean = _topk_oracle([_np(send)], k)
+    _, out, mem, bits = reducer.reduce({}, send, None)
+    assert bits == k * 64 == reducer.bits_per_step(send)
+    for o, e in zip(out, _unflatten(mean, _np(send))):
+        np.testing.assert_allclose(np.asarray(o), e, rtol=1e-5, atol=1e-6)
+    # EF residual: send - own selection
+    for m, s, e in zip(mem, _np(send), _unflatten(locals_[0], _np(send))):
+        np.testing.assert_allclose(np.asarray(m), s - e, rtol=1e-5, atol=1e-6)
+
+
+def test_topk_multiworker_mean(devices):
+    sends = [_leaves(100 + w) for w in range(W)]
+    n = sum(l.size for l in sends[0])
+    reducer = TopKReducer(k_fraction=0.2)
+    k = reducer._k(n)
+    locals_, mean = _topk_oracle([_np(s) for s in sends], k)
+    out, mem = _run_multiworker(reducer, sends, 3)
+    expected = _unflatten(mean, _np(sends[0]))
+    for i in range(3):
+        for d in range(W):
+            np.testing.assert_allclose(
+                np.asarray(out[i])[d], expected[i], rtol=1e-5, atol=1e-6
+            )
+
+
+# ---------------------------------------------------------------- sign
+
+
+def test_sign_bitpack_roundtrip():
+    rng = np.random.RandomState(0)
+    for n in (1, 7, 8, 9, 64, 100):
+        bools = jnp.asarray(rng.rand(n) > 0.5)
+        bitmap = SignSGDReducer._pack_bits(bools)
+        assert bitmap.dtype == jnp.uint8 and bitmap.shape == (-(-n // 8),)
+        signs = SignSGDReducer._unpack_signs(bitmap, n)
+        np.testing.assert_array_equal(
+            np.asarray(signs), np.where(np.asarray(bools), 1, -1)
+        )
+
+
+def _sign_oracle(sends_np):
+    contribs = []
+    for s in sends_np:
+        contribs.append(
+            [np.mean(np.abs(l)) * np.where(l >= 0, 1.0, -1.0) for l in s]
+        )
+    mean = [np.mean([c[i] for c in contribs], axis=0) for i in range(len(sends_np[0]))]
+    return contribs, mean
+
+
+def test_sign_single_worker_oracle():
+    send = _leaves(5)
+    reducer = SignSGDReducer()
+    contribs, mean = _sign_oracle([_np(send)])
+    _, out, mem, bits = reducer.reduce({}, send, None)
+    n = sum(l.size for l in send)
+    assert bits == 8 * (-(-n // 8)) + 32 * 3 == reducer.bits_per_step(send)
+    for o, e in zip(out, mean):
+        np.testing.assert_allclose(np.asarray(o), e, rtol=1e-5, atol=1e-6)
+    for m, s, c in zip(mem, _np(send), contribs[0]):
+        np.testing.assert_allclose(np.asarray(m), s - c, rtol=1e-5, atol=1e-6)
+
+
+def test_sign_multiworker_mean(devices):
+    sends = [_leaves(200 + w) for w in range(W)]
+    _, mean = _sign_oracle([_np(s) for s in sends])
+    out, _ = _run_multiworker(SignSGDReducer(), sends, 3)
+    for i in range(3):
+        for d in range(W):
+            np.testing.assert_allclose(
+                np.asarray(out[i])[d], mean[i], rtol=1e-5, atol=1e-6
+            )
+
+
+# ---------------------------------------------------------------- qsgd
+
+
+def _qsgd_oracle(sends_np):
+    contribs = []
+    for s in sends_np:
+        per = []
+        for l in s:
+            scale = np.max(np.abs(l)) / 127.0 if np.max(np.abs(l)) > 0 else 1.0
+            q = np.clip(np.round(l / scale), -127, 127)
+            per.append((scale * q).astype(np.float32))
+        contribs.append(per)
+    mean = [np.mean([c[i] for c in contribs], axis=0) for i in range(len(sends_np[0]))]
+    return contribs, mean
+
+
+def test_qsgd_deterministic_oracle():
+    send = _leaves(9)
+    reducer = QSGDReducer(stochastic=False)
+    state = reducer.init(send)
+    contribs, mean = _qsgd_oracle([_np(send)])
+    _, out, mem, bits = reducer.reduce(state, send, None)
+    n = sum(l.size for l in send)
+    assert bits == 8 * n + 32 * 3 == reducer.bits_per_step(send)
+    for o, e in zip(out, mean):
+        np.testing.assert_allclose(np.asarray(o), e, rtol=1e-5, atol=1e-6)
+    for m, s, c in zip(mem, _np(send), contribs[0]):
+        np.testing.assert_allclose(np.asarray(m), s - c, rtol=1e-5, atol=1e-6)
+
+
+def test_qsgd_multiworker_mean(devices):
+    sends = [_leaves(300 + w) for w in range(W)]
+    _, mean = _qsgd_oracle([_np(s) for s in sends])
+    out, _ = _run_multiworker(QSGDReducer(stochastic=False), sends, 3)
+    for i in range(3):
+        for d in range(W):
+            np.testing.assert_allclose(
+                np.asarray(out[i])[d], mean[i], rtol=1e-5, atol=2e-6
+            )
+
+
+def test_qsgd_stochastic_is_unbiased():
+    # E[dequant] == send: average many independent stochastic roundings
+    send = [jnp.asarray(np.random.RandomState(1).randn(64), np.float32)]
+    outs = []
+    for seed in range(200):
+        reducer = QSGDReducer(random_seed=seed, stochastic=True)
+        _, out, _, _ = reducer.reduce(reducer.init(send), send, None)
+        outs.append(np.asarray(out[0]))
+    scale = np.max(np.abs(np.asarray(send[0]))) / 127.0
+    np.testing.assert_allclose(
+        np.mean(outs, axis=0), np.asarray(send[0]), atol=3 * scale / np.sqrt(200)
+    )
+
+
+# ------------------------------------------------------- bits + training
+
+
+def test_compression_bits_ladder():
+    template = [jnp.zeros((256, 64)), jnp.zeros((64,))]
+    exact = 32 * (256 * 64 + 64)
+    sign = SignSGDReducer().bits_per_step(template)
+    qsgd = QSGDReducer().bits_per_step(template)
+    topk = TopKReducer(k_fraction=0.01).bits_per_step(template)
+    assert topk < sign < qsgd < exact  # 1% top-k at 64 bits/kept < 1 bit/elem
+    assert sign < exact / 30  # ~32x compression
+    assert qsgd < exact / 3.9  # ~4x
+
+
+@pytest.mark.parametrize(
+    "reducer",
+    [TopKReducer(k_fraction=0.1), SignSGDReducer(), QSGDReducer(random_seed=1)],
+    ids=["topk", "sign", "qsgd"],
+)
+def test_compressors_train_ef_momentum(devices, reducer):
+    """Each compressor plugged into the Algorithm-2 trainer on the 8-device
+    mesh: loss on a toy regression decreases."""
+    from network_distributed_pytorch_tpu.parallel.trainer import (
+        make_train_step,
+        stateless_loss,
+    )
+
+    mesh = make_mesh()
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(16, 4).astype(np.float32)
+    x = rng.randn(64, 16).astype(np.float32)
+    y = x @ w_true
+    params = {"w": jnp.zeros((16, 4)), "b": jnp.zeros((4,))}
+
+    def loss(params, batch):
+        xb, yb = batch
+        pred = xb @ params["w"] + params["b"]
+        return jnp.mean((pred - yb) ** 2)
+
+    step = make_train_step(
+        stateless_loss(loss), reducer, params, learning_rate=0.05,
+        momentum=0.9, algorithm="ef_momentum", mesh=mesh, donate_state=False,
+    )
+    state = step.init_state(params)
+    batch = (jnp.asarray(x), jnp.asarray(y))
+    losses = []
+    for _ in range(30):
+        state, l = step(state, batch)
+        losses.append(float(l))
+    assert losses[-1] < 0.2 * losses[0], losses
+    assert step.bits_per_step == reducer.bits_per_step(params)

@@ -88,6 +88,7 @@ def test_fallback_matches_native(monkeypatch):
     loader = NativeBatchLoader(x, y, 8, seed=9)
     loader._lib = None  # force the numpy path
     fallback = list(loader.epoch(0))
+    assert len(native) == len(fallback) == 5  # 40 // 8
     for (nx, ny), (fx, fy) in zip(native, fallback):
         np.testing.assert_allclose(nx, fx, rtol=0, atol=1e-6)
         np.testing.assert_array_equal(ny, fy)
