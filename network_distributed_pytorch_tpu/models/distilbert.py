@@ -48,6 +48,10 @@ class DistilBertConfig:
     # attention (parallel.sequence) and positions are ring-offset. LayerNorm,
     # FFN and embeddings are per-token and need no communication.
     seq_axis: Any = None
+    # Which sequence-parallel attention schedule to use when seq_axis is set:
+    # "ring" (K/V ppermute rotation, neighbor ICI hops) or "ulysses"
+    # (head<->sequence all_to_all, 4 collectives; needs n_heads % shards == 0).
+    seq_impl: str = "ring"
 
 
 class MultiHeadSelfAttention(nn.Module):
@@ -67,10 +71,17 @@ class MultiHeadSelfAttention(nn.Module):
 
         q, k, v = split(q), split(k), split(v)
         if cfg.seq_axis is not None:
-            # sequence-sharded exact attention: K/V ring-rotate over ICI
-            from ..parallel.sequence import ring_attention
+            # sequence-sharded exact attention: K/V ring-rotate over ICI, or
+            # Ulysses head<->sequence all_to_all
+            from ..parallel.sequence import ring_attention, ulysses_attention
 
-            ctx = ring_attention(q, k, v, cfg.seq_axis, mask=mask)
+            impls = {"ring": ring_attention, "ulysses": ulysses_attention}
+            if cfg.seq_impl not in impls:
+                raise ValueError(
+                    f"DistilBertConfig.seq_impl={cfg.seq_impl!r}: valid values"
+                    f" are {sorted(impls)}"
+                )
+            ctx = impls[cfg.seq_impl](q, k, v, cfg.seq_axis, mask=mask)
         else:
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(head_dim).astype(cfg.dtype)
             # additive mask: 0 for real tokens, -inf for padding

@@ -103,3 +103,68 @@ def ring_attention(
     _, _, _, m, l, acc = lax.fori_loop(0, n, hop, (k, v, mask, m0, l0, acc0))
     out = acc / jnp.maximum(l, 1e-37)
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+) -> jax.Array:
+    """Exact attention with sequence-sharded q/k/v via head↔sequence
+    all-to-all (the DeepSpeed-Ulysses schedule, Jacobs et al. 2023).
+
+    The dual of :func:`ring_attention`: instead of rotating K/V blocks N−1
+    times, ONE ``all_to_all`` per tensor re-shards from sequence-split to
+    head-split, each device runs plain full attention for its ``H/N`` heads
+    over the whole sequence, and one ``all_to_all`` brings the output back to
+    sequence-split. 4 all-to-alls (plus one small mask all-gather when a mask
+    is given), each moving ``(N−1)/N`` of one
+    activation — better for meshes where all-to-all bandwidth is plentiful
+    (single TPU pod slice) and ring latency would dominate; ring wins when
+    only neighbor ICI links are fast. Requires ``n_heads % N == 0``.
+
+    Per-device shapes (inside ``shard_map``): q/k/v ``(B, T/N, H, D)``,
+    mask ``(B, T/N)`` additive for the local block. Returns ``(B, T/N, H, D)``
+    — this device's block of the exact full-attention output.
+    """
+    n = lax.axis_size(axis_name)
+    b, t_loc, h, d = q.shape
+    assert h % n == 0, f"n_heads={h} must divide over {n} sequence shards"
+    t = t_loc * n
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+
+    # seq-sharded -> head-sharded: (B, T/N, H, D) -> (B, T, H/N, D)
+    to_heads = lambda x: lax.all_to_all(
+        x, axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+
+    if mask is None:
+        bias = jnp.zeros((b, t), jnp.float32)
+    else:
+        # (B, T/N) -> (B, T), shard-major — matches the all_to_all ordering
+        bias = lax.all_gather(mask, axis_name, axis=1, tiled=True).astype(jnp.float32)
+
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", qh.astype(jnp.float32), kh.astype(jnp.float32)
+    ) * scale
+    scores = scores + bias[:, None, None, :]
+    if causal:
+        pos = jnp.arange(t)
+        scores = jnp.where(pos[:, None] >= pos[None, :], scores, -jnp.inf)
+
+    # softmax with fully-masked-row guard (same guard as ring_attention)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(scores - safe_m)
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", p, vh.astype(jnp.float32))
+    ctx = ctx / jnp.maximum(jnp.sum(p, axis=-1)[..., None].swapaxes(1, 2), 1e-37)
+
+    # head-sharded -> seq-sharded: (B, T, H/N, D) -> (B, T/N, H, D)
+    return lax.all_to_all(
+        ctx.astype(q.dtype), axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
