@@ -22,3 +22,13 @@ from .compression import (  # noqa: F401
     SignSGDReducer,
     QSGDReducer,
 )
+from .pipeline import (  # noqa: F401
+    make_pipeline_fn,
+    pipeline_apply,
+    stacked_stage_params,
+)
+from .moe import (  # noqa: F401
+    MoEOutput,
+    stacked_expert_params,
+    switch_moe,
+)

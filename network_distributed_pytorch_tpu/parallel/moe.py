@@ -1,0 +1,130 @@
+"""Expert parallelism: Switch-style top-1 MoE with all-to-all token dispatch.
+
+Beyond-parity capability (SURVEY §2.3: EP/MoE absent from the reference).
+TPU-native design:
+
+- experts live on an ``expert`` mesh axis: device i holds only its
+  ``E/N`` experts' parameters (stacked expert params sharded on the leading
+  axis) — model memory scales with the mesh;
+- routing is the Mesh-TF/Switch dispatch-mask formulation: one-hot dispatch
+  tensors and einsums, so the whole layer is static-shaped and jit-compiles
+  (capacity-bounded; over-capacity tokens fall through on the residual path,
+  standard Switch behavior);
+- tokens physically move with TWO ``lax.all_to_all`` hops (to experts and
+  back) — the TPU equivalent of the NCCL all-to-all an EP framework would
+  use, riding ICI;
+- returns the standard load-balancing auxiliary loss
+  (``E · Σ_e fraction_e · prob_e``, Switch Transformer eq. 4) so trainers can
+  regularize routing collapse.
+
+Composes with the data axis the usual way: tokens are sharded over the SAME
+devices that hold the experts (one mesh axis serves as both the token-batch
+and expert shard axis).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+
+class MoEOutput(NamedTuple):
+    out: jax.Array          # (T, D) combined expert outputs (0 for dropped)
+    aux_loss: jax.Array     # scalar load-balance loss (Switch eq. 4)
+    dropped_fraction: jax.Array  # scalar: tokens over capacity
+
+
+def switch_moe(
+    x: jax.Array,
+    router_kernel: jax.Array,
+    expert_params: PyTree,
+    expert_fn: Callable[[PyTree, jax.Array], jax.Array],
+    axis_name: Optional[str],
+    capacity: int,
+) -> MoEOutput:
+    """Top-1 routed mixture-of-experts layer.
+
+    Inside ``shard_map``: ``x`` is this device's ``(T, D)`` token shard,
+    ``router_kernel`` ``(D, E)`` is replicated, and ``expert_params`` is this
+    device's ``(E_local, ...)`` slice of the stacked expert parameters
+    (sharded over ``axis_name``; total experts ``E = N · E_local``).
+    ``expert_fn(params_of_one_expert, (tokens, D)) -> (tokens, D)``.
+    ``capacity`` is per (expert, source-device): each device may send at most
+    ``capacity`` tokens to each expert.
+
+    ``axis_name=None`` is the single-process fallback (all experts local, no
+    all-to-all) — the framework-wide convention (reference ``reducer.py:13-18``).
+    """
+    t, d = x.shape
+    n = 1 if axis_name is None else lax.axis_size(axis_name)
+    e_local = jax.tree_util.tree_leaves(expert_params)[0].shape[0]
+    e = n * e_local
+    assert router_kernel.shape[1] == e, (
+        f"router routes over {router_kernel.shape[1]} experts but the mesh"
+        f" holds {e} ({n} devices x {e_local} local)"
+    )
+
+    # --- routing (fp32 for a stable softmax) ------------------------------
+    logits = x.astype(jnp.float32) @ router_kernel.astype(jnp.float32)  # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)               # (T,)
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (T, E)
+    # position of each token within its expert's capacity buffer
+    pos = jnp.cumsum(onehot, axis=0) - onehot                  # (T, E)
+    pos = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)     # (T,)
+    keep = pos < capacity
+    dropped_fraction = 1.0 - jnp.mean(keep.astype(jnp.float32))
+
+    # load-balance aux loss BEFORE capacity drops (Switch eq. 4)
+    fraction = jnp.mean(onehot, axis=0)
+    prob_mean = jnp.mean(probs, axis=0)
+    aux_loss = e * jnp.sum(fraction * prob_mean)
+
+    # (T, E, C) one-hot dispatch mask
+    dispatch = (
+        onehot[:, :, None]
+        * jax.nn.one_hot(pos, capacity, dtype=jnp.float32)[:, None, :]
+        * keep[:, None, None]
+    )
+    # (E, C, D) expert-major send buffer
+    sent = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+
+    # --- to experts: all_to_all over the mesh -----------------------------
+    if axis_name is None:
+        received = sent  # (E, C, D) — all experts local
+    else:
+        # expert-major (E, C, D) -> this device's experts with slots from
+        # every source device, source-major: (E_local, N·C, D)
+        received = lax.all_to_all(
+            sent, axis_name, split_axis=0, concat_axis=1, tiled=True
+        )
+
+    # --- run the local experts -------------------------------------------
+    processed = jax.vmap(expert_fn)(expert_params, received)
+
+    # --- back to sources --------------------------------------------------
+    if axis_name is None:
+        returned = processed
+    else:
+        # source-major slots go back to their source; experts re-concatenate
+        # expert-major: (E_local, N·C, D) -> (E, C, D), same layout as `sent`
+        returned = lax.all_to_all(
+            processed, axis_name, split_axis=1, concat_axis=0, tiled=True
+        )
+
+    combine = dispatch * gate[:, None, None]
+    out = jnp.einsum("tec,ecd->td", combine, returned).astype(x.dtype)
+    return MoEOutput(out, aux_loss, dropped_fraction)
+
+
+def stacked_expert_params(params_per_expert: list[PyTree]) -> PyTree:
+    """Stack E per-expert pytrees with a leading expert axis — shard it over
+    the ``expert`` mesh axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_per_expert)

@@ -1,0 +1,134 @@
+"""Pipeline parallelism: GPipe-style microbatched stage pipeline over a mesh
+axis.
+
+Beyond-parity capability (SURVEY §2.3: the reference has no pipeline
+parallelism — no stage partitioning, no send/recv anywhere). TPU-native
+design, not a torch-style scheduler translation:
+
+- stages live on a ``pipe`` mesh axis: device i holds ONLY stage i's
+  parameters (stacked stage params are sharded on their leading axis);
+- microbatches flow through a ``lax.scan`` over ``M + N − 1`` ticks; at each
+  tick every device applies its stage to the activation in hand and passes
+  the result to its right neighbor with ``lax.ppermute`` (one ICI hop — the
+  TPU equivalent of the reference-world's point-to-point send/recv);
+- the whole schedule is ONE traced program: XLA overlaps each tick's
+  neighbor transfer with the next tick's compute, and reverse-mode autodiff
+  transposes the ppermute chain into the reversed pipeline, so the backward
+  schedule needs no hand-written scheduler at all;
+- per-stage activation memory is O(microbatch), the point of GPipe; wrap
+  ``stage_fn`` in ``jax.checkpoint`` to trade recompute for tape memory.
+
+Composes with the data axis: use ``Mesh(axis_names=('data', 'pipe'))``, shard
+the batch over ``data``, the stages over ``pipe``, and reduce gradients over
+``data`` with any reducer from ``parallel.reducers``/``parallel.compression``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+
+def pipeline_apply(
+    stage_fn: Callable[[PyTree, jax.Array], jax.Array],
+    stage_params: PyTree,
+    x: jax.Array,
+    axis_name: str,
+    num_microbatches: int,
+    remat: bool = False,
+) -> jax.Array:
+    """Run ``x`` through N pipeline stages sharded over ``axis_name``.
+
+    Inside ``shard_map``: ``stage_params`` is THIS device's stage (stacked
+    ``(N, ...)`` params sharded on the leading axis, squeezed by the caller or
+    passed with the leading 1 intact — see ``make_pipeline_fn``), ``x`` is the
+    full ``(B, ...)`` batch (replicated on the pipe axis), and the return is
+    the full ``(B, ...)`` output, replicated again (one masked psum at the
+    end moves the last stage's result to everyone).
+
+    ``stage_fn(params, activation) -> activation`` must preserve the
+    activation shape (classic homogeneous-stage pipelining — e.g. a
+    transformer block); ``B % num_microbatches == 0``.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = num_microbatches
+    b = x.shape[0]
+    assert b % m == 0, f"batch {b} must divide into {m} microbatches"
+    mb = b // m
+    micro = x.reshape((m, mb) + x.shape[1:])
+
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    # right-shift permutation WITHOUT wraparound: stage i -> i+1; stage 0
+    # receives zeros (it reads fresh microbatches instead)
+    perm = [(i, i + 1) for i in range(n - 1)]
+
+    varying = lambda a: lax.pcast(a, axis_name, to="varying")
+    # zeros_like (not fresh zeros): the carry must inherit x's variance over
+    # any OTHER mesh axes (e.g. a data axis) and add pipe-variance on top
+    zero_mb = varying(jnp.zeros_like(micro[0]))
+
+    def tick(carry, t):
+        recv, acc = carry
+        # stage 0 ingests microbatch t (clamped; masked out when t >= m)
+        x_t = varying(
+            lax.dynamic_index_in_dim(micro, jnp.clip(t, 0, m - 1), 0, keepdims=False)
+        )
+        feed = jnp.where((idx == 0) & (t < m), x_t, recv)
+        y = fn(stage_params, feed)
+        # last stage banks microbatch t-(n-1) of the output
+        out_t = t - (n - 1)
+        valid = (idx == n - 1) & (out_t >= 0)
+        slot = jnp.clip(out_t, 0, m - 1)
+        prev = lax.dynamic_index_in_dim(acc, slot, 0, keepdims=False)
+        acc = lax.dynamic_update_index_in_dim(
+            acc, jnp.where(valid, y, prev), slot, 0
+        )
+        recv = lax.ppermute(y, axis_name, perm)
+        return (recv, acc), None
+
+    acc0 = varying(jnp.zeros_like(micro))
+    (_, acc), _ = lax.scan(tick, (zero_mb, acc0), jnp.arange(m + n - 1))
+
+    # replicate the last stage's output to every pipe rank (one psum; the
+    # other ranks contribute zeros)
+    out = lax.psum(jnp.where(idx == n - 1, acc, jnp.zeros_like(acc)), axis_name)
+    return out.reshape((b,) + x.shape[1:])
+
+
+def stacked_stage_params(params_per_stage: list[PyTree]) -> PyTree:
+    """Stack N per-stage pytrees into one pytree with a leading stage axis —
+    shard it over the ``pipe`` mesh axis (``PartitionSpec('pipe', ...)``)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_per_stage)
+
+
+def make_pipeline_fn(
+    stage_fn: Callable[[PyTree, jax.Array], jax.Array],
+    axis_name: str,
+    num_microbatches: int,
+    remat: bool = False,
+) -> Callable[[PyTree, jax.Array], jax.Array]:
+    """Adapt ``stage_fn`` to stacked sharded params: the returned
+    ``fn(stacked_params, x)`` squeezes this device's ``(1, ...)`` stage slice
+    and runs :func:`pipeline_apply`. Use inside ``shard_map`` with
+    ``in_specs=(P(axis_name), P()), out_specs=P()`` (vary batch specs as
+    needed when composing with a data axis)."""
+
+    def fn(stacked_params: PyTree, x: jax.Array) -> jax.Array:
+        n = lax.axis_size(axis_name)
+        for leaf in jax.tree_util.tree_leaves(stacked_params):
+            assert leaf.shape[0] == 1, (
+                f"stacked stage leaf has {n * leaf.shape[0]} stages but the"
+                f" '{axis_name}' axis has {n} devices — one stage per device"
+            )
+        local = jax.tree_util.tree_map(lambda p: p[0], stacked_params)
+        return pipeline_apply(
+            stage_fn, local, x, axis_name, num_microbatches, remat=remat
+        )
+
+    return fn

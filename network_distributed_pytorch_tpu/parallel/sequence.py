@@ -148,21 +148,45 @@ def ulysses_attention(
         # (B, T/N) -> (B, T), shard-major — matches the all_to_all ordering
         bias = lax.all_gather(mask, axis_name, axis=1, tiled=True).astype(jnp.float32)
 
-    scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", qh.astype(jnp.float32), kh.astype(jnp.float32)
-    ) * scale
-    scores = scores + bias[:, None, None, :]
-    if causal:
-        pos = jnp.arange(t)
-        scores = jnp.where(pos[:, None] >= pos[None, :], scores, -jnp.inf)
+    # local attention over the full sequence for H/N heads, chunked over keys
+    # with the same online-softmax accumulation ring_attention uses — memory
+    # stays O(T · T/N) per device instead of materializing (T, T) scores
+    h_loc = h // n
+    q32 = qh.astype(jnp.float32)
+    varying = lambda a: lax.pcast(a, axis_name, to="varying")
+    m0 = varying(jnp.full((b, h_loc, t, 1), -jnp.inf, jnp.float32))
+    l0 = varying(jnp.zeros((b, h_loc, t, 1), jnp.float32))
+    acc0 = varying(jnp.zeros((b, h_loc, t, d), jnp.float32))
 
-    # softmax with fully-masked-row guard (same guard as ring_attention)
-    m = jnp.max(scores, axis=-1, keepdims=True)
-    safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
-    p = jnp.exp(scores - safe_m)
-    p = jnp.where(jnp.isfinite(scores), p, 0.0)
-    ctx = jnp.einsum("bhqk,bkhd->bqhd", p, vh.astype(jnp.float32))
-    ctx = ctx / jnp.maximum(jnp.sum(p, axis=-1)[..., None].swapaxes(1, 2), 1e-37)
+    def chunk(i, carry):
+        m, l, acc = carry
+        k_blk = lax.dynamic_slice_in_dim(kh, i * t_loc, t_loc, 1)
+        v_blk = lax.dynamic_slice_in_dim(vh, i * t_loc, t_loc, 1)
+        bias_blk = lax.dynamic_slice_in_dim(bias, i * t_loc, t_loc, 1)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)
+        ) * scale
+        scores = scores + bias_blk[:, None, None, :]
+        if causal:
+            q_pos = jnp.arange(t)
+            k_pos = i * t_loc + jnp.arange(t_loc)
+            scores = jnp.where(
+                q_pos[:, None] >= k_pos[None, :], scores, -jnp.inf
+            )
+        blk_max = jnp.max(scores, axis=-1, keepdims=True)
+        new_m = jnp.maximum(m, blk_max)
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        correction = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, -jnp.inf))
+        p = jnp.exp(scores - safe_m)
+        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+        l = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * correction + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        return new_m, l, acc
+
+    _, l, acc = lax.fori_loop(0, n, chunk, (m0, l0, acc0))
+    ctx = jnp.einsum("bhqd->bqhd", acc / jnp.maximum(l, 1e-37))
 
     # head-sharded -> seq-sharded: (B, T, H/N, D) -> (B, T/N, H, D)
     return lax.all_to_all(

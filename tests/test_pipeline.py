@@ -1,0 +1,139 @@
+"""GPipe-style pipeline over the 8-device 'pipe' mesh: forward ≡ sequential
+stage application, gradients ≡ single-device autodiff, training converges,
+and composition with a data axis on a 2×4 mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from network_distributed_pytorch_tpu.parallel import make_mesh
+from network_distributed_pytorch_tpu.parallel.pipeline import (
+    make_pipeline_fn,
+    pipeline_apply,
+    stacked_stage_params,
+)
+
+N = 8          # stages
+B, DIM = 16, 6
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _stage_params(seed):
+    rng = np.random.RandomState(seed)
+    return {
+        "w": jnp.asarray(rng.randn(DIM, DIM) * 0.5, jnp.float32),
+        "b": jnp.asarray(rng.randn(DIM) * 0.1, jnp.float32),
+    }
+
+
+def _sequential(stages, x):
+    for p in stages:
+        x = _stage_fn(p, x)
+    return x
+
+
+@pytest.mark.parametrize("microbatches", [2, 4, 8], ids=lambda m: f"mb{m}")
+def test_pipeline_forward_matches_sequential(devices, microbatches):
+    stages = [_stage_params(s) for s in range(N)]
+    stacked = stacked_stage_params(stages)
+    x = jnp.asarray(np.random.RandomState(99).randn(B, DIM), jnp.float32)
+    ref = _sequential(stages, x)
+
+    mesh = make_mesh(axis_sizes=(N,), axis_names=("pipe",))
+    fn = make_pipeline_fn(_stage_fn, "pipe", microbatches)
+    out = jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=(P("pipe"), P()), out_specs=P())
+    )(stacked, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=1e-6)
+
+
+def test_pipeline_gradients_match_single_device(devices):
+    stages = [_stage_params(10 + s) for s in range(N)]
+    stacked = stacked_stage_params(stages)
+    x = jnp.asarray(np.random.RandomState(0).randn(B, DIM), jnp.float32)
+    y = jnp.asarray(np.random.RandomState(1).randn(B, DIM), jnp.float32)
+
+    def ref_loss(stacked_params):
+        out = x
+        for i in range(N):
+            out = _stage_fn(jax.tree.map(lambda p: p[i], stacked_params), out)
+        return jnp.mean((out - y) ** 2)
+
+    ref_grads = jax.grad(ref_loss)(stacked)
+
+    mesh = make_mesh(axis_sizes=(N,), axis_names=("pipe",))
+    fn = make_pipeline_fn(_stage_fn, "pipe", 4, remat=True)
+
+    def pipe_loss(stacked_params, x, y):
+        out = fn(stacked_params, x)
+        return jnp.mean((out - y) ** 2)
+
+    grads = jax.jit(
+        jax.shard_map(
+            jax.grad(pipe_loss), mesh=mesh,
+            in_specs=(P("pipe"), P(), P()), out_specs=P("pipe"),
+        )
+    )(stacked, x, y)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(grads[k]), np.asarray(ref_grads[k]), rtol=2e-4, atol=1e-6
+        )
+
+
+def test_pipeline_training_converges(devices):
+    """PP-only training loop: per-stage SGD on the local stage params."""
+    stages = [_stage_params(20 + s) for s in range(N)]
+    stacked = stacked_stage_params(stages)
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(B, DIM), jnp.float32)
+    y = jnp.tanh(jnp.asarray(rng.randn(B, DIM), jnp.float32))
+
+    mesh = make_mesh(axis_sizes=(N,), axis_names=("pipe",))
+    fn = make_pipeline_fn(_stage_fn, "pipe", 4)
+
+    def loss_fn(stacked_params, x, y):
+        return jnp.mean((fn(stacked_params, x) - y) ** 2)
+
+    @jax.jit
+    def train_step(stacked_params, x, y):
+        def body(p, x, y):
+            l, g = jax.value_and_grad(loss_fn)(p, x, y)
+            return jax.tree.map(lambda p_, g_: p_ - 0.2 * g_, p, g), l
+
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P("pipe"), P(), P()), out_specs=(P("pipe"), P()),
+        )(stacked_params, x, y)
+
+    losses = []
+    for _ in range(80):
+        stacked, l = train_step(stacked, x, y)
+        losses.append(float(l))
+    # 8 stacked tanh stages train slowly; monotone-ish halving is the signal
+    assert losses[-1] < 0.5 * losses[0], losses
+
+
+def test_pipeline_composes_with_data_axis(devices):
+    """2×4 mesh: batch sharded over 'data', stages over 'pipe'; forward equals
+    sequential on the full batch."""
+    n_pipe = 4
+    stages = [_stage_params(30 + s) for s in range(n_pipe)]
+    stacked = stacked_stage_params(stages)
+    x = jnp.asarray(np.random.RandomState(3).randn(B, DIM), jnp.float32)
+    ref = _sequential(stages, x)
+
+    mesh = make_mesh(axis_sizes=(2, n_pipe), axis_names=("data", "pipe"))
+    fn = make_pipeline_fn(_stage_fn, "pipe", 2)
+
+    out = jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(P("pipe"), P("data")), out_specs=P("data"),
+        )
+    )(stacked, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=1e-6)
