@@ -32,3 +32,9 @@ from .moe import (  # noqa: F401
     stacked_expert_params,
     switch_moe,
 )
+from .fsdp import (  # noqa: F401
+    FSDPState,
+    make_fsdp_train_step,
+    shard_params,
+    unshard_params,
+)

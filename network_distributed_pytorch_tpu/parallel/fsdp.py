@@ -1,0 +1,271 @@
+"""FSDP / ZeRO-3 — fully-sharded data parallelism over the ``data`` axis.
+
+Beyond-parity capability (the reference is replicated-parameter DDP only,
+SURVEY §2.3): every parameter, its gradient, and its optimizer state live
+**sharded** across the data-parallel workers — per-device memory for the
+model+optimizer drops by ~1/world — while the training math stays exactly
+data-parallel SGD.
+
+TPU-native design (this is where JAX earns its keep):
+
+- Each parameter leaf is flattened, padded to a multiple of the world size,
+  and stored as a flat shard per device (leading ``world`` axis sharded over
+  the mesh, like the trainer's error memories).
+- Inside the ``shard_map`` step, ``jax.lax.all_gather(..., tiled=True)``
+  reconstructs the full parameter just-in-time for the forward.
+- **The backward is not hand-written**: reverse-mode AD transposes the
+  tiled all_gather into ``psum_scatter`` — i.e. the ZeRO reduce-scatter of
+  gradients falls out of ``jax.grad`` automatically, and each device receives
+  exactly its shard of the summed gradient.
+- The optimizer update then runs on 1/world of the elements per device.
+
+Wire cost per step: one all_gather (parameters, bf16/f32 as stored) + one
+reduce_scatter (gradients) per leaf — the classic ZeRO-3 2×payload vs plain
+DDP's 1× logical allreduce (which itself costs ~2× on the wire ring-wise, so
+step bandwidth is comparable while memory is 1/world). Accounted statically
+like everything else (reference ``reducer.py:197-198`` analytic model).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .comm import all_reduce_mean
+from .mesh import DATA_AXIS
+from .trainer import LossFn
+
+PyTree = Any
+
+
+def _chunk_size(n: int, world: int) -> int:
+    return -(-n // world)  # ceil
+
+
+def shard_params(params: PyTree, world: int) -> PyTree:
+    """Flatten+pad each leaf and split into ``world`` flat shards:
+    leaf ``(…shape)`` → ``(world, ceil(size/world))``. Host-side; place the
+    result with a ``P('data')`` sharding (``fsdp_state_sharding``)."""
+
+    def shard(leaf):
+        leaf = jnp.asarray(leaf)
+        chunk = _chunk_size(leaf.size, world)
+        flat = jnp.pad(leaf.reshape(-1), (0, world * chunk - leaf.size))
+        return flat.reshape(world, chunk)
+
+    return jax.tree_util.tree_map(shard, params)
+
+
+def unshard_params(shards: PyTree, params_template: PyTree) -> PyTree:
+    """Inverse of :func:`shard_params` — reassemble full parameters (e.g. for
+    eval or checkpointing)."""
+
+    def unshard(shard, tmpl):
+        return shard.reshape(-1)[: tmpl.size].reshape(tmpl.shape).astype(tmpl.dtype)
+
+    return jax.tree_util.tree_map(unshard, shards, params_template)
+
+
+class FSDPState(NamedTuple):
+    """Per-step carry. ``param_shards`` / ``opt_shards`` are flat ZeRO shards
+    with a leading ``world`` axis sharded over the data axis; ``model_state``
+    (e.g. BatchNorm stats) is replicated like the trainer's."""
+
+    param_shards: PyTree
+    opt_shards: PyTree
+    model_state: PyTree
+
+
+class CompiledFSDPStep(NamedTuple):
+    """A jitted FSDP step plus its static wire cost and (de)sharding helpers."""
+
+    fn: Callable[[FSDPState, Any], Tuple[FSDPState, jax.Array]]
+    bits_per_step: int
+    mesh: Mesh
+    axis_name: str
+    params_template: PyTree
+    opt_specs: PyTree
+    optimizer: Any = None
+
+    def __call__(self, state, batch):
+        return self.fn(state, batch)
+
+    @property
+    def world(self) -> int:
+        return int(self.mesh.shape[self.axis_name])
+
+    def init_state(self, params: PyTree, model_state: PyTree = None) -> FSDPState:
+        shards = shard_params(params, self.world)
+        opt = (
+            self.optimizer.init(shards)
+            if self.optimizer is not None
+            else jax.tree_util.tree_map(jnp.zeros_like, shards)
+        )
+        sh = NamedSharding(self.mesh, PartitionSpec(self.axis_name))
+        place = lambda t: jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sh), t
+        )
+        # optimizer state may carry unsharded leaves (e.g. optax's scalar step
+        # count) alongside the shard-mirroring ones — place each per its spec
+        opt = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+            opt,
+            self.opt_specs,
+        )
+        return FSDPState(
+            param_shards=place(shards),
+            opt_shards=opt,
+            model_state={} if model_state is None else model_state,
+        )
+
+    def unshard(self, state: FSDPState) -> PyTree:
+        """Full (replicated) parameters from the sharded state."""
+        return unshard_params(state.param_shards, self.params_template)
+
+
+def make_fsdp_train_step(
+    loss_fn: LossFn,
+    params_template: PyTree,
+    learning_rate: float,
+    momentum: float = 0.9,
+    algorithm: str = "sgd",
+    mesh: Mesh = None,
+    axis_name: str = DATA_AXIS,
+    donate_state: bool = True,
+    optimizer=None,
+) -> CompiledFSDPStep:
+    """Compile the fully-sharded training step.
+
+    ``loss_fn`` has the trainer signature ``(params, model_state, batch) ->
+    (loss, model_state)`` and always sees **full** parameters — sharding is
+    invisible to the model. ``algorithm`` ∈ {"sgd", "sgd_plain",
+    "sgd_nesterov", "optax"} with torch ``optim.SGD`` semantics (the exact-DDP
+    trainer's optimizer, ``ddp_guide_cifar10/ddp_init.py:110``); elementwise
+    optimizers apply shard-wise unchanged.
+    """
+    assert mesh is not None, "FSDP is inherently multi-device; pass a mesh"
+    assert algorithm in ("sgd", "sgd_plain", "sgd_nesterov", "optax")
+    assert (algorithm == "optax") == (optimizer is not None)
+    world = int(mesh.shape[axis_name])
+    templates = jax.tree_util.tree_map(
+        lambda p: jax.ShapeDtypeStruct(jnp.shape(p), jnp.asarray(p).dtype),
+        params_template,
+    )
+    # Optimizer state mirrors the (world, chunk) shards leaf-for-leaf except
+    # for unsharded extras (optax's scalar count): spec each leaf by shape.
+    shards_abs = jax.eval_shape(lambda p: shard_params(p, world), templates)
+    opt_abs = (
+        jax.eval_shape(optimizer.init, shards_abs)
+        if optimizer is not None
+        else shards_abs
+    )
+    _shard_spec = PartitionSpec(axis_name)
+    opt_specs = jax.tree_util.tree_map(
+        lambda l: _shard_spec
+        if l.ndim >= 1 and l.shape[0] == world
+        else PartitionSpec(),
+        opt_abs,
+    )
+
+    def gather_full(shard, tmpl):
+        # (chunk,) local shard -> full (…shape); AD transposes the tiled
+        # all_gather into psum_scatter — the ZeRO gradient reduce-scatter.
+        flat = jax.lax.all_gather(shard, axis_name, tiled=True)
+        return flat[: tmpl.size].reshape(tmpl.shape)
+
+    def step(state: FSDPState, batch):
+        def shard_loss(param_shards, model_state, batch):
+            params = jax.tree_util.tree_map(gather_full, param_shards, templates)
+            return loss_fn(params, model_state, batch)
+
+        (loss, model_state), grad_shards = jax.value_and_grad(
+            shard_loss, has_aux=True
+        )(state.param_shards, state.model_state, batch)
+        # psum_scatter summed the per-worker gradients; divide for the
+        # data-parallel mean (the reference's allreduce-then-/=world,
+        # ddp_guide_cifar10/ddp_init.py:61-62).
+        grad_shards = jax.tree_util.tree_map(lambda g: g / world, grad_shards)
+        model_state = jax.tree_util.tree_map(
+            lambda x: all_reduce_mean(x, axis_name), model_state
+        )
+
+        if algorithm == "optax":
+            import optax
+
+            updates, opt = optimizer.update(
+                grad_shards, state.opt_shards, state.param_shards
+            )
+            param_shards = optax.apply_updates(state.param_shards, updates)
+        else:
+            if algorithm == "sgd_plain":
+                opt = state.opt_shards
+                update = grad_shards
+            else:
+                opt = jax.tree_util.tree_map(
+                    lambda m, g: momentum * m + g, state.opt_shards, grad_shards
+                )
+                update = (
+                    jax.tree_util.tree_map(
+                        lambda g, m: g + momentum * m, grad_shards, opt
+                    )
+                    if algorithm == "sgd_nesterov"
+                    else opt
+                )
+            param_shards = jax.tree_util.tree_map(
+                lambda p, u: p - learning_rate * u, state.param_shards, update
+            )
+
+        loss = all_reduce_mean(loss, axis_name)
+        return FSDPState(param_shards, opt, model_state), loss
+
+    _rep = PartitionSpec()
+
+    def sharded_body(state: FSDPState, batch):
+        # strip the global leading world axis: (world, chunk) -> (chunk,);
+        # replicated opt leaves (spec P()) pass through unchanged
+        strip = lambda t: jax.tree_util.tree_map(lambda x: x[0], t)
+        local = FSDPState(
+            strip(state.param_shards),
+            jax.tree_util.tree_map(
+                lambda x, s: x if s == _rep else x[0], state.opt_shards, opt_specs
+            ),
+            state.model_state,
+        )
+        new_state, loss = step(local, batch)
+        pad = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+        return (
+            FSDPState(
+                pad(new_state.param_shards),
+                jax.tree_util.tree_map(
+                    lambda x, s: x if s == _rep else x[None],
+                    new_state.opt_shards,
+                    opt_specs,
+                ),
+                new_state.model_state,
+            ),
+            loss,
+        )
+
+    shard_spec = PartitionSpec(axis_name)
+    state_specs = FSDPState(
+        param_shards=shard_spec, opt_shards=opt_specs, model_state=PartitionSpec()
+    )
+    fn = jax.jit(
+        jax.shard_map(
+            sharded_body,
+            mesh=mesh,
+            in_specs=(state_specs, PartitionSpec(axis_name)),
+            out_specs=(state_specs, PartitionSpec()),
+        ),
+        donate_argnums=(0,) if donate_state else (),
+    )
+
+    # all_gather(params) + reduce_scatter(grads), padded sizes, per leaf
+    bits = sum(
+        2 * 8 * world * _chunk_size(int(t.size), world) * t.dtype.itemsize
+        for t in jax.tree_util.tree_leaves(templates)
+    )
+    return CompiledFSDPStep(fn, bits, mesh, axis_name, templates, opt_specs, optimizer)

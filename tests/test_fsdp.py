@@ -1,0 +1,135 @@
+"""FSDP/ZeRO-3: sharded training ≡ replicated DDP, memory actually sharded."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from network_distributed_pytorch_tpu.models import SmallCNN
+from network_distributed_pytorch_tpu.parallel import ExactReducer, make_mesh
+from network_distributed_pytorch_tpu.parallel.fsdp import (
+    make_fsdp_train_step,
+    shard_params,
+    unshard_params,
+)
+from network_distributed_pytorch_tpu.parallel.trainer import (
+    make_train_step,
+    stateless_loss,
+)
+from network_distributed_pytorch_tpu.utils import cross_entropy_loss
+
+IMG = (8, 8, 3)
+
+
+def _cnn_setup():
+    model = SmallCNN(width=4)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, *IMG)))["params"]
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return cross_entropy_loss(model.apply({"params": params}, x), y)
+
+    return params, stateless_loss(loss_fn)
+
+
+def _batch(key, n=64):
+    ky, kx = jax.random.split(key)
+    means = jax.random.normal(jax.random.PRNGKey(999), (10, *IMG))
+    y = jax.random.randint(ky, (n,), 0, 10)
+    x = means[y] + 0.5 * jax.random.normal(kx, (n, *IMG))
+    return x, y
+
+
+def test_shard_unshard_roundtrip(devices):
+    params, _ = _cnn_setup()
+    world = 8
+    shards = shard_params(params, world)
+    # every shard leaf carries the (world, chunk) layout
+    for leaf, orig in zip(
+        jax.tree_util.tree_leaves(shards), jax.tree_util.tree_leaves(params)
+    ):
+        assert leaf.shape[0] == world
+        assert leaf.size >= orig.size
+    back = unshard_params(shards, params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(back), jax.tree_util.tree_leaves(params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fsdp_matches_replicated_ddp(devices):
+    """The ZeRO-3 step (gather params → grad → AD-transposed reduce-scatter →
+    sharded SGD) must trace the same trajectory as replicated exact-DDP."""
+    params, loss_fn = _cnn_setup()
+    mesh = make_mesh()
+
+    ddp = make_train_step(
+        loss_fn, ExactReducer(), params, learning_rate=0.05, momentum=0.9,
+        algorithm="sgd", mesh=mesh, donate_state=False,
+    )
+    fsdp = make_fsdp_train_step(
+        loss_fn, params, learning_rate=0.05, momentum=0.9,
+        algorithm="sgd", mesh=mesh, donate_state=False,
+    )
+
+    ds = ddp.init_state(params)
+    fs = fsdp.init_state(params)
+    for i in range(5):
+        batch = _batch(jax.random.PRNGKey(i))
+        ds, dloss = ddp(ds, batch)
+        fs, floss = fsdp(fs, batch)
+        np.testing.assert_allclose(float(dloss), float(floss), rtol=1e-5)
+
+    full = fsdp.unshard(fs)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(full), jax.tree_util.tree_leaves(ds.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_fsdp_memory_is_sharded(devices):
+    """Each device holds ~1/world of every parameter + optimizer leaf."""
+    params, loss_fn = _cnn_setup()
+    mesh = make_mesh()
+    fsdp = make_fsdp_train_step(
+        loss_fn, params, learning_rate=0.05, mesh=mesh, donate_state=False
+    )
+    state = fsdp.init_state(params)
+    for shard, orig in zip(
+        jax.tree_util.tree_leaves(state.param_shards),
+        jax.tree_util.tree_leaves(params),
+    ):
+        per_device = shard.size // 8
+        assert per_device == -(-orig.size // 8)
+        # genuinely distributed: one addressable shard per device
+        assert len(shard.sharding.device_set) == 8
+
+
+def test_fsdp_optax_adamw_trains(devices):
+    import optax
+
+    params, loss_fn = _cnn_setup()
+    mesh = make_mesh()
+    fsdp = make_fsdp_train_step(
+        loss_fn, params, learning_rate=0.0, algorithm="optax",
+        optimizer=optax.adamw(1e-2), mesh=mesh, donate_state=False,
+    )
+    state = fsdp.init_state(params)
+    losses = []
+    for i in range(8):
+        state, loss = fsdp(state, _batch(jax.random.PRNGKey(i % 2)))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_fsdp_bits_accounting(devices):
+    params, loss_fn = _cnn_setup()
+    mesh = make_mesh()
+    fsdp = make_fsdp_train_step(
+        loss_fn, params, learning_rate=0.05, mesh=mesh, donate_state=False
+    )
+    # gather + scatter of every (padded) leaf
+    manual = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        padded = -(-leaf.size // 8) * 8
+        manual += 2 * 8 * padded * leaf.dtype.itemsize
+    assert fsdp.bits_per_step == manual
