@@ -24,6 +24,7 @@ from .compression import (  # noqa: F401
 )
 from .pipeline import (  # noqa: F401
     make_pipeline_fn,
+    make_pipeline_train_fn,
     pipeline_apply,
     stacked_stage_params,
 )

@@ -107,6 +107,155 @@ def stacked_stage_params(params_per_stage: list[PyTree]) -> PyTree:
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_per_stage)
 
 
+def make_pipeline_train_fn(
+    stage_fn: Callable[[PyTree, jax.Array], jax.Array],
+    loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+    axis_name: str,
+    num_microbatches: int,
+    params_varying_over: tuple = (),
+):
+    """1F1B-style pipeline **training** schedule with an O(stages) activation
+    stash.
+
+    ``pipeline_apply`` + reverse-mode AD gives a correct backward pipeline,
+    but the scan tape stores one stashed activation per forward tick —
+    O(num_microbatches) per device. The classic 1F1B fix (one backward unit
+    interleaved after each forward unit in steady state) bounds live
+    activations by the pipeline depth instead. JAX's AD cannot reorder its
+    own backward, so this schedule is hand-built: each scan iteration runs
+    one forward unit (tick ``2j``) and one backward unit (tick ``2j+1``),
+    with the backward recomputing its stage forward from the stashed INPUT
+    (input-stash + recompute, as in Megatron's memory-efficient variant):
+
+    - forward of microbatch k runs on device i at iteration ``j = k + i``;
+      activations hop right via ``ppermute`` and are consumed next iteration;
+    - backward of microbatch k runs on device i at iteration
+      ``j = k + 2(n-1) - i``; gradients hop left and are consumed next
+      iteration; the last stage seeds from the loss vjp one tick after its
+      forward — the "1F" is immediately followed by its "1B";
+    - each device stashes at most ``min(2n-1, m)`` microbatch inputs — peak
+      activation memory is independent of the microbatch count.
+
+    Returns ``fn(stage_params, x, labels) -> (mean_loss, stage_grads)`` for
+    use inside ``shard_map`` (stage params/grads carry this device's leading
+    ``(1, ...)`` stage slice, specs ``P(axis_name)``; x/labels replicated).
+    ``loss_fn(y_mb, labels_mb) -> scalar`` is the per-microbatch mean loss.
+
+    When composing with a data axis, list it in ``params_varying_over``: the
+    params are pcast device-varying over those axes before differentiation so
+    the returned grads are this shard's LOCAL grads — without it, jax's
+    replication-tracking transpose would auto-``psum`` them (pre-synchronized
+    gradients, exactly what the trainer avoids for pluggable compression —
+    see ``trainer.make_step_fn``); the caller then reduces over the data axis
+    with any reducer (or ``pmean``).
+    """
+    m = num_microbatches
+
+    def fn(stacked_params: PyTree, x: jax.Array, labels: jax.Array):
+        n = lax.axis_size(axis_name)
+        idx = lax.axis_index(axis_name)
+        for leaf in jax.tree_util.tree_leaves(stacked_params):
+            assert leaf.shape[0] == 1, (
+                f"stacked stage leaf has {n * leaf.shape[0]} stages but the"
+                f" '{axis_name}' axis has {n} devices — one stage per device"
+            )
+        params = jax.tree_util.tree_map(lambda p: p[0], stacked_params)
+        for ax in params_varying_over:
+            params = jax.tree_util.tree_map(
+                lambda p: lax.pcast(p, ax, to="varying"), params
+            )
+        b = x.shape[0]
+        assert b % m == 0, f"batch {b} must divide into {m} microbatches"
+        mb = b // m
+        micro = x.reshape((m, mb) + x.shape[1:])
+        micro_labels = labels.reshape((m, mb) + labels.shape[1:])
+        # ≥ the max number of in-flight microbatch inputs on any device
+        # (2n-2-2i live + 1 being written on device i), capped at m: for
+        # m ≤ 2n-1 every microbatch gets its own slot (invalid ticks don't
+        # write), for m > 2n-1 the ring reuse spacing ≥ the in-flight span.
+        # Bounded by the pipeline depth, not m: the 1F1B memory property.
+        stash_size = min(2 * n - 1, m)
+
+        varying = lambda a: lax.pcast(a, axis_name, to="varying")
+        # a zero scalar that inherits x's variance over any OTHER mesh axes
+        # (e.g. data/model): every scan-carry init is built from it so carry
+        # types stay fixed when the pipeline composes with more axes
+        tint = (micro[0] * 0).sum()
+        zero_mb = varying(jnp.zeros_like(micro[0]))
+        fwd_perm = [(i, i + 1) for i in range(n - 1)]
+        bwd_perm = [(i + 1, i) for i in range(n - 1)]
+
+        def fwd_unit(p, x_in):
+            return stage_fn(p, x_in)
+
+        def bwd_unit(p, x_in, g_in, label, is_last):
+            y, vjp = jax.vjp(stage_fn, p, x_in)
+            loss_val, loss_vjp = jax.vjp(lambda yy: loss_fn(yy, label), y)
+            seed = jnp.where(is_last, loss_vjp(jnp.ones_like(loss_val))[0], g_in)
+            dp, dx = vjp(seed)
+            return loss_val, dp, dx
+
+        def iteration(carry, j):
+            recv_act, recv_grad, stash, dp_acc, loss_acc = carry
+
+            # ---- forward subtick (global tick 2j): microbatch k_f = j - idx
+            k_f = j - idx
+            valid_f = (k_f >= 0) & (k_f < m)
+            # indexing by the idx-dependent k_f already makes this varying
+            x_first = lax.dynamic_index_in_dim(
+                micro, jnp.clip(k_f, 0, m - 1), 0, keepdims=False
+            )
+            feed = jnp.where(idx == 0, x_first, recv_act)
+            y = fwd_unit(params, feed)
+            slot_f = jnp.clip(k_f, 0, m - 1) % stash_size
+            old = lax.dynamic_index_in_dim(stash, slot_f, 0, keepdims=False)
+            stash = lax.dynamic_update_index_in_dim(
+                stash, jnp.where(valid_f, feed, old), slot_f, 0
+            )
+            send_act = lax.ppermute(y, axis_name, fwd_perm)
+
+            # ---- backward subtick (tick 2j+1): k_b = j + idx + 2 - 2n
+            k_b = j + idx + 2 - 2 * n
+            valid_b = (k_b >= 0) & (k_b < m)
+            slot_b = jnp.clip(k_b, 0, m - 1) % stash_size
+            x_in = lax.dynamic_index_in_dim(stash, slot_b, 0, keepdims=False)
+            label = lax.dynamic_index_in_dim(
+                micro_labels, jnp.clip(k_b, 0, m - 1), 0, keepdims=False
+            )
+            loss_val, dp, dx = bwd_unit(
+                params, x_in, recv_grad, label, idx == n - 1
+            )
+            dp_acc = jax.tree_util.tree_map(
+                lambda a, d: a + jnp.where(valid_b, d, jnp.zeros_like(d)),
+                dp_acc,
+                dp,
+            )
+            loss_acc = loss_acc + jnp.where(
+                valid_b & (idx == n - 1), loss_val, 0.0
+            )
+            send_grad = lax.ppermute(dx, axis_name, bwd_perm)
+
+            return (send_act, send_grad, stash, dp_acc, loss_acc), None
+
+        stash0 = jnp.broadcast_to(zero_mb[None], (stash_size,) + zero_mb.shape)
+        dp0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p) + tint.astype(p.dtype), params
+        )
+        loss0 = varying(tint.astype(jnp.float32))
+        carry0 = (zero_mb, zero_mb, stash0, dp0, loss0)
+        num_iters = m + 2 * n - 2  # last backward: j = (m-1) + 2(n-1)
+        (_, _, _, dp_acc, loss_acc), _ = lax.scan(
+            iteration, carry0, jnp.arange(num_iters)
+        )
+
+        # mean over microbatches; broadcast the last stage's loss to all ranks
+        loss = lax.psum(loss_acc, axis_name) / m
+        grads = jax.tree_util.tree_map(lambda g: (g / m)[None], dp_acc)
+        return loss, grads
+
+    return fn
+
+
 def make_pipeline_fn(
     stage_fn: Callable[[PyTree, jax.Array], jax.Array],
     axis_name: str,

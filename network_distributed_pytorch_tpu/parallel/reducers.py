@@ -120,8 +120,9 @@ class PowerSGDReducer:
     semantic parity to ``reducer.py:26-170``.
 
     Parameters mirror the reference constructor (``reducer.py:26``):
-    ``n_power_iterations`` must be 0 (the reference asserts the same,
-    ``reducer.py:30`` — "0" means the single fused power iteration),
+    ``n_power_iterations=0`` is the reference's single fused power iteration
+    (the reference asserts exactly this, ``reducer.py:30``); values k>0 run k
+    EXTRA subspace iterations — a beyond-parity fidelity/bandwidth knob.
     ``reuse_query`` warm-starts Q from the previous step,
     ``compression_rank`` is the target rank r.
 
@@ -143,9 +144,16 @@ class PowerSGDReducer:
         orthogonalize_impl: str = "xla",
         compression_dtype=None,
     ):
-        assert n_power_iterations == 0, "only the fused single power iteration is supported (reducer.py:30)"
+        # The reference asserts n_power_iterations == 0 (reducer.py:30 — "0"
+        # meaning the single fused iteration). Beyond parity, we support k
+        # EXTRA subspace iterations: each repeats the P/Q round (with its two
+        # collectives) on the mean matrix before decompression, improving the
+        # rank-r approximation at proportional wire cost. The loop is a
+        # static Python unroll — shapes differ per matrix, count is tiny.
+        assert n_power_iterations >= 0
         assert matricize in ("first", "last")
         assert orthogonalize_impl in ("xla", "pallas")
+        self.n_power_iterations = n_power_iterations
         self.random_seed = random_seed
         self.reuse_query = reuse_query
         self.compression_rank = compression_rank
@@ -257,46 +265,52 @@ class PowerSGDReducer:
                 for t, meta in enumerate(metas)
             ]
 
-        # Step 3: P <- M Q (reducer.py:120-123)
-        ps = [mat @ q for mat, q in zip(matrices, qs)]
-
-        # Step 4: ALL_REDUCE_MEAN(P) — ONE collective for all Ps
-        # (reducer.py:125-128)
-        if ps:
-            p_flat = all_reduce_mean(p_packer.pack(ps), axis_name)
-            bits += n_bits(p_flat)
-            math_dtype = matrices[0].dtype
-            ps = [p.astype(math_dtype) for p in p_packer.unpack(p_flat)]
-
-        # Rank-1 tensors: flat-pack and reduce uncompressed. The reference
-        # launches this async here to overlap with orthogonalization
-        # (reducer.py:130-133); under XLA the same overlap comes from the
-        # latency-hiding scheduler, so only the issue ORDER is mirrored.
+        # Steps 3-7, run (1 + n_power_iterations) times: the reference's single
+        # fused round (reducer.py:120-147), plus optional extra subspace
+        # iterations on the mean matrix (beyond parity — the reference asserts
+        # the count to 0). Each round costs one P and one Q collective.
+        new_q_memory = state.q_memory
         rank1_out: List[jax.Array] = []
-        if rank1_idx:
-            rank1_flat = rank1_packer.pack([leaves[i] for i in rank1_idx])
-            rank1_reduced = all_reduce_mean(rank1_flat, axis_name)
-            bits += rank1_packer.bits()
-            rank1_out = [
-                o.astype(leaves[i].dtype)
-                for i, o in zip(rank1_idx, rank1_packer.unpack(rank1_reduced))
-            ]
+        ps: List[jax.Array] = []
+        for it in range(1 + self.n_power_iterations):
+            # Step 3: P <- M Q (reducer.py:120-123)
+            ps = [mat @ q for mat, q in zip(matrices, qs)]
 
-        # Step 5: P_hat <- ORTHOGONALIZE(P) (reducer.py:135-137)
-        ps = [self._orthogonalize(p) for p in ps]
+            # Step 4: ALL_REDUCE_MEAN(P) — ONE collective for all Ps
+            # (reducer.py:125-128)
+            if ps:
+                p_flat = all_reduce_mean(p_packer.pack(ps), axis_name)
+                bits += n_bits(p_flat)
+                math_dtype = matrices[0].dtype
+                ps = [p.astype(math_dtype) for p in p_packer.unpack(p_flat)]
 
-        # Step 6: Q <- M^T P_hat (reducer.py:139-142)
-        qs = [mat.T @ p for mat, p in zip(matrices, ps)]
+            # Rank-1 tensors: flat-pack and reduce uncompressed, once. The
+            # reference launches this async here to overlap with
+            # orthogonalization (reducer.py:130-133); under XLA the same
+            # overlap comes from the latency-hiding scheduler, so only the
+            # issue ORDER is mirrored.
+            if it == 0 and rank1_idx:
+                rank1_flat = rank1_packer.pack([leaves[i] for i in rank1_idx])
+                rank1_reduced = all_reduce_mean(rank1_flat, axis_name)
+                bits += rank1_packer.bits()
+                rank1_out = [
+                    o.astype(leaves[i].dtype)
+                    for i, o in zip(rank1_idx, rank1_packer.unpack(rank1_reduced))
+                ]
 
-        # Step 7: ALL_REDUCE_MEAN(Q) — ONE collective for all Qs
-        # (reducer.py:144-147)
-        if qs:
-            q_flat = all_reduce_mean(q_packer.pack(qs), axis_name)
-            bits += n_bits(q_flat)
-            qs = [q.astype(matrices[0].dtype) for q in q_packer.unpack(q_flat)]
-            new_q_memory = q_flat
-        else:
-            new_q_memory = state.q_memory
+            # Step 5: P_hat <- ORTHOGONALIZE(P) (reducer.py:135-137)
+            ps = [self._orthogonalize(p) for p in ps]
+
+            # Step 6: Q <- M^T P_hat (reducer.py:139-142)
+            qs = [mat.T @ p for mat, p in zip(matrices, ps)]
+
+            # Step 7: ALL_REDUCE_MEAN(Q) — ONE collective for all Qs
+            # (reducer.py:144-147)
+            if qs:
+                q_flat = all_reduce_mean(q_packer.pack(qs), axis_name)
+                bits += n_bits(q_flat)
+                qs = [q.astype(matrices[0].dtype) for q in q_packer.unpack(q_flat)]
+                new_q_memory = q_flat
 
         # Steps 8-9: decompress out = P Q^T; error memory = send - out
         # (reducer.py:157-163). Rank-1 error memory stays zero: the reference
@@ -319,9 +333,13 @@ class PowerSGDReducer:
     # ---- analytics -------------------------------------------------------
 
     def bits_per_step(self, grads_template: PyTree) -> int:
-        """Static analytic wire cost: 32·[Σ(nᵢ+mᵢ)·rᵢ + Σ rank-1 sizes] bits
-        for fp32 (BASELINE.md wire-cost model; reference reducer.py:72-98)."""
+        """Static analytic wire cost:
+        32·[(1+k)·Σ(nᵢ+mᵢ)·rᵢ + Σ rank-1 sizes] bits for fp32, where k is
+        ``n_power_iterations`` (each extra subspace round repeats the P and Q
+        collectives; k=0 recovers the BASELINE.md wire-cost model, reference
+        ``reducer.py:72-98``)."""
         leaves = jax.tree_util.tree_leaves(grads_template)
         metas = self._metas(leaves)
         p_packer, q_packer, rank1_packer = self._packers(leaves, metas)
-        return p_packer.bits() + q_packer.bits() + rank1_packer.bits()
+        rounds = 1 + self.n_power_iterations
+        return rounds * (p_packer.bits() + q_packer.bits()) + rank1_packer.bits()

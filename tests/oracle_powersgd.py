@@ -36,11 +36,14 @@ def powersgd_reduce_np(
     qs: List[np.ndarray],
     compression_rank: int,
     matricize_mode: str = "first",
+    n_power_iterations: int = 0,
 ) -> Tuple[List[np.ndarray], List[List[np.ndarray]], List[np.ndarray], int]:
     """One reduction step over W simulated workers.
 
     Returns (out, memories_per_worker, next_qs, bits). ``qs`` must be the
     current warm-start Qs for the high-rank tensors in leaf order.
+    ``n_power_iterations`` adds extra P/Q subspace rounds (the framework's
+    beyond-parity extension; 0 = the reference's single fused round).
     """
     n_workers = len(sends_per_worker)
     template = sends_per_worker[0]
@@ -49,15 +52,6 @@ def powersgd_reduce_np(
 
     bits = 0
     out = [None] * len(template)
-    next_qs = []
-    p_hats = []
-
-    # P = mean_w(M_w Q); bits count the packed P buffer (reducer.py:120-128)
-    for j, i in enumerate(high_idx):
-        mats = [matricize(w[i], matricize_mode) for w in sends_per_worker]
-        p = np.mean([m @ qs[j] for m in mats], axis=0)
-        bits += 32 * p.size
-        p_hats.append(orthogonalize_np(p))
 
     # rank-1 tensors: uncompressed allreduce-mean (reducer.py:130-133)
     for i in rank1_idx:
@@ -65,13 +59,28 @@ def powersgd_reduce_np(
         out[i] = stacked.mean(axis=0)
         bits += 32 * template[i].size
 
-    # Q = mean_w(M_w^T P_hat); decompress P_hat Q^T (reducer.py:139-163)
+    next_qs = list(qs)
+    p_hats = [None] * len(high_idx)
+    for _round in range(1 + n_power_iterations):
+        # P = mean_w(M_w Q); bits count the packed P buffer (reducer.py:120-128)
+        p_hats = []
+        for j, i in enumerate(high_idx):
+            mats = [matricize(w[i], matricize_mode) for w in sends_per_worker]
+            p = np.mean([m @ next_qs[j] for m in mats], axis=0)
+            bits += 32 * p.size
+            p_hats.append(orthogonalize_np(p))
+
+        # Q = mean_w(M_w^T P_hat) (reducer.py:139-147)
+        next_qs = []
+        for j, i in enumerate(high_idx):
+            mats = [matricize(w[i], matricize_mode) for w in sends_per_worker]
+            q = np.mean([m.T @ p_hats[j] for m in mats], axis=0)
+            bits += 32 * q.size
+            next_qs.append(q)
+
+    # decompress P_hat Q^T (reducer.py:157-163)
     for j, i in enumerate(high_idx):
-        mats = [matricize(w[i], matricize_mode) for w in sends_per_worker]
-        q = np.mean([m.T @ p_hats[j] for m in mats], axis=0)
-        bits += 32 * q.size
-        next_qs.append(q)
-        out[i] = (p_hats[j] @ q.T).reshape(template[i].shape)
+        out[i] = (p_hats[j] @ next_qs[j].T).reshape(template[i].shape)
 
     memories = []
     for w in sends_per_worker:
