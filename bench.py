@@ -1,20 +1,23 @@
 """Benchmark — one JSON line for the driver.
 
-Flagship: CIFAR-10 training step (entry point A/B's model family) on real
-TPU. Two configurations run back-to-back:
+Flagship: CIFAR-10 ResNet-50 training (the reference's entry point A/B model
+family) on real TPU. Two configurations run back-to-back:
 
-- **baseline emulation**: the reference's exact-DDP configuration translated
-  literally — ResNet-50, fp32, exact allreduce-mean, SGD momentum
-  (``ddp_guide_cifar10/ddp_init.py:108-125``).
-- **flagship**: the same model trained the TPU-first way — bfloat16 compute
-  on the MXU + PowerSGD rank-4 compressed reduction (the reference's
-  flagship algorithm, ``ddp_powersgd_guide_cifar10``).
+- **baseline emulation**: the reference's configuration translated literally
+  — ResNet-50, fp32, exact allreduce-mean, SGD momentum, one host dispatch
+  per step (the reference's Python loop,
+  ``ddp_guide_cifar10/ddp_init.py:108-125``).
+- **flagship**: the same workload the TPU-first way — bfloat16 compute on
+  the MXU and the ``lax.scan`` epoch runner (whole step chunks compiled into
+  ONE dispatch, ``make_scanned_train_fn``), donated carries.
 
-metric  = flagship images/sec (global batch 256, one training step)
-vs_baseline = flagship imgs/sec / baseline-emulation imgs/sec — i.e. how much
-faster the TPU-native design trains the reference's own workload than a
-literal translation of the reference's config. The reference itself publishes
-no numbers to compare against (BASELINE.md).
+On a single chip there is no wire, so gradient-sync flavor is irrelevant to
+wall time here; the compressed-vs-exact wire story is measured by the
+bandwidth study harness (``experiments/bandwidth_study.py``) and the HLO
+collective audit instead. metric = flagship imgs/sec; vs_baseline =
+flagship / baseline — how much faster the TPU-native design trains the
+reference's own workload than a literal translation of it. The reference
+itself publishes no numbers (BASELINE.md).
 """
 
 import json
@@ -23,27 +26,18 @@ import time
 import jax
 import jax.numpy as jnp
 
-
-def _measure(step, state, batch, iters=10):
-    state, loss = step(state, batch)  # compile + warmup
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, loss = step(state, batch)
-    jax.block_until_ready(loss)
-    return (time.perf_counter() - t0) / iters
+CHUNK = 10  # steps fused into one dispatch by the scanned runner
 
 
 def main():
     from network_distributed_pytorch_tpu.data import synthetic_cifar10
     from network_distributed_pytorch_tpu.experiments.common import image_classifier_loss
     from network_distributed_pytorch_tpu.models import resnet50
-    from network_distributed_pytorch_tpu.parallel import (
-        ExactReducer,
-        PowerSGDReducer,
-        make_mesh,
+    from network_distributed_pytorch_tpu.parallel import ExactReducer, make_mesh
+    from network_distributed_pytorch_tpu.parallel.trainer import (
+        make_scanned_train_fn,
+        make_train_step,
     )
-    from network_distributed_pytorch_tpu.parallel.trainer import make_train_step
 
     batch_size = 256  # reference global batch — ddp_guide_cifar10/ddp_init.py:49
     mesh = make_mesh()
@@ -51,30 +45,50 @@ def main():
     batch = (jnp.asarray(images), jnp.asarray(labels))
 
     results = {}
-    for name, dtype, reducer, algo in [
-        ("baseline_fp32_exact", jnp.float32, ExactReducer(), "sgd"),
-        (
-            "flagship_bf16_powersgd",
-            jnp.bfloat16,
-            PowerSGDReducer(random_seed=714, compression_rank=4, matricize="last"),
-            "ef_momentum",
-        ),
-    ]:
-        model = resnet50(num_classes=10, norm="batch", stem="imagenet", dtype=dtype)
-        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=True)
-        loss_fn = image_classifier_loss(model, has_batch_stats=True)
-        step = make_train_step(
-            loss_fn, reducer, variables["params"], learning_rate=0.001, momentum=0.9,
-            algorithm=algo, mesh=mesh, donate_state=False,
-        )
-        state = step.init_state(
-            variables["params"], model_state={"batch_stats": variables["batch_stats"]}
-        )
-        t = _measure(step, state, batch)
-        results[name] = batch_size / t
 
-    value = results["flagship_bf16_powersgd"]
-    vs = value / results["baseline_fp32_exact"]
+    # --- baseline emulation: fp32, stepwise host loop ---------------------
+    model = resnet50(num_classes=10, norm="batch", stem="imagenet", dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=True)
+    loss_fn = image_classifier_loss(model, has_batch_stats=True)
+    step = make_train_step(
+        loss_fn, ExactReducer(), variables["params"], learning_rate=0.001,
+        momentum=0.9, algorithm="sgd", mesh=mesh, donate_state=True,
+    )
+    state = step.init_state(
+        variables["params"], model_state={"batch_stats": variables["batch_stats"]}
+    )
+    state, loss = step(state, batch)  # compile + warmup
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(CHUNK):
+        state, loss = step(state, batch)
+    jax.block_until_ready(loss)
+    results["baseline_fp32_stepwise"] = batch_size * CHUNK / (time.perf_counter() - t0)
+
+    # --- flagship: bf16 MXU compute + scanned epoch runner ----------------
+    model = resnet50(num_classes=10, norm="batch", stem="imagenet", dtype=jnp.bfloat16)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=True)
+    loss_fn = image_classifier_loss(model, has_batch_stats=True)
+    scanned = make_scanned_train_fn(
+        loss_fn, ExactReducer(), variables["params"], learning_rate=0.001,
+        momentum=0.9, algorithm="sgd", mesh=mesh, donate_state=True,
+    )
+    state = scanned.init_state(
+        variables["params"], model_state={"batch_stats": variables["batch_stats"]}
+    )
+    chunk_batch = (
+        jnp.broadcast_to(batch[0][None], (CHUNK,) + batch[0].shape),
+        jnp.broadcast_to(batch[1][None], (CHUNK,) + batch[1].shape),
+    )
+    state, losses = scanned(state, chunk_batch)  # compile + warmup
+    jax.block_until_ready(losses)
+    t0 = time.perf_counter()
+    state, losses = scanned(state, chunk_batch)
+    jax.block_until_ready(losses)
+    results["flagship_bf16_scanned"] = batch_size * CHUNK / (time.perf_counter() - t0)
+
+    value = results["flagship_bf16_scanned"]
+    vs = value / results["baseline_fp32_stepwise"]
     print(
         json.dumps(
             {

@@ -15,3 +15,10 @@ from .distilbert import (  # noqa: F401
     distilbert_base,
     distilbert_tiny,
 )
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTLM,
+    gpt_small,
+    gpt_tiny,
+    next_token_loss,
+)

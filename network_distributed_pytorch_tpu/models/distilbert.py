@@ -51,6 +51,8 @@ class DistilBertConfig:
     # Which sequence-parallel attention schedule to use when seq_axis is set:
     # "ring" (K/V ppermute rotation, neighbor ICI hops) or "ulysses"
     # (head<->sequence all_to_all, 4 collectives; needs n_heads % shards == 0).
+    # NOTE: both schedules are flash-style (the attention-weight matrix never
+    # materializes), so attention_dropout is not applied on this path.
     seq_impl: str = "ring"
 
 

@@ -1,0 +1,163 @@
+"""GPT-style decoder LM — first-party flax implementation, TPU-first.
+
+Beyond-parity model family: the reference's only transformer is an encoder
+classifier consumed from HuggingFace (DistilBERT,
+``ddp_powersgd_distillBERT_IMDb/ddp_init.py:150``); it has no generative /
+decoder model and handles long sequences by truncation
+(``ddp_init.py:74-77``). This adds the canonical decoder (GPT-2 layout:
+pre-LN blocks, learned positions, weight-tied LM head — Radford et al. 2019)
+with the framework's long-context machinery built in:
+
+- ``seq_axis``: shard the sequence dimension over a mesh axis; causal
+  attention runs as ring attention (K/V ``ppermute`` rotation) or
+  DeepSpeed-Ulysses (head↔sequence ``all_to_all``) from
+  ``parallel.sequence`` — both EXACT, so a sequence-sharded forward matches
+  the single-device forward.
+- ``dtype``: bfloat16 matmuls on the MXU with fp32 params.
+- fully static shapes, attention as plain einsum for XLA fusion.
+
+For training, shift host-side (``inputs = tokens[:, :-1]``,
+``labels = tokens[:, 1:]``) so the model stays shift-agnostic and the same
+next-token CE works sharded and unsharded.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    max_position_embeddings: int = 1024
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    hidden_dim: int = 3072
+    dropout: float = 0.1
+    dtype: Any = jnp.float32
+    # sequence/context parallelism (see DistilBertConfig.seq_axis): mesh axis
+    # the sequence is sharded over, and which exact schedule to run on it.
+    # NOTE: like flash attention, the sequence-parallel schedules never
+    # materialize the attention-weight matrix, so attention-weight dropout is
+    # not applied on this path (residual/FFN dropout still is) — sharded and
+    # unsharded training regularize slightly differently when dropout > 0.
+    seq_axis: Any = None
+    seq_impl: str = "ring"
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool):
+        cfg = self.config
+        head_dim = cfg.dim // cfg.n_heads
+        dense = lambda feats, name: nn.Dense(feats, dtype=cfg.dtype, name=name)
+        q = dense(cfg.dim, "q_proj")(x)
+        k = dense(cfg.dim, "k_proj")(x)
+        v = dense(cfg.dim, "v_proj")(x)
+
+        def split(t):
+            return t.reshape(t.shape[0], t.shape[1], cfg.n_heads, head_dim)
+
+        q, k, v = split(q), split(k), split(v)
+        if cfg.seq_axis is not None:
+            from ..parallel.sequence import ring_attention, ulysses_attention
+
+            impls = {"ring": ring_attention, "ulysses": ulysses_attention}
+            if cfg.seq_impl not in impls:
+                raise ValueError(
+                    f"GPTConfig.seq_impl={cfg.seq_impl!r}: valid values are"
+                    f" {sorted(impls)}"
+                )
+            ctx = impls[cfg.seq_impl](q, k, v, cfg.seq_axis, causal=True)
+        else:
+            t = x.shape[1]
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+                head_dim
+            ).astype(cfg.dtype)
+            causal = jnp.tril(jnp.ones((t, t), bool))
+            scores = jnp.where(causal[None, None], scores, -jnp.inf)
+            weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
+                cfg.dtype
+            )
+            weights = nn.Dropout(cfg.dropout)(weights, deterministic=deterministic)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+        ctx = ctx.reshape(ctx.shape[0], ctx.shape[1], cfg.dim)
+        return dense(cfg.dim, "out_proj")(ctx)
+
+
+class GPTBlock(nn.Module):
+    """Pre-LN block (GPT-2): x + attn(LN(x)); x + mlp(LN(x))."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool):
+        cfg = self.config
+        a = CausalSelfAttention(cfg, name="attn")(
+            nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype, name="ln_1")(x),
+            deterministic,
+        )
+        x = x + a
+        h = nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype, name="ln_2")(x)
+        h = nn.Dense(cfg.hidden_dim, dtype=cfg.dtype, name="mlp_fc")(h)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(cfg.dim, dtype=cfg.dtype, name="mlp_proj")(h)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return x + h
+
+
+class GPTLM(nn.Module):
+    """Decoder LM: tokens -> next-token logits, LM head weight-tied to the
+    token embedding (GPT-2)."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic: bool = True):
+        cfg = self.config
+        wte = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype, name="wte")
+        positions = jnp.arange(input_ids.shape[1])[None, :]
+        if cfg.seq_axis is not None:
+            positions = (
+                positions + jax.lax.axis_index(cfg.seq_axis) * input_ids.shape[1]
+            )
+        x = wte(input_ids)
+        x = x + nn.Embed(
+            cfg.max_position_embeddings, cfg.dim, dtype=cfg.dtype, name="wpe"
+        )(positions)
+        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+        for i in range(cfg.n_layers):
+            x = GPTBlock(cfg, name=f"h_{i}")(x, deterministic)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype, name="ln_f")(x)
+        logits = wte.attend(x)  # weight-tied LM head
+        return logits.astype(jnp.float32)
+
+
+def gpt_small(dtype=jnp.float32, **overrides) -> GPTLM:
+    """GPT-2 small shape (124M)."""
+    return GPTLM(GPTConfig(dtype=dtype, **overrides))
+
+
+def gpt_tiny(dtype=jnp.float32, **overrides) -> GPTLM:
+    """Test-tier decoder: 2 layers, 4 heads, dim 32."""
+    cfg = dict(
+        vocab_size=128, max_position_embeddings=128, dim=32, n_layers=2,
+        n_heads=4, hidden_dim=64, dropout=0.0,
+    )
+    cfg.update(overrides)
+    return GPTLM(GPTConfig(dtype=dtype, **cfg))
+
+
+def next_token_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean next-token cross-entropy; ``labels`` already shifted host-side."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

@@ -28,6 +28,14 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _ensure_varying(x: jax.Array, axis_name: str) -> jax.Array:
+    """pcast to device-varying over ``axis_name``; no-op if already varying."""
+    try:
+        return lax.pcast(x, axis_name, to="varying")
+    except ValueError:  # already varying over axis_name
+        return x
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -57,6 +65,10 @@ def ring_attention(
 
     if mask is None:
         mask = jnp.zeros((b, tk), jnp.float32)
+    # the mask rides the ring (ppermute) in the loop carry, so its type must
+    # be device-varying from the start — normalize unconditionally (a caller
+    # may pass a replicated mask, e.g. explicit zeros for "no padding")
+    mask = _ensure_varying(mask, axis_name)
 
     q32 = q.astype(jnp.float32)
     # running (max, normalizer, numerator) per query position/head — marked

@@ -47,6 +47,37 @@ def _mb_loss(out, label):
     return jnp.mean((out - label) ** 2)
 
 
+PARAM_SPECS = {
+    "w_up": P("pipe", None, "model"),
+    "b_up": P("pipe", "model"),
+    "w_down": P("pipe", "model", None),
+    "b_down": P("pipe", None),
+}
+
+
+def _make_3d_fit():
+    """The composed training step: 1F1B pipeline of TP-MLP stages over
+    ('data','pipe','model'), grads pmean'd over data."""
+    mesh = make_mesh((N_DATA, N_PIPE, N_MODEL), ("data", "pipe", "model"))
+    pipe_fn = make_pipeline_train_fn(
+        _tp_stage, _mb_loss, "pipe", MICRO, params_varying_over=("data",)
+    )
+
+    def step(stacked, x, y):
+        loss, grads = pipe_fn(stacked, x, y)
+        grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, "data"), grads)
+        return lax.pmean(loss, "data"), grads
+
+    return jax.jit(
+        jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(PARAM_SPECS, P("data"), P("data")),
+            out_specs=(P(), PARAM_SPECS),
+        )
+    )
+
+
 def test_dp_pp_tp_training_step_matches_single_device(devices):
     stages = [_stage_params(70 + s) for s in range(N_PIPE)]
     stacked = stacked_stage_params(stages)
@@ -61,31 +92,7 @@ def test_dp_pp_tp_training_step_matches_single_device(devices):
 
     ref_l, ref_g = jax.value_and_grad(ref_loss)(stages, x, y)
 
-    mesh = make_mesh((N_DATA, N_PIPE, N_MODEL), ("data", "pipe", "model"))
-    pipe_fn = make_pipeline_train_fn(
-        _tp_stage, _mb_loss, "pipe", MICRO, params_varying_over=("data",)
-    )
-
-    def step(stacked, x, y):
-        loss, grads = pipe_fn(stacked, x, y)
-        # data-parallel reduction of the pipeline/TP gradients
-        grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, "data"), grads)
-        return lax.pmean(loss, "data"), grads
-
-    param_specs = {
-        "w_up": P("pipe", None, "model"),
-        "b_up": P("pipe", "model"),
-        "w_down": P("pipe", "model", None),
-        "b_down": P("pipe", None),
-    }
-    loss, grads = jax.jit(
-        jax.shard_map(
-            step,
-            mesh=mesh,
-            in_specs=(param_specs, P("data"), P("data")),
-            out_specs=(P(), param_specs),
-        )
-    )(stacked, x, y)
+    loss, grads = _make_3d_fit()(stacked, x, y)
 
     np.testing.assert_allclose(float(loss), float(ref_l), rtol=2e-5)
     # shard_map reassembles the sharded grads into full global arrays
@@ -105,30 +112,7 @@ def test_dp_pp_tp_trains(devices):
     x = jnp.asarray(np.random.RandomState(5).randn(B, DIM), jnp.float32)
     y = jnp.asarray(np.random.RandomState(6).randn(B, DIM), jnp.float32)
 
-    mesh = make_mesh((N_DATA, N_PIPE, N_MODEL), ("data", "pipe", "model"))
-    pipe_fn = make_pipeline_train_fn(
-        _tp_stage, _mb_loss, "pipe", MICRO, params_varying_over=("data",)
-    )
-
-    def step(stacked, x, y):
-        loss, grads = pipe_fn(stacked, x, y)
-        grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, "data"), grads)
-        return lax.pmean(loss, "data"), grads
-
-    param_specs = {
-        "w_up": P("pipe", None, "model"),
-        "b_up": P("pipe", "model"),
-        "w_down": P("pipe", "model", None),
-        "b_down": P("pipe", None),
-    }
-    fit = jax.jit(
-        jax.shard_map(
-            step,
-            mesh=mesh,
-            in_specs=(param_specs, P("data"), P("data")),
-            out_specs=(P(), param_specs),
-        )
-    )
+    fit = _make_3d_fit()
     losses = []
     for _ in range(30):
         loss, grads = fit(stacked, x, y)

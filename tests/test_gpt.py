@@ -1,0 +1,100 @@
+"""GPT decoder LM: causality, sequence-parallel exactness (ring + Ulysses
+causal), and DDP training on a synthetic language task."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from network_distributed_pytorch_tpu.models.gpt import (
+    GPTConfig,
+    GPTLM,
+    gpt_tiny,
+    next_token_loss,
+)
+from network_distributed_pytorch_tpu.parallel import ExactReducer, make_mesh
+from network_distributed_pytorch_tpu.parallel.trainer import (
+    make_train_step,
+    stateless_loss,
+)
+
+N = 8
+T = 8 * N  # global sequence length (8 tokens per shard)
+
+
+def _tokens(seed, b=2, t=T, vocab=128):
+    return jnp.asarray(
+        np.random.RandomState(seed).randint(0, vocab, (b, t)), jnp.int32
+    )
+
+
+def test_causality(devices):
+    """Changing future tokens must not change past logits."""
+    model = gpt_tiny()
+    ids = _tokens(0, b=1, t=16)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    out1 = model.apply({"params": params}, ids)
+    ids2 = ids.at[:, 10:].set((ids[:, 10:] + 7) % 128)
+    out2 = model.apply({"params": params}, ids2)
+    np.testing.assert_allclose(
+        np.asarray(out1[:, :10]), np.asarray(out2[:, :10]), atol=1e-5
+    )
+    assert float(jnp.abs(out1[:, 10:] - out2[:, 10:]).max()) > 1e-3
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_seq_parallel_forward_matches_single_device(devices, impl):
+    overrides = dict(max_position_embeddings=T)
+    if impl == "ulysses":
+        overrides.update(n_heads=N, dim=2 * N, hidden_dim=4 * N)
+    base = gpt_tiny(**overrides)
+    ids = _tokens(1)
+    params = base.init(jax.random.PRNGKey(0), ids[:, :8])["params"]
+    ref = base.apply({"params": params}, ids)
+
+    mesh = make_mesh(axis_sizes=(N,), axis_names=("seq",))
+    sharded_model = gpt_tiny(seq_axis="seq", seq_impl=impl, **overrides)
+    out = jax.jit(
+        jax.shard_map(
+            lambda p, i: sharded_model.apply({"params": p}, i),
+            mesh=mesh,
+            in_specs=(P(), P(None, "seq")),
+            out_specs=P(None, "seq"),
+        )
+    )(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_gpt_ddp_training_learns(devices):
+    """Exact-DDP training on a deterministic next-token task (cyclic
+    sequences => the next token is fully predictable)."""
+    model = gpt_tiny(vocab_size=16, max_position_embeddings=32)
+    rng = np.random.RandomState(0)
+
+    def batch(seed, b=16, t=32):
+        start = np.random.RandomState(seed).randint(0, 16, (b, 1))
+        toks = (start + np.arange(t + 1)[None, :]) % 16
+        toks = jnp.asarray(toks, jnp.int32)
+        return toks[:, :-1], toks[:, 1:]
+
+    ids, _ = batch(0)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+
+    def loss_fn(params, b):
+        x, y = b
+        return next_token_loss(model.apply({"params": params}, x), y)
+
+    mesh = make_mesh()
+    step = make_train_step(
+        stateless_loss(loss_fn), ExactReducer(), params, learning_rate=0.1,
+        momentum=0.9, algorithm="sgd", mesh=mesh, donate_state=False,
+    )
+    state = step.init_state(params)
+    losses = []
+    for i in range(30):
+        state, loss = step(state, batch(i % 4))
+        losses.append(float(loss))
+    assert losses[-1] < 0.3 * losses[0], losses[::6]
