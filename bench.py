@@ -21,25 +21,35 @@ itself publishes no numbers (BASELINE.md).
 """
 
 import json
+import os
 import time
 
 import jax
 import jax.numpy as jnp
 
-CHUNK = 10  # steps fused into one dispatch by the scanned runner
+CHUNK = int(os.environ.get("BENCH_CHUNK", "10"))  # steps per scanned dispatch
 
 
 def main():
     from network_distributed_pytorch_tpu.data import synthetic_cifar10
     from network_distributed_pytorch_tpu.experiments.common import image_classifier_loss
-    from network_distributed_pytorch_tpu.models import resnet50
+    from network_distributed_pytorch_tpu.models import resnet18, resnet50
     from network_distributed_pytorch_tpu.parallel import ExactReducer, make_mesh
     from network_distributed_pytorch_tpu.parallel.trainer import (
         make_scanned_train_fn,
         make_train_step,
     )
 
-    batch_size = 256  # reference global batch — ddp_guide_cifar10/ddp_init.py:49
+    # BENCH_PRESET=small: CPU-feasible smoke tier (CI / harness validation);
+    # default is the reference's full config on the real chip.
+    small = os.environ.get("BENCH_PRESET") == "small"
+    make_model = (
+        (lambda dtype: resnet18(num_classes=10, norm="batch", stem="cifar", width=8, dtype=dtype))
+        if small
+        else (lambda dtype: resnet50(num_classes=10, norm="batch", stem="imagenet", dtype=dtype))
+    )
+    # reference global batch — ddp_guide_cifar10/ddp_init.py:49
+    batch_size = 32 if small else 256
     mesh = make_mesh()
     images, labels = synthetic_cifar10(batch_size, seed=0)
     batch = (jnp.asarray(images), jnp.asarray(labels))
@@ -47,7 +57,7 @@ def main():
     results = {}
 
     # --- baseline emulation: fp32, stepwise host loop ---------------------
-    model = resnet50(num_classes=10, norm="batch", stem="imagenet", dtype=jnp.float32)
+    model = make_model(jnp.float32)
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=True)
     loss_fn = image_classifier_loss(model, has_batch_stats=True)
     step = make_train_step(
@@ -66,7 +76,7 @@ def main():
     results["baseline_fp32_stepwise"] = batch_size * CHUNK / (time.perf_counter() - t0)
 
     # --- flagship: bf16 MXU compute + scanned epoch runner ----------------
-    model = resnet50(num_classes=10, norm="batch", stem="imagenet", dtype=jnp.bfloat16)
+    model = make_model(jnp.bfloat16)
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=True)
     loss_fn = image_classifier_loss(model, has_batch_stats=True)
     scanned = make_scanned_train_fn(

@@ -18,7 +18,11 @@ from .distilbert import (  # noqa: F401
 from .gpt import (  # noqa: F401
     GPTConfig,
     GPTLM,
+    gpt_embed_apply,
+    gpt_head_apply,
     gpt_small,
     gpt_tiny,
+    make_gpt_stage_fn,
     next_token_loss,
+    split_gpt_params,
 )

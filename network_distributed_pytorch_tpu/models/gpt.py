@@ -161,3 +161,99 @@ def next_token_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+# ---- pipeline-parallel decomposition ------------------------------------
+#
+# The homogeneous-stage pipeline (parallel.pipeline) wants stage_fn(params,
+# activation) with a shape-preserving activation. A GPT decomposes naturally:
+# embedding (cheap, replicated on every pipe rank) -> n_stages stages of
+# n_layers/n_stages pre-LN blocks (pipelined over the 'pipe' axis) -> final
+# LN + weight-tied head (replicated). Only the blocks carry the FLOPs, so
+# this pipelines >95% of the model while keeping stages homogeneous.
+#
+# Training scope: make_pipeline_train_fn differentiates the STAGE (block)
+# params only — embed/wpe/ln_f and the tied head enter the loss as closed-over
+# constants, so they stay frozen unless the caller adds their gradients some
+# other way (e.g. a periodic full-model fine-tune step, or GPipe
+# pipeline_apply under plain jax.grad, which differentiates everything).
+
+
+def split_gpt_params(params, n_stages: int):
+    """Split a GPTLM param tree into (embed, per-stage, final) pieces.
+
+    ``per_stage[i]['layers']`` stacks that stage's blocks on a leading axis;
+    feed the list to ``parallel.pipeline.stacked_stage_params`` and shard the
+    result over the 'pipe' mesh axis. The weight-tied LM head lives in
+    ``embed['wte']`` (as in GPTLM itself).
+    """
+    layer_names = sorted(
+        (k for k in params if k.startswith("h_")), key=lambda k: int(k[2:])
+    )
+    n_layers = len(layer_names)
+    assert n_layers % n_stages == 0, (
+        f"{n_layers} layers do not split into {n_stages} equal stages"
+    )
+    from ..parallel.pipeline import stacked_stage_params
+
+    per = n_layers // n_stages
+    embed = {"wte": params["wte"], "wpe": params["wpe"]}
+    stages = []
+    for s in range(n_stages):
+        blocks = [params[layer_names[s * per + j]] for j in range(per)]
+        # same stacking as the stage-level helper, here over a stage's layers
+        stages.append({"layers": stacked_stage_params(blocks)})
+    final = {"ln_f": params["ln_f"]}
+    return embed, stages, final
+
+
+def make_gpt_stage_fn(config: GPTConfig, layers_per_stage: int):
+    """stage_fn(stage_params, x) applying this stage's blocks sequentially
+    (static unroll — layers_per_stage is small).
+
+    Deterministic-only: the pipeline schedules have no per-microbatch rng
+    plumbing, so block dropout cannot run here — configs with dropout > 0
+    are rejected rather than silently regularizing differently.
+    """
+    if config.dropout > 0:
+        raise ValueError(
+            "pipeline stages run deterministically (no dropout rng plumbing);"
+            " use a config with dropout=0.0"
+        )
+    block = GPTBlock(config)
+
+    def stage_fn(p, x):
+        for j in range(layers_per_stage):
+            bp = jax.tree_util.tree_map(lambda t: t[j], p["layers"])
+            x = block.apply({"params": bp}, x, True)
+        return x
+
+    return stage_fn
+
+
+def gpt_embed_apply(config: GPTConfig, embed, input_ids):
+    """The (replicated) embedding front: tokens -> block-input activations.
+    Deterministic (no dropout) — the pipeline path is an inference/training
+    building block; compose dropout outside if needed. Honors ``seq_axis``
+    (ring-offset positions), matching ``GPTLM.__call__``."""
+    x = nn.Embed(config.vocab_size, config.dim, dtype=config.dtype).apply(
+        {"params": embed["wte"]}, input_ids
+    )
+    positions = jnp.arange(input_ids.shape[1])[None, :]
+    if config.seq_axis is not None:
+        positions = (
+            positions + jax.lax.axis_index(config.seq_axis) * input_ids.shape[1]
+        )
+    x = x + nn.Embed(
+        config.max_position_embeddings, config.dim, dtype=config.dtype
+    ).apply({"params": embed["wpe"]}, positions)
+    return x
+
+
+def gpt_head_apply(config: GPTConfig, final, embed, x):
+    """The (replicated) head: final LN + weight-tied logits."""
+    x = nn.LayerNorm(epsilon=1e-5, dtype=config.dtype).apply(
+        {"params": final["ln_f"]}, x
+    )
+    logits = x @ embed["wte"]["embedding"].T.astype(config.dtype)
+    return logits.astype(jnp.float32)

@@ -1,0 +1,107 @@
+"""Pipeline parallelism on a real model: the GPT decoder split into 8 block
+stages over a 'pipe' mesh — forward exact vs the plain GPTLM forward, 1F1B
+training grads exact vs single-device autodiff."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from network_distributed_pytorch_tpu.models.gpt import (
+    gpt_embed_apply,
+    gpt_head_apply,
+    gpt_tiny,
+    make_gpt_stage_fn,
+    next_token_loss,
+    split_gpt_params,
+)
+from network_distributed_pytorch_tpu.parallel import make_mesh
+from network_distributed_pytorch_tpu.parallel.pipeline import (
+    make_pipeline_train_fn,
+    pipeline_apply,
+    stacked_stage_params,
+)
+
+N = 8
+B, T = 8, 16
+
+
+def _setup():
+    model = gpt_tiny(n_layers=N, max_position_embeddings=T)
+    ids = jnp.asarray(
+        np.random.RandomState(0).randint(0, 128, (B, T)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    return model, params, ids
+
+
+def test_gpt_pipeline_forward_matches_direct(devices):
+    model, params, ids = _setup()
+    cfg = model.config
+    ref = model.apply({"params": params}, ids)
+
+    embed, stages, final = split_gpt_params(params, N)
+    stacked = stacked_stage_params(stages)
+    stage_fn = make_gpt_stage_fn(cfg, layers_per_stage=1)
+    mesh = make_mesh(axis_sizes=(N,), axis_names=("pipe",))
+
+    def fwd(stacked, embed, final, ids):
+        x = gpt_embed_apply(cfg, embed, ids)
+        local = jax.tree_util.tree_map(lambda p: p[0], stacked)
+        x = pipeline_apply(stage_fn, local, x, "pipe", num_microbatches=4)
+        return gpt_head_apply(cfg, final, embed, x)
+
+    out = jax.jit(
+        jax.shard_map(
+            fwd, mesh=mesh,
+            in_specs=(P("pipe"), P(), P(), P()), out_specs=P(),
+        )
+    )(stacked, embed, final, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_pipeline_1f1b_grads_match_single_device(devices):
+    model, params, ids = _setup()
+    cfg = model.config
+    labels = jnp.asarray(
+        np.random.RandomState(1).randint(0, 128, (B, T)), jnp.int32
+    )
+
+    embed, stages, final = split_gpt_params(params, N)
+    stacked = stacked_stage_params(stages)
+    stage_fn = make_gpt_stage_fn(cfg, layers_per_stage=1)
+
+    def mb_loss(act, lab):
+        return next_token_loss(gpt_head_apply(cfg, final, embed, act), lab)
+
+    # reference: plain autodiff wrt the per-layer block params
+    def ref_loss(stages_list, ids, labels):
+        x = gpt_embed_apply(cfg, embed, ids)
+        for sp in stages_list:
+            x = stage_fn(sp, x)
+        return mb_loss(x, labels)
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(stages, ids, labels)
+
+    mesh = make_mesh(axis_sizes=(N,), axis_names=("pipe",))
+    train = make_pipeline_train_fn(stage_fn, mb_loss, "pipe", num_microbatches=4)
+
+    def fn(stacked, ids, labels):
+        x = gpt_embed_apply(cfg, embed, ids)
+        return train(stacked, x, labels)
+
+    loss, grads = jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(P("pipe"), P(), P()), out_specs=(P(), P("pipe")),
+        )
+    )(stacked, ids, labels)
+
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=2e-5)
+    ref_stacked = stacked_stage_params(ref_g)
+    for a, e in zip(
+        jax.tree_util.tree_leaves(grads), jax.tree_util.tree_leaves(ref_stacked)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(e), rtol=5e-4, atol=1e-5
+        )
