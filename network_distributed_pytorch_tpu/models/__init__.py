@@ -18,10 +18,13 @@ from .distilbert import (  # noqa: F401
 from .gpt import (  # noqa: F401
     GPTConfig,
     GPTLM,
+    generate,
+    gpt_decode_step,
     gpt_embed_apply,
     gpt_head_apply,
     gpt_small,
     gpt_tiny,
+    init_gpt_cache,
     make_gpt_stage_fn,
     next_token_loss,
     split_gpt_params,

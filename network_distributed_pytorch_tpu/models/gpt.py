@@ -257,3 +257,179 @@ def gpt_head_apply(config: GPTConfig, final, embed, x):
     )
     logits = x @ embed["wte"]["embedding"].T.astype(config.dtype)
     return logits.astype(jnp.float32)
+
+
+# ---- autoregressive decoding (KV cache) ---------------------------------
+#
+# The reference has no generative path at all; this completes the decoder
+# family. TPU-first decode: a fixed-capacity K/V cache per layer (static
+# shapes), one-token decode steps that attend to the cache under a
+# position mask, and the whole prefill+sample loop as ONE lax.scan inside
+# jit — no per-token host dispatch, no dynamic shapes.
+
+
+def init_gpt_cache(config: GPTConfig, batch: int, max_len: int):
+    """Per-layer K/V cache: zeros of (B, max_len, H, D)."""
+    head_dim = config.dim // config.n_heads
+    shape = (batch, max_len, config.n_heads, head_dim)
+    return [
+        {
+            "k": jnp.zeros(shape, config.dtype),
+            "v": jnp.zeros(shape, config.dtype),
+        }
+        for _ in range(config.n_layers)
+    ]
+
+
+def _apply_dense(cfg, p, h):
+    return nn.Dense(p["kernel"].shape[-1], dtype=cfg.dtype).apply({"params": p}, h)
+
+
+def _apply_ln(cfg, p, h):
+    return nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype).apply({"params": p}, h)
+
+
+def gpt_decode_step(config: GPTConfig, params, cache, tokens, pos):
+    """One decode step: ``tokens`` (B,) at position ``pos`` -> (logits (B, V),
+    updated cache). Attends to cache positions <= pos (static shapes; the
+    mask does the truncation). The input cache is not mutated — a new one is
+    returned (so callers can snapshot for beam/speculative branching)."""
+    cfg = config
+    head_dim = cfg.dim // cfg.n_heads
+    max_len = cache[0]["k"].shape[1]
+
+    apply_dense = lambda p, h: _apply_dense(cfg, p, h)
+    apply_ln = lambda p, h: _apply_ln(cfg, p, h)
+
+    x = params["wte"]["embedding"][tokens].astype(cfg.dtype)  # (B, dim)
+    x = x + params["wpe"]["embedding"][pos].astype(cfg.dtype)
+
+    cache = list(cache)
+    for i in range(cfg.n_layers):
+        bp = params[f"h_{i}"]
+        h = apply_ln(bp["ln_1"], x)
+        q = apply_dense(bp["attn"]["q_proj"], h).reshape(-1, cfg.n_heads, head_dim)
+        k = apply_dense(bp["attn"]["k_proj"], h).reshape(-1, cfg.n_heads, head_dim)
+        v = apply_dense(bp["attn"]["v_proj"], h).reshape(-1, cfg.n_heads, head_dim)
+        cache[i] = {
+            "k": jax.lax.dynamic_update_slice_in_dim(
+                cache[i]["k"], k[:, None], pos, axis=1
+            ),
+            "v": jax.lax.dynamic_update_slice_in_dim(
+                cache[i]["v"], v[:, None], pos, axis=1
+            ),
+        }
+        scores = jnp.einsum(
+            "bhd,bthd->bht", q.astype(jnp.float32),
+            cache[i]["k"].astype(jnp.float32),
+        ) / jnp.sqrt(head_dim)
+        valid = jnp.arange(max_len) <= pos
+        scores = jnp.where(valid[None, None, :], scores, -jnp.inf)
+        weights = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum(
+            "bht,bthd->bhd", weights, cache[i]["v"].astype(jnp.float32)
+        ).astype(cfg.dtype)
+        x = x + apply_dense(
+            bp["attn"]["out_proj"], ctx.reshape(-1, cfg.dim)
+        )
+        h = apply_ln(bp["ln_2"], x)
+        h = apply_dense(bp["mlp_fc"], h)
+        h = nn.gelu(h, approximate=True)
+        x = x + apply_dense(bp["mlp_proj"], h)
+
+    x = apply_ln(params["ln_f"], x)
+    logits = x @ params["wte"]["embedding"].T.astype(cfg.dtype)
+    return logits.astype(jnp.float32), cache
+
+
+def gpt_prefill(config: GPTConfig, params, prompt_ids: jax.Array, max_len: int):
+    """Fill the K/V cache for the whole prompt in ONE batched forward
+    (position-parallel — the MXU sees (B, T_prompt) matmuls, not T_prompt
+    sequential one-token ticks). Returns ``(last_logits (B, V), cache)`` with
+    cache positions ``< T_prompt`` populated."""
+    cfg = config
+    head_dim = cfg.dim // cfg.n_heads
+    b, t = prompt_ids.shape
+    apply_dense = lambda p, h: _apply_dense(cfg, p, h)
+    apply_ln = lambda p, h: _apply_ln(cfg, p, h)
+
+    x = params["wte"]["embedding"][prompt_ids].astype(cfg.dtype)  # (B, T, dim)
+    x = x + params["wpe"]["embedding"][jnp.arange(t)][None].astype(cfg.dtype)
+
+    cache = init_gpt_cache(cfg, b, max_len)
+    causal = jnp.tril(jnp.ones((t, t), bool))
+    for i in range(cfg.n_layers):
+        bp = params[f"h_{i}"]
+        h = apply_ln(bp["ln_1"], x)
+        split = lambda y: y.reshape(b, t, cfg.n_heads, head_dim)
+        q = split(apply_dense(bp["attn"]["q_proj"], h))
+        k = split(apply_dense(bp["attn"]["k_proj"], h))
+        v = split(apply_dense(bp["attn"]["v_proj"], h))
+        cache[i] = {
+            "k": cache[i]["k"].at[:, :t].set(k.astype(cfg.dtype)),
+            "v": cache[i]["v"].at[:, :t].set(v.astype(cfg.dtype)),
+        }
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) / jnp.sqrt(head_dim)
+        scores = jnp.where(causal[None, None], scores, -jnp.inf)
+        weights = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum(
+            "bhqk,bkhd->bqhd", weights, v.astype(jnp.float32)
+        ).astype(cfg.dtype)
+        x = x + apply_dense(bp["attn"]["out_proj"], ctx.reshape(b, t, cfg.dim))
+        h = apply_ln(bp["ln_2"], x)
+        h = apply_dense(bp["mlp_fc"], h)
+        h = nn.gelu(h, approximate=True)
+        x = x + apply_dense(bp["mlp_proj"], h)
+
+    last = apply_ln(params["ln_f"], x[:, -1])
+    logits = last @ params["wte"]["embedding"].T.astype(cfg.dtype)
+    return logits.astype(jnp.float32), cache
+
+
+def generate(
+    config: GPTConfig,
+    params,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    key: jax.Array = None,
+):
+    """Autoregressive sampling: batched prefill of the prompt (one forward),
+    then ``max_new_tokens`` one-token decode steps as one ``lax.scan`` —
+    greedy (``temperature=0``) or temperature sampling. Returns
+    (B, max_new_tokens) sampled ids."""
+    b, t_prompt = prompt_ids.shape
+    total = t_prompt + max_new_tokens
+    assert total <= config.max_position_embeddings
+    if max_new_tokens <= 0:
+        return jnp.zeros((b, 0), jnp.int32)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    last_logits, cache = gpt_prefill(config, params, prompt_ids, total)
+
+    def sample(logits, sub):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(sub, logits / temperature, axis=-1).astype(
+            jnp.int32
+        )
+
+    key, sub = jax.random.split(key)
+    first = sample(last_logits, sub)
+
+    def step(carry, i):
+        cache, tok, key = carry
+        logits, cache = gpt_decode_step(config, params, cache, tok, t_prompt + i)
+        key, sub = jax.random.split(key)
+        nxt = sample(logits, sub)
+        return (cache, nxt, key), nxt
+
+    (_, _, _), rest = jax.lax.scan(
+        step, (cache, first, key), jnp.arange(max_new_tokens - 1)
+    )
+    return jnp.concatenate(
+        [first[:, None], jnp.moveaxis(rest, 0, 1)], axis=1
+    )

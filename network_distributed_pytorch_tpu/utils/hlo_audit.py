@@ -42,10 +42,44 @@ class CollectiveOp:
     payload_bytes: int
 
 
+_GROUPS_RE = re.compile(r"replica_groups=(?:\{\{([\d,]+)\}|\[(\d+),(\d+)\])")
+_REPLICAS_RE = re.compile(r"replica_count=(\d+)|num_partitions=(\d+)")
+
+
+def _module_world(hlo_text: str) -> int:
+    """Total participant count from the module header (replica_count /
+    num_partitions) — the fallback group size when a collective's
+    replica_groups is empty/absent, which in HLO means ALL participants."""
+    best = 1
+    for m in _REPLICAS_RE.finditer(hlo_text):
+        best = max(best, int(m.group(1) or m.group(2) or 1))
+    return best
+
+
+def _group_size(hlo_text: str, op_end: int) -> int:
+    """Replica-group size of the collective whose match ends at ``op_end``
+    (first group of `{{0,1,...},...}`, or S from the iota form `[G,S]<=[N]`).
+    Empty/absent replica_groups = one group of every participant."""
+    line_end = hlo_text.find("\n", op_end)
+    m = _GROUPS_RE.search(hlo_text, op_end, line_end if line_end != -1 else len(hlo_text))
+    if m is None:
+        return _module_world(hlo_text)
+    if m.group(1) is not None:
+        return m.group(1).count(",") + 1
+    return int(m.group(3))
+
+
 def audit_hlo(hlo_text: str) -> List[CollectiveOp]:
     """All collective ops in a compiled HLO module, with payload sizes.
     A tuple-typed (combiner-merged) collective is reported as ONE op whose
-    payload sums its components."""
+    payload sums its components.
+
+    Payload convention = the reference's ``n_bits(buffer)``
+    (``reducer.py:197-198``): the LOGICAL buffer the collective moves, from
+    the op's result type. For reduce-scatter the result is 1/N of the
+    reduced buffer, so it is scaled by the replica-group size to stay
+    consistent with all-reduce/all-gather (whose results already equal the
+    buffer)."""
     ops = []
     for m in _OP_RE.finditer(hlo_text):
         result_type, kind = m.group(1), m.group(4)
@@ -59,6 +93,8 @@ def audit_hlo(hlo_text: str) -> List[CollectiveOp]:
             payload += n * _DTYPE_BYTES.get(dtype, 4)
             shapes.append(shape)
             dtypes.append(dtype)
+        if kind == "reduce-scatter":
+            payload *= _group_size(hlo_text, m.end())
         ops.append(
             CollectiveOp(kind, "+".join(dtypes), tuple(shapes), payload)
         )

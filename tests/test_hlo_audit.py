@@ -61,3 +61,27 @@ def test_powersgd_hlo_payload_matches_analytic(devices):
     # the P / rank-1 / Q / loss collectives compile to at most 3 (Q depends
     # on allreduced-P so it cannot merge with it; the rest may combine)
     assert 2 <= s["by_kind"]["all-reduce"] <= 3
+
+
+def test_fsdp_hlo_payload_matches_analytic(devices):
+    """ZeRO-3's compiled collectives: all-gather(params) + reduce-scatter
+    (grads) payloads must equal the analytic 2x model (+ loss/model-state
+    pmeans), with the grad reduce-scatter appearing as real reduce-scatter
+    ops (psum_scatter from the AD transpose), not widened all-reduces."""
+    from network_distributed_pytorch_tpu.parallel.fsdp import make_fsdp_train_step
+
+    params, loss_fn, batch = _setup()
+    mesh = make_mesh()
+    step = make_fsdp_train_step(
+        loss_fn, params, learning_rate=0.05, momentum=0.9, algorithm="sgd",
+        mesh=mesh, donate_state=False,
+    )
+    state = step.init_state(params)
+    txt = compiled_hlo_text(step.fn, state, batch)
+    s = collective_summary(txt)
+
+    assert s["by_kind"].get("reduce-scatter", 0) >= 1, s["by_kind"]
+    assert s["by_kind"].get("all-gather", 0) >= 1, s["by_kind"]
+    # analytic: gather + scatter of every padded leaf; compiled adds the
+    # 4-byte loss pmean (model_state is {} here)
+    assert s["total_payload_bytes"] == step.bits_per_step // 8 + 4
