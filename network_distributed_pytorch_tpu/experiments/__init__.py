@@ -5,6 +5,7 @@ from . import (  # noqa: F401
     bandwidth_study,
     bare_init,
     exact_cifar10,
+    gpt_lm,
     imdb_baseline,
     powersgd_cifar10,
     powersgd_imdb,

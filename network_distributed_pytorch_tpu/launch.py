@@ -22,6 +22,7 @@ from .experiments import (
     bandwidth_study,
     bare_init,
     exact_cifar10,
+    gpt_lm,
     imdb_baseline,
     powersgd_cifar10,
     powersgd_imdb,
@@ -36,6 +37,7 @@ EXPERIMENTS = {
     "powersgd_imdb": powersgd_imdb.run,
     "imdb_baseline": imdb_baseline.run,
     "bandwidth_study": bandwidth_study.run,
+    "gpt_lm": gpt_lm.run,
 }
 
 
@@ -118,6 +120,8 @@ def main(argv=None) -> dict:
                       max_steps_per_epoch=args.max_steps_per_epoch)
     elif args.experiment == "bandwidth_study":
         kwargs.update(preset=args.preset)
+    elif args.experiment == "gpt_lm":
+        kwargs.update(preset=args.preset, max_steps_per_epoch=args.max_steps_per_epoch)
 
     result = fn(**kwargs)
     if args.json:

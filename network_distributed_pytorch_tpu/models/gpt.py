@@ -408,6 +408,9 @@ def generate(
     if key is None:
         key = jax.random.PRNGKey(0)
 
+    # freshly-imported checkpoints arrive as numpy (import_weights is
+    # torch-free); device arrays are required for traced indexing below
+    params = jax.tree_util.tree_map(jnp.asarray, params)
     last_logits, cache = gpt_prefill(config, params, prompt_ids, total)
 
     def sample(logits, sub):

@@ -144,3 +144,60 @@ def distilbert_variables_from_torch(state_dict: Mapping[str, Any], n_layers: int
         "classifier": dense("classifier"),
     }
     return {"params": params}
+
+
+def gpt2_variables_from_torch(state_dict: Mapping[str, Any], n_layers: int = None) -> Dict[str, Any]:
+    """HF GPT2LMHeadModel state_dict → flax ``{'params'}`` for ``models.gpt.GPTLM``.
+
+    HF GPT-2 uses Conv1D layers whose weights are already (in, out) — no
+    transpose — and a fused ``c_attn`` producing q/k/v concatenated on the
+    output axis, which is split into this package's separate q/k/v denses.
+    The LM head is weight-tied to ``wte`` in both implementations.
+    ``n_layers`` defaults to the count present in the checkpoint; passing a
+    smaller value than the checkpoint holds is rejected (silent truncation
+    would produce garbage logits).
+    """
+    sd = state_dict
+    pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    ckpt_layers = 1 + max(
+        (int(k[len(pfx) + 2 :].split(".")[0]) for k in sd if k.startswith(f"{pfx}h.")),
+        default=-1,
+    )
+    if n_layers is None:
+        n_layers = ckpt_layers
+    elif n_layers != ckpt_layers:
+        raise ValueError(
+            f"n_layers={n_layers} but the checkpoint has {ckpt_layers} layers"
+        )
+
+    def conv1d(prefix: str):
+        return {"kernel": _np(sd[f"{prefix}.weight"]), "bias": _np(sd[f"{prefix}.bias"])}
+
+    def ln(prefix: str):
+        return {"scale": _np(sd[f"{prefix}.weight"]), "bias": _np(sd[f"{prefix}.bias"])}
+
+    params: Dict[str, Any] = {
+        "wte": {"embedding": _np(sd[f"{pfx}wte.weight"])},
+        "wpe": {"embedding": _np(sd[f"{pfx}wpe.weight"])},
+        "ln_f": ln(f"{pfx}ln_f"),
+    }
+    for i in range(n_layers):
+        hp = f"{pfx}h.{i}"
+        c_attn = conv1d(f"{hp}.attn.c_attn")
+        dim = c_attn["kernel"].shape[0]
+        assert c_attn["kernel"].shape[1] == 3 * dim, c_attn["kernel"].shape
+        qkv_k = np.split(c_attn["kernel"], 3, axis=1)
+        qkv_b = np.split(c_attn["bias"], 3, axis=0)
+        params[f"h_{i}"] = {
+            "ln_1": ln(f"{hp}.ln_1"),
+            "attn": {
+                "q_proj": {"kernel": qkv_k[0], "bias": qkv_b[0]},
+                "k_proj": {"kernel": qkv_k[1], "bias": qkv_b[1]},
+                "v_proj": {"kernel": qkv_k[2], "bias": qkv_b[2]},
+                "out_proj": conv1d(f"{hp}.attn.c_proj"),
+            },
+            "ln_2": ln(f"{hp}.ln_2"),
+            "mlp_fc": conv1d(f"{hp}.mlp.c_fc"),
+            "mlp_proj": conv1d(f"{hp}.mlp.c_proj"),
+        }
+    return {"params": params}

@@ -4,3 +4,8 @@ from .losses import cross_entropy_loss  # noqa: F401
 from .config import ExperimentConfig  # noqa: F401
 from .metrics import MetricsLogger, StepRecord  # noqa: F401
 from .bandwidth import allreduce_time_s, bandwidth_table, format_table  # noqa: F401
+from .failure import (  # noqa: F401
+    HeartbeatMonitor,
+    StepWatchdog,
+    retry_transient,
+)

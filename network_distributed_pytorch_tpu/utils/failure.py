@@ -1,0 +1,161 @@
+"""Failure detection — the aux subsystem the reference almost has.
+
+The reference's only failure handling is a rendezvous timeout whose failure
+path prints ``[Failure] Distributed Environment Failed`` and falls through
+WITHOUT exiting (``ddp_guide_cifar10/ddp_init.py:98-99`` — the training then
+crashes later). ``mesh.initialize_distributed`` already fixes that (raises
+immediately). This module adds the detection machinery the reference lacks
+(SURVEY §5: "rendezvous timeouts only — no retry, no elasticity"):
+
+- :class:`StepWatchdog` — detects a hung training step (e.g. a peer died
+  mid-collective, so the allreduce never completes) and runs a callback on
+  the deadline. A hung XLA collective cannot be interrupted from Python, so
+  the callback's job is to REPORT (structured banner, flight-recorder dump)
+  and decide (e.g. ``os._exit`` for a supervisor restart).
+- :func:`retry_transient` — bounded retry for transient runtime errors
+  (preemption blips, tunnel hiccups) with exponential backoff.
+- :class:`HeartbeatMonitor` — file-based liveness over a shared filesystem,
+  the same substrate as the reference's ``file://`` rendezvous
+  (``ddp_guide/ddp_init.py:41``): each process beats its own file; any
+  process can list peers whose heartbeat has gone stale.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class StepWatchdog:
+    """Deadline monitor for potentially-hanging calls.
+
+    Usage::
+
+        wd = StepWatchdog(timeout_seconds=300, on_timeout=report_and_exit)
+        for batch in loader:
+            with wd.watch(f"step {i}"):
+                state, loss = step(state, batch)   # blocks on device
+
+    ``on_timeout(label)`` runs on a daemon thread when a watched region
+    exceeds the deadline; the watched call itself keeps blocking (XLA cannot
+    be interrupted) — the callback reports and/or terminates the process.
+    """
+
+    def __init__(
+        self,
+        timeout_seconds: float,
+        on_timeout: Optional[Callable[[str], None]] = None,
+    ):
+        self.timeout_seconds = timeout_seconds
+        self.on_timeout = on_timeout or self._default_report
+        self.fired: List[str] = []  # labels whose deadline passed
+
+    @staticmethod
+    def _default_report(label: str) -> None:
+        # structured version of the reference's failure banner
+        # (ddp_guide_cifar10/ddp_init.py:98) — but impossible to miss
+        print(
+            json.dumps(
+                {"event": "watchdog_timeout", "label": label, "ts": time.time()}
+            ),
+            flush=True,
+        )
+
+    class _Watch:
+        def __init__(self, wd: "StepWatchdog", label: str):
+            self.wd = wd
+            self.label = label
+            self.done = threading.Event()
+
+        def __enter__(self):
+            def monitor():
+                if not self.done.wait(self.wd.timeout_seconds):
+                    self.wd.fired.append(self.label)
+                    self.wd.on_timeout(self.label)
+
+            self.thread = threading.Thread(target=monitor, daemon=True)
+            self.thread.start()
+            return self
+
+        def __exit__(self, *exc):
+            self.done.set()
+            self.thread.join(timeout=1.0)
+            return False
+
+    def watch(self, label: str = "step") -> "_Watch":
+        return self._Watch(self, label)
+
+
+def retry_transient(
+    fn: Callable,
+    retries: int = 3,
+    backoff_seconds: float = 1.0,
+    exceptions=(RuntimeError,),
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+):
+    """Call ``fn()``; on a transient error retry up to ``retries`` times with
+    exponential backoff. Re-raises the last error when exhausted. The
+    reference has no retry anywhere (SURVEY §5)."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except exceptions as e:  # noqa: PERF203
+            attempt += 1
+            if attempt > retries:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            time.sleep(backoff_seconds * (2 ** (attempt - 1)))
+
+
+class HeartbeatMonitor:
+    """Liveness via per-process heartbeat files on a shared filesystem.
+
+    The multi-host analogue of the reference's ``file://`` rendezvous
+    directory: process i touches ``<dir>/heartbeat_<i>.json`` every
+    ``interval``; `stale_peers(threshold)` lists processes whose last beat is
+    older than ``threshold`` seconds (or that never beat at all).
+    """
+
+    def __init__(self, directory: str, process_id: int, num_processes: int):
+        self.directory = directory
+        self.process_id = process_id
+        self.num_processes = num_processes
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, pid: int) -> str:
+        return os.path.join(self.directory, f"heartbeat_{pid}.json")
+
+    def beat(self, **extra) -> None:
+        """Write this process's heartbeat (atomic rename)."""
+        payload = {"process_id": self.process_id, "ts": time.time(), **extra}
+        tmp = self._path(self.process_id) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self._path(self.process_id))
+
+    def last_beats(self) -> Dict[int, Optional[float]]:
+        """Timestamp of every process's latest beat (None = never beat)."""
+        out: Dict[int, Optional[float]] = {}
+        for pid in range(self.num_processes):
+            try:
+                with open(self._path(pid)) as f:
+                    out[pid] = json.load(f)["ts"]
+            except (OSError, ValueError, KeyError):
+                out[pid] = None
+        return out
+
+    def stale_peers(self, threshold_seconds: float) -> List[int]:
+        """Process ids (excluding self) not seen within the threshold."""
+        now = time.time()
+        stale = []
+        for pid, ts in self.last_beats().items():
+            if pid == self.process_id:
+                continue
+            if ts is None or now - ts > threshold_seconds:
+                stale.append(pid)
+        return stale

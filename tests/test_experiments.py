@@ -144,3 +144,21 @@ def test_powersgd_imdb_learns_synthetic_sentiment(devices):
     rec = out
     assert np.isfinite(rec["final_loss"])
     assert rec["final_loss"] < 0.69, rec  # below ln(2) = chance for 2 classes
+
+
+def test_gpt_lm_learns_with_powersgd(devices):
+    """The decoder family under the reference's flagship algorithm: GPT +
+    PowerSGD data parallelism learns the cyclic next-token task."""
+    from network_distributed_pytorch_tpu.experiments import gpt_lm
+
+    out = gpt_lm.run(
+        _cfg(
+            learning_rate=0.15, reducer_rank=4, global_batch_size=32,
+            training_epochs=3,
+        ),
+        preset="small",
+        seq_len=32,
+        steps_per_epoch=15,
+    )
+    assert out["final_loss"] < 0.5, out
+    assert out["bytes_communicated"] > 0

@@ -1,0 +1,74 @@
+"""Failure detection: watchdog fires on hangs (and not on fast steps),
+transient retry recovers, heartbeat staleness finds dead peers."""
+
+import time
+
+from network_distributed_pytorch_tpu.utils.failure import (
+    HeartbeatMonitor,
+    StepWatchdog,
+    retry_transient,
+)
+
+
+def test_watchdog_fires_on_slow_step():
+    fired = []
+    wd = StepWatchdog(timeout_seconds=0.1, on_timeout=fired.append)
+    with wd.watch("slow"):
+        time.sleep(0.3)
+    assert fired == ["slow"]
+    assert wd.fired == ["slow"]
+
+
+def test_watchdog_quiet_on_fast_step():
+    fired = []
+    wd = StepWatchdog(timeout_seconds=0.5, on_timeout=fired.append)
+    for i in range(3):
+        with wd.watch(f"fast {i}"):
+            time.sleep(0.01)
+    time.sleep(0.1)
+    assert fired == []
+
+
+def test_retry_transient_recovers_and_exhausts():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    seen = []
+    assert (
+        retry_transient(
+            flaky, retries=5, backoff_seconds=0.01,
+            on_retry=lambda a, e: seen.append(a),
+        )
+        == "ok"
+    )
+    assert calls["n"] == 3 and seen == [1, 2]
+
+    def always():
+        raise RuntimeError("permanent")
+
+    try:
+        retry_transient(always, retries=2, backoff_seconds=0.01)
+    except RuntimeError as e:
+        assert str(e) == "permanent"
+    else:
+        raise AssertionError("should have re-raised")
+
+
+def test_heartbeat_staleness(tmp_path):
+    a = HeartbeatMonitor(str(tmp_path), process_id=0, num_processes=3)
+    b = HeartbeatMonitor(str(tmp_path), process_id=1, num_processes=3)
+    a.beat()
+    b.beat(step=42)
+    # process 2 never beat; 0 and 1 are fresh
+    assert a.stale_peers(threshold_seconds=5.0) == [2]
+    beats = a.last_beats()
+    assert beats[0] is not None and beats[1] is not None and beats[2] is None
+    # age out process 1
+    time.sleep(0.15)
+    a.beat()
+    assert a.stale_peers(threshold_seconds=0.1) == [1, 2]

@@ -187,3 +187,33 @@ def test_distilbert_forward_parity():
         variables, jnp.asarray(ids), jnp.asarray(mask), deterministic=True
     )
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_gpt2_forward_parity():
+    transformers = pytest.importorskip("transformers")
+    from network_distributed_pytorch_tpu.models import GPTConfig, GPTLM
+    from network_distributed_pytorch_tpu.models.import_weights import (
+        gpt2_variables_from_torch,
+    )
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=160, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        n_inner=64, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        activation_function="gelu_new",
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+
+    cfg = GPTConfig(
+        vocab_size=160, max_position_embeddings=64, dim=32, n_layers=2,
+        n_heads=4, hidden_dim=64, dropout=0.0,
+    )
+    model = GPTLM(cfg)
+    variables = gpt2_variables_from_torch(hf_model.state_dict(), n_layers=2)
+
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, 160, (3, 20)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_model(input_ids=torch.from_numpy(ids).long()).logits.numpy()
+    out = model.apply(variables, jnp.asarray(ids), deterministic=True)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-3, atol=1e-4)
