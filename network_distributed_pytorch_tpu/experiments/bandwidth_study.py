@@ -68,6 +68,13 @@ def run(
             "ef_momentum",
             3,  # P, Q, rank-1 collectives — reducer.py:126-147
         )
+    # the rest of the compressor family (beyond parity): the other classic
+    # points on the bandwidth/fidelity curve, same EF-chain interface
+    from ..parallel import QSGDReducer, SignSGDReducer, TopKReducer
+
+    configs["topk_1pct"] = (TopKReducer(k_fraction=0.01), "ef_momentum", 2)
+    configs["signsgd"] = (SignSGDReducer(), "ef_momentum", 2)
+    configs["qsgd_int8"] = (QSGDReducer(random_seed=config.seed), "ef_momentum", 2)
 
     tables = {}
     results = {}

@@ -24,16 +24,40 @@ def train_loop(
     epochs: int,
     rank: int = 0,
     log_every: int = 0,
+    start_epoch: int = 0,
+    watchdog: Any = None,
+    heartbeat: Any = None,
+    on_epoch_end: Optional[Callable[[int, TrainState], None]] = None,
 ) -> Tuple[TrainState, MetricsLogger]:
     """Run ``epochs`` passes, logging loss / step-time / cumulative bits
-    (the reference's per-epoch banner + the bits it never reported)."""
+    (the reference's per-epoch banner + the bits it never reported).
+
+    Optional hooks (all default-off; :func:`resilient_train_loop` wires
+    them): a ``utils.failure.StepWatchdog`` around every step, a
+    ``utils.failure.HeartbeatMonitor`` beat per step (rate-limited by the
+    monitor itself), and an ``on_epoch_end(epoch, state)`` callback (e.g.
+    checkpointing).
+    """
+    import contextlib
+
     logger = MetricsLogger(bits_per_step=step.bits_per_step, log_every=log_every)
-    for epoch in range(epochs):
+    for epoch in range(start_epoch, epochs):
         for batch in batches_for_epoch(epoch):
             logger.start_step()
-            state, loss = step(state, batch)
-            logger.end_step(epoch, jax.device_get(loss))
+            ctx = (
+                watchdog.watch(f"epoch {epoch}")
+                if watchdog is not None
+                else contextlib.nullcontext()
+            )
+            with ctx:
+                state, loss = step(state, batch)
+                loss = jax.device_get(loss)
+            logger.end_step(epoch, loss)
+            if heartbeat is not None:
+                heartbeat.beat(epoch=epoch)
         logger.end_epoch(epoch, rank=rank)
+        if on_epoch_end is not None:
+            on_epoch_end(epoch, state)
     return state, logger
 
 
@@ -112,3 +136,59 @@ def summarize(name: str, logger: MetricsLogger, extra: Optional[Dict] = None) ->
     if extra:
         out.update(extra)
     return out
+
+
+def resilient_train_loop(
+    step: CompiledStep,
+    init_state: TrainState,
+    batches_for_epoch: Callable[[int], Iterator[Any]],
+    epochs: int,
+    checkpoint_dir: str,
+    rank: int = 0,
+    log_every: int = 0,
+    watchdog_timeout_s: Optional[float] = None,
+    heartbeat: Any = None,
+) -> Tuple[TrainState, "MetricsLogger", int]:
+    """:func:`train_loop` plus the survival kit the reference lacks entirely
+    (SURVEY §5: no checkpointing, no retry; a failed init doesn't even exit):
+
+    - on entry, resume from the newest per-epoch checkpoint under
+      ``checkpoint_dir`` (full TrainState — the EF chain continues exactly);
+    - every epoch, save one (epoch-boundary checkpoints + deterministic
+      per-epoch data streams ⇒ a crash-restart converges to the SAME state
+      as an uninterrupted run);
+    - optional :class:`utils.failure.StepWatchdog` around every step and
+      :class:`utils.failure.HeartbeatMonitor` beat per step.
+
+    Returns ``(state, logger, start_epoch)`` — ``start_epoch`` tells the
+    caller how many epochs were skipped via resume.
+    """
+    from ..utils.checkpoint import (
+        latest_step_path,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+    from ..utils.failure import StepWatchdog
+
+    state = init_state
+    start_epoch = 0
+    latest = latest_step_path(checkpoint_dir)
+    if latest is not None:
+        state = restore_checkpoint(latest, init_state)
+        start_epoch = int(latest.rsplit("step_", 1)[1]) + 1
+
+    wd = (
+        # grace on the first step: it includes XLA compilation, which may
+        # legitimately exceed a steady-state deadline
+        StepWatchdog(watchdog_timeout_s, compile_grace=1)
+        if watchdog_timeout_s is not None
+        else None
+    )
+    state, logger = train_loop(
+        step, state, batches_for_epoch, epochs, rank=rank, log_every=log_every,
+        start_epoch=start_epoch, watchdog=wd, heartbeat=heartbeat,
+        on_epoch_end=lambda epoch, st: save_checkpoint(
+            checkpoint_dir, st, step=epoch
+        ),
+    )
+    return state, logger, start_epoch

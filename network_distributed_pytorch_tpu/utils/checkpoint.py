@@ -39,6 +39,11 @@ def restore_checkpoint(path: str, template: TrainState) -> TrainState:
     the original run."""
     with ocp.StandardCheckpointer() as ckptr:
         restored = ckptr.restore(os.path.abspath(path), template)
+    # orbax hands back arrays COMMITTED to one device; the jitted shard_map
+    # step would then refuse them ("incompatible devices"). Return host
+    # arrays instead — uncommitted inputs let jit place each leaf on the
+    # step's own sharding, exactly like the freshly-initialized state.
+    restored = jax.device_get(restored)
     return TrainState(*restored) if not isinstance(restored, TrainState) else restored
 
 

@@ -39,19 +39,30 @@ class StepWatchdog:
             with wd.watch(f"step {i}"):
                 state, loss = step(state, batch)   # blocks on device
 
-    ``on_timeout(label)`` runs on a daemon thread when a watched region
-    exceeds the deadline; the watched call itself keeps blocking (XLA cannot
-    be interrupted) — the callback reports and/or terminates the process.
+    ``on_timeout(label)`` runs on the (single, long-lived) monitor thread
+    when a watched region exceeds the deadline; the watched call itself keeps
+    blocking (XLA cannot be interrupted) — the callback reports and/or
+    terminates the process. ``compile_grace`` skips monitoring the first N
+    watched regions: step 1 includes XLA compilation, which can legitimately
+    exceed a steady-state deadline (a spurious fire + supervisor restart
+    there would recompile and fire again, forever).
     """
 
     def __init__(
         self,
         timeout_seconds: float,
         on_timeout: Optional[Callable[[str], None]] = None,
+        compile_grace: int = 0,
     ):
         self.timeout_seconds = timeout_seconds
         self.on_timeout = on_timeout or self._default_report
+        self.compile_grace = compile_grace
         self.fired: List[str] = []  # labels whose deadline passed
+        self._watch_count = 0
+        self._cond = threading.Condition()
+        self._deadline: Optional[float] = None
+        self._label: Optional[str] = None
+        self._thread: Optional[threading.Thread] = None
 
     @staticmethod
     def _default_report(label: str) -> None:
@@ -64,25 +75,51 @@ class StepWatchdog:
             flush=True,
         )
 
+    def _monitor(self) -> None:
+        while True:
+            with self._cond:
+                while self._deadline is None:
+                    self._cond.wait()
+                remaining = self._deadline - time.monotonic()
+                if remaining > 0:
+                    self._cond.wait(remaining)
+                    continue
+                label = self._label
+                self._deadline = None
+                self._label = None
+            self.fired.append(label)
+            self.on_timeout(label)
+
+    def _arm(self, label: str) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._monitor, daemon=True)
+            self._thread.start()
+        with self._cond:
+            self._deadline = time.monotonic() + self.timeout_seconds
+            self._label = label
+            self._cond.notify()
+
+    def _disarm(self) -> None:
+        with self._cond:
+            self._deadline = None
+            self._label = None
+            self._cond.notify()
+
     class _Watch:
         def __init__(self, wd: "StepWatchdog", label: str):
             self.wd = wd
             self.label = label
-            self.done = threading.Event()
 
         def __enter__(self):
-            def monitor():
-                if not self.done.wait(self.wd.timeout_seconds):
-                    self.wd.fired.append(self.label)
-                    self.wd.on_timeout(self.label)
-
-            self.thread = threading.Thread(target=monitor, daemon=True)
-            self.thread.start()
+            self.wd._watch_count += 1
+            self.armed = self.wd._watch_count > self.wd.compile_grace
+            if self.armed:
+                self.wd._arm(self.label)
             return self
 
         def __exit__(self, *exc):
-            self.done.set()
-            self.thread.join(timeout=1.0)
+            if self.armed:
+                self.wd._disarm()
             return False
 
     def watch(self, label: str = "step") -> "_Watch":
@@ -116,22 +153,37 @@ class HeartbeatMonitor:
     """Liveness via per-process heartbeat files on a shared filesystem.
 
     The multi-host analogue of the reference's ``file://`` rendezvous
-    directory: process i touches ``<dir>/heartbeat_<i>.json`` every
-    ``interval``; `stale_peers(threshold)` lists processes whose last beat is
-    older than ``threshold`` seconds (or that never beat at all).
+    directory: process i touches ``<dir>/heartbeat_<i>.json`` when it beats;
+    `stale_peers(threshold)` lists processes whose last beat is older than
+    ``threshold`` seconds (or that never beat at all). ``min_interval_seconds``
+    rate-limits beats so ``beat()`` can sit in a hot training loop without a
+    filesystem write per step (beats within the interval are skipped).
     """
 
-    def __init__(self, directory: str, process_id: int, num_processes: int):
+    def __init__(
+        self,
+        directory: str,
+        process_id: int,
+        num_processes: int,
+        min_interval_seconds: float = 0.0,
+    ):
         self.directory = directory
         self.process_id = process_id
         self.num_processes = num_processes
+        self.min_interval_seconds = min_interval_seconds
+        self._last_beat = -float("inf")
         os.makedirs(directory, exist_ok=True)
 
     def _path(self, pid: int) -> str:
         return os.path.join(self.directory, f"heartbeat_{pid}.json")
 
     def beat(self, **extra) -> None:
-        """Write this process's heartbeat (atomic rename)."""
+        """Write this process's heartbeat (atomic rename); a no-op when the
+        previous beat is newer than ``min_interval_seconds``."""
+        now = time.monotonic()
+        if now - self._last_beat < self.min_interval_seconds:
+            return
+        self._last_beat = now
         payload = {"process_id": self.process_id, "ts": time.time(), **extra}
         tmp = self._path(self.process_id) + ".tmp"
         with open(tmp, "w") as f:
