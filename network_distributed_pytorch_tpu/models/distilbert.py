@@ -54,6 +54,9 @@ class DistilBertConfig:
     # NOTE: both schedules are flash-style (the attention-weight matrix never
     # materializes), so attention_dropout is not applied on this path.
     seq_impl: str = "ring"
+    # single-device attention engine: "einsum" (XLA) or "flash" (the Pallas
+    # VMEM-tiled kernel; no attention-weight dropout, as above).
+    attn_impl: str = "einsum"
 
 
 class MultiHeadSelfAttention(nn.Module):
@@ -84,6 +87,13 @@ class MultiHeadSelfAttention(nn.Module):
                     f" are {sorted(impls)}"
                 )
             ctx = impls[cfg.seq_impl](q, k, v, cfg.seq_axis, mask=mask)
+        elif cfg.attn_impl == "flash":
+            from ..ops.flash_attention import flash_attention
+
+            ctx = flash_attention(
+                q, k, v, mask=mask.astype(jnp.float32),
+                interpret=jax.default_backend() != "tpu",
+            )
         else:
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(head_dim).astype(cfg.dtype)
             # additive mask: 0 for real tokens, -inf for padding

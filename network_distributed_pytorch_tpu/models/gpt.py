@@ -49,6 +49,11 @@ class GPTConfig:
     # unsharded training regularize slightly differently when dropout > 0.
     seq_axis: Any = None
     seq_impl: str = "ring"
+    # single-device attention engine: "einsum" (XLA) or "flash" (the Pallas
+    # VMEM-tiled kernel, ops.flash_attention; interpret mode off-TPU). Like
+    # the sequence-parallel schedules it never materializes the score matrix,
+    # so attention-weight dropout does not apply on this path either.
+    attn_impl: str = "einsum"
 
 
 class CausalSelfAttention(nn.Module):
@@ -77,6 +82,13 @@ class CausalSelfAttention(nn.Module):
                     f" {sorted(impls)}"
                 )
             ctx = impls[cfg.seq_impl](q, k, v, cfg.seq_axis, causal=True)
+        elif cfg.attn_impl == "flash":
+            from ..ops.flash_attention import flash_attention
+
+            ctx = flash_attention(
+                q, k, v, causal=True,
+                interpret=jax.default_backend() != "tpu",
+            )
         else:
             t = x.shape[1]
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(

@@ -1,3 +1,5 @@
-"""TPU ops: Gram-Schmidt orthogonalization (XLA fori_loop + Pallas variants)."""
+"""TPU ops: Gram-Schmidt orthogonalization (XLA fori_loop + Pallas variants)
+and Pallas flash attention."""
 
 from .orthogonalize import orthogonalize  # noqa: F401
+from .flash_attention import flash_attention  # noqa: F401
