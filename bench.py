@@ -148,8 +148,15 @@ def _measure(results: dict) -> dict:
     )
 
     # BENCH_PRESET=small: CPU-feasible smoke tier (CI / harness validation);
-    # default is the reference's full config on the real chip.
-    small = os.environ.get("BENCH_PRESET") == "small"
+    # default is the reference's full config on the real chip. A non-TPU
+    # platform auto-selects the small tier (the full ResNet-50/batch-256
+    # config takes >10 min/step-chunk on CPU — useless as a smoke signal)
+    # unless BENCH_PRESET=full explicitly forces it.
+    preset_env = os.environ.get("BENCH_PRESET", "").lower()
+    small = preset_env == "small" or (
+        preset_env != "full" and jax.devices()[0].platform != "tpu"
+    )
+    results["preset"] = "small" if small else "full"
     make_model = (
         (lambda dtype: resnet18(num_classes=10, norm="batch", stem="cifar", width=8, dtype=dtype))
         if small
@@ -257,7 +264,7 @@ def main() -> int:
         )
     except Exception as e:
         out["error"] = f"{type(e).__name__}: {e}"[:800]
-    for k in ("mfu", "step_time_ms", "device"):
+    for k in ("mfu", "step_time_ms", "device", "preset"):
         if k in results:
             out[k] = round(results[k], 4) if isinstance(results[k], float) else results[k]
     _emit(out)

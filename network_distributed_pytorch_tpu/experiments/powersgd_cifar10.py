@@ -99,6 +99,6 @@ def run(
 
         test_x, test_y, _ = load_cifar10_or_synthetic(data_dir, train=False)
         extra["eval_accuracy"] = evaluate_image_classifier(
-            model, state.params, state.model_state["batch_stats"], test_x, test_y
+            model, state.params, step.eval_model_state(state)["batch_stats"], test_x, test_y
         )
     return summarize("powersgd_cifar10", logger, extra)

@@ -25,6 +25,7 @@ from .gpt import (  # noqa: F401
     gpt_small,
     gpt_tiny,
     init_gpt_cache,
+    make_gpt_pipeline_train_fn,
     make_gpt_stage_fn,
     next_token_loss,
     split_gpt_params,

@@ -75,6 +75,21 @@ class MultiHeadSelfAttention(nn.Module):
             return t.reshape(t.shape[0], t.shape[1], cfg.n_heads, head_dim)
 
         q, k, v = split(q), split(k), split(v)
+        if (
+            (cfg.seq_axis is not None or cfg.attn_impl == "flash")
+            and not deterministic
+            and cfg.attention_dropout > 0.0
+        ):
+            # fail loudly (same contract as make_gpt_stage_fn): these paths
+            # never materialize the attention-weight matrix, so the weights
+            # cannot be dropout-masked — training would silently use
+            # different regularization than the einsum path
+            raise ValueError(
+                "attention_dropout > 0 cannot be applied on the"
+                f" {'sequence-parallel' if cfg.seq_axis is not None else 'flash'}"
+                " attention path (the weight matrix is never materialized)."
+                " Set attention_dropout=0.0 or use attn_impl='einsum'."
+            )
         if cfg.seq_axis is not None:
             # sequence-sharded exact attention: K/V ring-rotate over ICI, or
             # Ulysses head<->sequence all_to_all

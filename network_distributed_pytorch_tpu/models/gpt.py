@@ -184,11 +184,13 @@ def next_token_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
 # LN + weight-tied head (replicated). Only the blocks carry the FLOPs, so
 # this pipelines >95% of the model while keeping stages homogeneous.
 #
-# Training scope: make_pipeline_train_fn differentiates the STAGE (block)
-# params only — embed/wpe/ln_f and the tied head enter the loss as closed-over
-# constants, so they stay frozen unless the caller adds their gradients some
-# other way (e.g. a periodic full-model fine-tune step, or GPipe
-# pipeline_apply under plain jax.grad, which differentiates everything).
+# Training scope: make_pipeline_train_fn with a hand-closed-over head
+# differentiates the STAGE (block) params only — embed/wpe/ln_f and the tied
+# head would enter the loss as constants and stay FROZEN. For full-model
+# pipeline training use make_gpt_pipeline_train_fn below, which routes head
+# gradients through the schedule's loss-params path and embedding gradients
+# through the pipeline's input cotangent; GPipe pipeline_apply under plain
+# jax.grad also differentiates everything.
 
 
 def split_gpt_params(params, n_stages: int):
@@ -269,6 +271,81 @@ def gpt_head_apply(config: GPTConfig, final, embed, x):
     )
     logits = x @ embed["wte"]["embedding"].T.astype(config.dtype)
     return logits.astype(jnp.float32)
+
+
+def make_gpt_pipeline_train_fn(
+    config: GPTConfig,
+    layers_per_stage: int,
+    num_microbatches: int,
+    axis_name: str = "pipe",
+    params_varying_over: tuple = (),
+):
+    """FULL-model 1F1B pipeline training: every parameter gets a gradient.
+
+    Wiring ``parallel.pipeline.make_pipeline_train_fn`` by hand with a
+    closed-over head trains a partially-frozen model (embed/wpe/ln_f and the
+    weight-tied LM head receive no gradients — see the module comment above).
+    This builder closes the gap:
+
+    - **head + final LN**: passed as the schedule's differentiable
+      ``loss_params`` — the last stage's loss VJP produces their gradients
+      (tied-head gradient lands on ``wte``);
+    - **embedding (wte/wpe)**: the schedule returns the pipeline INPUT
+      cotangent, chained here through ``jax.vjp`` of ``gpt_embed_apply``;
+      the tied ``wte`` gradient sums both contributions.
+
+    Returns ``fn(embed, stacked_stages, final, ids, labels) ->
+    (loss, (embed_grads, stage_grads, final_grads))`` for use inside
+    ``shard_map`` over the ``axis_name`` mesh axis with
+    ``in_specs=(P(), P(axis_name), P(), P(), P())`` and
+    ``out_specs=(P(), (P(), P(axis_name), P()))``. When composing with a
+    data axis, list it in ``params_varying_over`` (grads come back LOCAL to
+    each data shard for pluggable reduction, as in ``trainer.make_step_fn``).
+    """
+    stage_fn = make_gpt_stage_fn(config, layers_per_stage)
+    from ..parallel.pipeline import make_pipeline_train_fn
+
+    # loss_params carry ONLY what the head reads — final LN + the tied wte
+    # matrix. wpe would otherwise ride along as a structurally-zero dlp
+    # accumulator through every scan tick (its real gradient arrives via the
+    # input-cotangent path below).
+    def mb_loss(lp, y, labels):
+        return next_token_loss(
+            gpt_head_apply(config, lp["final"], {"wte": lp["wte"]}, y), labels
+        )
+
+    pipe = make_pipeline_train_fn(
+        stage_fn,
+        mb_loss,
+        axis_name,
+        num_microbatches,
+        params_varying_over=params_varying_over,
+        loss_has_params=True,
+        return_input_grads=True,
+    )
+
+    def fn(embed, stacked_stages, final, ids, labels):
+        # data-varying copy for the embedding vjp only; the pipeline pcasts
+        # its own loss_params internally (pcast-ing twice is an error)
+        embed_var = embed
+        for ax in params_varying_over:
+            embed_var = jax.tree_util.tree_map(
+                lambda p: jax.lax.pcast(p, ax, to="varying"), embed_var
+            )
+        x, embed_vjp = jax.vjp(
+            lambda e: gpt_embed_apply(config, e, ids), embed_var
+        )
+        loss, stage_grads, dlp, dx = pipe(
+            stacked_stages, {"wte": embed["wte"], "final": final}, x, labels
+        )
+        (d_embed_in,) = embed_vjp(dx)
+        embed_grads = {
+            "wte": jax.tree_util.tree_map(jnp.add, d_embed_in["wte"], dlp["wte"]),
+            "wpe": d_embed_in["wpe"],
+        }
+        return loss, (embed_grads, stage_grads, dlp["final"])
+
+    return fn
 
 
 # ---- autoregressive decoding (KV cache) ---------------------------------

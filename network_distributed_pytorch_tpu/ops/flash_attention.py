@@ -36,6 +36,14 @@ from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (TPU lowering)
 
 _NEG_INF = float(-1e30)  # finite stand-in: -inf breaks the m-correction math
 _LSE_EMPTY = float(1e30)  # lse for fully-masked rows: exp(s - 1e30) == 0
+# Additive-mask values at or below this are PADDING (hard-masked keys) and
+# are excluded from the softmax by an explicit validity flag rather than by
+# relying on exp underflow: a padding value equal to _NEG_INF ties the
+# running-max init, where exp(s - new_m) == 1 instead of underflowing —
+# an all-padded row would then emit garbage output and leak gradients into
+# padded K/V (round-1 advisor finding). Soft biases (ALiBi etc.) are far
+# above this threshold and keep exact additive semantics.
+_MASK_PAD = float(-1e29)
 
 
 def _flash_kernel(
@@ -71,7 +79,11 @@ def _flash_kernel(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (block_q, block_k)
-        s = s + mask_ref[0, pl.ds(j * block_k, block_k)][None, :]
+        mask_blk = mask_ref[0, pl.ds(j * block_k, block_k)]
+        valid = jnp.broadcast_to(
+            (mask_blk > _MASK_PAD)[None, :], (block_q, block_k)
+        )
+        s = s + mask_blk[None, :]
         if causal:
             q_pos = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -79,13 +91,15 @@ def _flash_kernel(
             k_pos = j * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
+            valid = valid & (q_pos >= k_pos)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
 
-        blk_max = jnp.max(s, axis=-1, keepdims=True)
+        # invalid (padding / causal-pruned) entries are force-excluded by
+        # the validity flag — never by hoping exp underflows (see _MASK_PAD)
+        blk_max = jnp.max(jnp.where(valid, s, _NEG_INF), axis=-1, keepdims=True)
         new_m = jnp.maximum(m, blk_max)
         correction = jnp.exp(m - new_m)
-        p = jnp.exp(s - new_m)
-        # fully-masked entries: exp(NEG_INF - new_m) underflows to 0 already
+        p = jnp.where(valid, jnp.exp(s - new_m), 0.0)
         l = l * correction + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * correction + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
@@ -132,7 +146,12 @@ def _flash_bwd_chunked(scale, causal, block_k, q, k, v, mask, out, lse, do):
         )
         if causal:
             s = s + _causal_bias(t, block_k, ks)[None]
-        p = jnp.exp(s - lse[:, :, None])  # (BH, T, bk); 0 for masked/empty
+        p = jnp.exp(s - lse[:, :, None])  # (BH, T, bk)
+        # force-exclude padded keys (mask ≤ _MASK_PAD) instead of relying on
+        # exp underflow — mirrors the forward kernel's validity flag
+        p = jnp.where(
+            jnp.repeat(m_blk > _MASK_PAD, h, axis=0)[:, None, :], p, 0.0
+        )
         dp = jnp.einsum("zqd,zkd->zqk", do32, v_blk)
         ds = p * (dp - D[:, :, None])
         dq_acc = dq_acc + jnp.einsum("zqk,zkd->zqd", ds, k_blk) * scale

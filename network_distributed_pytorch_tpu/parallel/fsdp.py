@@ -72,7 +72,9 @@ def unshard_params(shards: PyTree, params_template: PyTree) -> PyTree:
 class FSDPState(NamedTuple):
     """Per-step carry. ``param_shards`` / ``opt_shards`` are flat ZeRO shards
     with a leading ``world`` axis sharded over the data axis; ``model_state``
-    (e.g. BatchNorm stats) is replicated like the trainer's."""
+    (e.g. BatchNorm stats) is per-worker with the same leading axis — torch
+    DDP never syncs running stats and neither does this step (zero wire
+    bytes; collapse with :meth:`CompiledFSDPStep.eval_model_state`)."""
 
     param_shards: PyTree
     opt_shards: PyTree
@@ -115,15 +117,32 @@ class CompiledFSDPStep(NamedTuple):
             opt,
             self.opt_specs,
         )
+        model_state = {} if model_state is None else model_state
+        model_state = jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                jnp.broadcast_to(
+                    jnp.asarray(x)[None], (self.world,) + jnp.shape(x)
+                ),
+                sh,
+            ),
+            model_state,
+        )
         return FSDPState(
             param_shards=place(shards),
             opt_shards=opt,
-            model_state={} if model_state is None else model_state,
+            model_state=model_state,
         )
 
     def unshard(self, state: FSDPState) -> PyTree:
         """Full (replicated) parameters from the sharded state."""
         return unshard_params(state.param_shards, self.params_template)
+
+    def eval_model_state(self, state: FSDPState, reduce: str = "mean") -> PyTree:
+        """Collapse the per-worker model_state for eval
+        (:func:`trainer.collapse_per_worker` — FSDP is always multi-device)."""
+        from .trainer import collapse_per_worker
+
+        return collapse_per_worker(state.model_state, reduce)
 
 
 def make_fsdp_train_step(
@@ -188,9 +207,8 @@ def make_fsdp_train_step(
         # data-parallel mean (the reference's allreduce-then-/=world,
         # ddp_guide_cifar10/ddp_init.py:61-62).
         grad_shards = jax.tree_util.tree_map(lambda g: g / world, grad_shards)
-        model_state = jax.tree_util.tree_map(
-            lambda x: all_reduce_mean(x, axis_name), model_state
-        )
+        # model_state (BN running stats) stays per-worker — no collective,
+        # matching torch DDP; collapsed only by eval_model_state
 
         if algorithm == "optax":
             import optax
@@ -232,7 +250,7 @@ def make_fsdp_train_step(
             jax.tree_util.tree_map(
                 lambda x, s: x if s == _rep else x[0], state.opt_shards, opt_specs
             ),
-            state.model_state,
+            strip(state.model_state),
         )
         new_state, loss = step(local, batch)
         pad = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
@@ -244,14 +262,14 @@ def make_fsdp_train_step(
                     new_state.opt_shards,
                     opt_specs,
                 ),
-                new_state.model_state,
+                pad(new_state.model_state),
             ),
             loss,
         )
 
     shard_spec = PartitionSpec(axis_name)
     state_specs = FSDPState(
-        param_shards=shard_spec, opt_shards=opt_specs, model_state=PartitionSpec()
+        param_shards=shard_spec, opt_shards=opt_specs, model_state=shard_spec
     )
     fn = jax.jit(
         jax.shard_map(
@@ -263,9 +281,15 @@ def make_fsdp_train_step(
         donate_argnums=(0,) if donate_state else (),
     )
 
-    # all_gather(params) + reduce_scatter(grads), padded sizes, per leaf
-    bits = sum(
-        2 * 8 * world * _chunk_size(int(t.size), world) * t.dtype.itemsize
-        for t in jax.tree_util.tree_leaves(templates)
+    # all_gather(params) + reduce_scatter(grads), padded sizes, per leaf,
+    # plus the scalar loss pmean (trainer.LOSS_SYNC_BITS convention)
+    from .trainer import LOSS_SYNC_BITS
+
+    bits = (
+        sum(
+            2 * 8 * world * _chunk_size(int(t.size), world) * t.dtype.itemsize
+            for t in jax.tree_util.tree_leaves(templates)
+        )
+        + LOSS_SYNC_BITS
     )
     return CompiledFSDPStep(fn, bits, mesh, axis_name, templates, opt_specs, optimizer)

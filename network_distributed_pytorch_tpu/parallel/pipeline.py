@@ -109,10 +109,12 @@ def stacked_stage_params(params_per_stage: list[PyTree]) -> PyTree:
 
 def make_pipeline_train_fn(
     stage_fn: Callable[[PyTree, jax.Array], jax.Array],
-    loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+    loss_fn: Callable[..., jax.Array],
     axis_name: str,
     num_microbatches: int,
     params_varying_over: tuple = (),
+    loss_has_params: bool = False,
+    return_input_grads: bool = False,
 ):
     """1F1B-style pipeline **training** schedule with an O(stages) activation
     stash.
@@ -141,6 +143,24 @@ def make_pipeline_train_fn(
     ``(1, ...)`` stage slice, specs ``P(axis_name)``; x/labels replicated).
     ``loss_fn(y_mb, labels_mb) -> scalar`` is the per-microbatch mean loss.
 
+    **Training scope** — with the defaults, ONLY the stage params receive
+    gradients: anything ``loss_fn`` or ``stage_fn`` closes over (an
+    embedding front, a tied LM head) enters as a constant and stays frozen.
+    Two opt-ins widen the scope to the full model:
+
+    - ``loss_has_params=True``: ``loss_fn(loss_params, y_mb, labels_mb)``
+      and the returned ``fn(stage_params, loss_params, x, labels)`` also
+      yields ``loss_param_grads`` (the head/final-LN gradients, accumulated
+      over microbatches on the last stage and psum-shared to all pipe
+      ranks, spec ``P()``).
+    - ``return_input_grads=True``: ``fn`` additionally yields ``dx`` — the
+      cotangent of the pipeline INPUT ``x`` (full ``(B, ...)``, collected
+      from stage-0 backwards and psum-shared, spec ``P()``); chain it
+      through ``jax.vjp`` of the embedding front to get embedding grads.
+
+    Output layout: ``(loss, stage_grads[, loss_param_grads][, dx])``.
+    See ``models.gpt.make_gpt_pipeline_train_fn`` for the full-model wiring.
+
     When composing with a data axis, list it in ``params_varying_over``: the
     params are pcast device-varying over those axes before differentiation so
     the returned grads are this shard's LOCAL grads — without it, jax's
@@ -151,7 +171,12 @@ def make_pipeline_train_fn(
     """
     m = num_microbatches
 
-    def fn(stacked_params: PyTree, x: jax.Array, labels: jax.Array):
+    def fn(stacked_params: PyTree, *rest):
+        if loss_has_params:
+            loss_params, x, labels = rest
+        else:
+            loss_params = None
+            x, labels = rest
         n = lax.axis_size(axis_name)
         idx = lax.axis_index(axis_name)
         for leaf in jax.tree_util.tree_leaves(stacked_params):
@@ -164,6 +189,10 @@ def make_pipeline_train_fn(
             params = jax.tree_util.tree_map(
                 lambda p: lax.pcast(p, ax, to="varying"), params
             )
+            if loss_params is not None:
+                loss_params = jax.tree_util.tree_map(
+                    lambda p: lax.pcast(p, ax, to="varying"), loss_params
+                )
         b = x.shape[0]
         assert b % m == 0, f"batch {b} must divide into {m} microbatches"
         mb = b // m
@@ -190,13 +219,29 @@ def make_pipeline_train_fn(
 
         def bwd_unit(p, x_in, g_in, label, is_last):
             y, vjp = jax.vjp(stage_fn, p, x_in)
-            loss_val, loss_vjp = jax.vjp(lambda yy: loss_fn(yy, label), y)
-            seed = jnp.where(is_last, loss_vjp(jnp.ones_like(loss_val))[0], g_in)
+            if loss_has_params:
+                # pcast to pipe-varying BEFORE differentiation: a replicated
+                # input to a varying computation makes jax's replication-
+                # tracking transpose auto-psum the cotangent over the pipe
+                # axis — every device's dlp would then contain the OTHER
+                # devices' (masked-out, garbage) head gradients too
+                lp_var = jax.tree_util.tree_map(
+                    lambda q: lax.pcast(q, axis_name, to="varying"), loss_params
+                )
+                loss_val, loss_vjp = jax.vjp(
+                    lambda lp, yy: loss_fn(lp, yy, label), lp_var, y
+                )
+                dlp, dy = loss_vjp(jnp.ones_like(loss_val))
+            else:
+                loss_val, loss_vjp = jax.vjp(lambda yy: loss_fn(yy, label), y)
+                (dy,) = loss_vjp(jnp.ones_like(loss_val))
+                dlp = None
+            seed = jnp.where(is_last, dy, g_in)
             dp, dx = vjp(seed)
-            return loss_val, dp, dx
+            return loss_val, dp, dx, dlp
 
         def iteration(carry, j):
-            recv_act, recv_grad, stash, dp_acc, loss_acc = carry
+            recv_act, recv_grad, stash, dp_acc, loss_acc = carry["core"]
 
             # ---- forward subtick (global tick 2j): microbatch k_f = j - idx
             k_f = j - idx
@@ -222,7 +267,7 @@ def make_pipeline_train_fn(
             label = lax.dynamic_index_in_dim(
                 micro_labels, jnp.clip(k_b, 0, m - 1), 0, keepdims=False
             )
-            loss_val, dp, dx = bwd_unit(
+            loss_val, dp, dx, dlp = bwd_unit(
                 params, x_in, recv_grad, label, idx == n - 1
             )
             dp_acc = jax.tree_util.tree_map(
@@ -235,23 +280,68 @@ def make_pipeline_train_fn(
             )
             send_grad = lax.ppermute(dx, axis_name, bwd_perm)
 
-            return (send_act, send_grad, stash, dp_acc, loss_acc), None
+            out = {"core": (send_act, send_grad, stash, dp_acc, loss_acc)}
+            if loss_has_params:
+                # head grads are real only on the LAST stage's backward ticks
+                mask_lp = valid_b & (idx == n - 1)
+                out["dlp"] = jax.tree_util.tree_map(
+                    lambda a, d: a + jnp.where(mask_lp, d, jnp.zeros_like(d)),
+                    carry["dlp"],
+                    dlp,
+                )
+            if return_input_grads:
+                # the pipeline-input cotangent is stage 0's dx for its
+                # backward microbatch — bank it by microbatch index
+                mask_dx = valid_b & (idx == 0)
+                prev_dx = lax.dynamic_index_in_dim(
+                    carry["dxo"], jnp.clip(k_b, 0, m - 1), 0, keepdims=False
+                )
+                out["dxo"] = lax.dynamic_update_index_in_dim(
+                    carry["dxo"],
+                    jnp.where(mask_dx, dx, prev_dx),
+                    jnp.clip(k_b, 0, m - 1),
+                    0,
+                )
+            return out, None
 
         stash0 = jnp.broadcast_to(zero_mb[None], (stash_size,) + zero_mb.shape)
         dp0 = jax.tree_util.tree_map(
             lambda p: jnp.zeros_like(p) + tint.astype(p.dtype), params
         )
         loss0 = varying(tint.astype(jnp.float32))
-        carry0 = (zero_mb, zero_mb, stash0, dp0, loss0)
+        carry0 = {"core": (zero_mb, zero_mb, stash0, dp0, loss0)}
+        if loss_has_params:
+            carry0["dlp"] = jax.tree_util.tree_map(
+                lambda p: varying(jnp.zeros_like(p) + tint.astype(p.dtype)),
+                loss_params,
+            )
+        if return_input_grads:
+            carry0["dxo"] = jnp.broadcast_to(
+                zero_mb[None], (m,) + zero_mb.shape
+            )
         num_iters = m + 2 * n - 2  # last backward: j = (m-1) + 2(n-1)
-        (_, _, _, dp_acc, loss_acc), _ = lax.scan(
-            iteration, carry0, jnp.arange(num_iters)
-        )
+        final, _ = lax.scan(iteration, carry0, jnp.arange(num_iters))
+        _, _, _, dp_acc, loss_acc = final["core"]
 
         # mean over microbatches; broadcast the last stage's loss to all ranks
         loss = lax.psum(loss_acc, axis_name) / m
         grads = jax.tree_util.tree_map(lambda g: (g / m)[None], dp_acc)
-        return loss, grads
+        outs = [loss, grads]
+        if loss_has_params:
+            # only the last stage accumulated real values — share them
+            outs.append(
+                jax.tree_util.tree_map(
+                    lambda g: lax.psum(g, axis_name) / m, final["dlp"]
+                )
+            )
+        if return_input_grads:
+            # only stage 0 banked real values — share, then un-microbatch.
+            # loss = (1/m)·Σ_k loss_k and microbatch k's dx is d loss_k/d x_k
+            # (its slice of x affects only its own loss term), so the full
+            # input cotangent is each banked dx scaled by 1/m.
+            dx_full = lax.psum(final["dxo"], axis_name) / m
+            outs.append(dx_full.reshape((b,) + x.shape[1:]))
+        return tuple(outs)
 
     return fn
 

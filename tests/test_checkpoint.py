@@ -5,6 +5,7 @@ uninterrupted run)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from network_distributed_pytorch_tpu.models import SmallCNN
 from network_distributed_pytorch_tpu.parallel import PowerSGDReducer, make_mesh
@@ -26,6 +27,7 @@ def _batch(i, n=32):
     return means[y] + 0.5 * jax.random.normal(kx, (n, *IMG)), y
 
 
+@pytest.mark.slow
 def test_save_restore_resume_bitexact(tmp_path, devices):
     model = SmallCNN(width=4)
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, *IMG)))["params"]

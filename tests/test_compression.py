@@ -275,4 +275,6 @@ def test_compressors_train_ef_momentum(devices, reducer):
         state, l = step(state, batch)
         losses.append(float(l))
     assert losses[-1] < 0.2 * losses[0], losses
-    assert step.bits_per_step == reducer.bits_per_step(params)
+    from network_distributed_pytorch_tpu.parallel.trainer import LOSS_SYNC_BITS
+
+    assert step.bits_per_step == reducer.bits_per_step(params) + LOSS_SYNC_BITS

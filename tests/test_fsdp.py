@@ -132,4 +132,6 @@ def test_fsdp_bits_accounting(devices):
     for leaf in jax.tree_util.tree_leaves(params):
         padded = -(-leaf.size // 8) * 8
         manual += 2 * 8 * padded * leaf.dtype.itemsize
-    assert fsdp.bits_per_step == manual
+    from network_distributed_pytorch_tpu.parallel.trainer import LOSS_SYNC_BITS
+
+    assert fsdp.bits_per_step == manual + LOSS_SYNC_BITS

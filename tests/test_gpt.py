@@ -44,6 +44,7 @@ def test_causality(devices):
 
 
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.slow
 def test_seq_parallel_forward_matches_single_device(devices, impl):
     overrides = dict(max_position_embeddings=T)
     if impl == "ulysses":
@@ -68,6 +69,7 @@ def test_seq_parallel_forward_matches_single_device(devices, impl):
     )
 
 
+@pytest.mark.slow
 def test_gpt_ddp_training_learns(devices):
     """Exact-DDP training on a deterministic next-token task (cyclic
     sequences => the next token is fully predictable)."""

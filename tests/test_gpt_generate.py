@@ -4,6 +4,7 @@ greedy generate matches the naive (re-run-the-whole-prefix) loop."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from network_distributed_pytorch_tpu.models.gpt import (
     generate,
@@ -36,6 +37,7 @@ def test_decode_steps_match_full_forward(devices):
         )
 
 
+@pytest.mark.slow
 def test_greedy_generate_matches_naive_loop(devices):
     model, params, ids = _setup()
     new = 8

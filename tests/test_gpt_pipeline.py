@@ -5,12 +5,14 @@ training grads exact vs single-device autodiff."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from network_distributed_pytorch_tpu.models.gpt import (
     gpt_embed_apply,
     gpt_head_apply,
     gpt_tiny,
+    make_gpt_pipeline_train_fn,
     make_gpt_stage_fn,
     next_token_loss,
     split_gpt_params,
@@ -35,6 +37,7 @@ def _setup():
     return model, params, ids
 
 
+@pytest.mark.slow
 def test_gpt_pipeline_forward_matches_direct(devices):
     model, params, ids = _setup()
     cfg = model.config
@@ -60,6 +63,7 @@ def test_gpt_pipeline_forward_matches_direct(devices):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_gpt_pipeline_1f1b_grads_match_single_device(devices):
     model, params, ids = _setup()
     cfg = model.config
@@ -105,3 +109,124 @@ def test_gpt_pipeline_1f1b_grads_match_single_device(devices):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(e), rtol=5e-4, atol=1e-5
         )
+
+
+@pytest.mark.slow
+def test_gpt_pipeline_full_model_grads(devices):
+    """make_gpt_pipeline_train_fn must produce gradients for EVERY param —
+    embedding (wte/wpe), blocks, final LN, and the weight-tied head's
+    contribution into wte — matching single-device autodiff (round-1 advisor
+    finding: the hand-wired decomposition silently froze embed/head)."""
+    model, params, ids = _setup()
+    cfg = model.config
+    labels = jnp.asarray(
+        np.random.RandomState(1).randint(0, 128, (B, T)), jnp.int32
+    )
+
+    embed, stages, final = split_gpt_params(params, N)
+    stacked = stacked_stage_params(stages)
+    stage_fn = make_gpt_stage_fn(cfg, layers_per_stage=1)
+
+    # reference: plain autodiff over ALL pieces at once
+    def ref_loss(embed, stages_list, final, ids, labels):
+        x = gpt_embed_apply(cfg, embed, ids)
+        for sp in stages_list:
+            x = stage_fn(sp, x)
+        return next_token_loss(gpt_head_apply(cfg, final, embed, x), labels)
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss, argnums=(0, 1, 2))(
+        embed, stages, final, ids, labels
+    )
+    ref_embed_g, ref_stage_g, ref_final_g = ref_g
+
+    mesh = make_mesh(axis_sizes=(N,), axis_names=("pipe",))
+    train = make_gpt_pipeline_train_fn(
+        cfg, layers_per_stage=1, num_microbatches=4
+    )
+    loss, (embed_g, stage_g, final_g) = jax.jit(
+        jax.shard_map(
+            train, mesh=mesh,
+            in_specs=(P(), P("pipe"), P(), P(), P()),
+            out_specs=(P(), (P(), P("pipe"), P())),
+        )
+    )(embed, stacked, final, ids, labels)
+
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=2e-5)
+    # embedding grads: nonzero and exact (includes the tied-head term on wte)
+    assert np.any(np.asarray(embed_g["wte"]["embedding"]) != 0.0)
+    assert np.any(np.asarray(embed_g["wpe"]["embedding"]) != 0.0)
+    for got, want in (
+        (embed_g, ref_embed_g),
+        (stage_g, stacked_stage_params(ref_stage_g)),
+        (final_g, ref_final_g),
+    ):
+        for a, e in zip(
+            jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(want)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(e), rtol=5e-4, atol=1e-5
+            )
+
+
+def test_gpt_pipeline_full_model_grads_with_data_axis(devices):
+    """The documented pipe x data composition: params_varying_over=('data',)
+    must trace (no double-pcast) and per-shard LOCAL grads must pmean to the
+    full-batch gradient."""
+    n_pipe, n_data = 4, 2
+    model = gpt_tiny(n_layers=n_pipe, max_position_embeddings=T)
+    cfg = model.config
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, (B, T)), jnp.int32)
+    labels = jnp.asarray(np.random.RandomState(1).randint(0, 128, (B, T)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+
+    embed, stages, final = split_gpt_params(params, n_pipe)
+    stacked = stacked_stage_params(stages)
+    stage_fn = make_gpt_stage_fn(cfg, layers_per_stage=1)
+
+    def ref_loss(embed, stages_list, final, ids, labels):
+        x = gpt_embed_apply(cfg, embed, ids)
+        for sp in stages_list:
+            x = stage_fn(sp, x)
+        return next_token_loss(gpt_head_apply(cfg, final, embed, x), labels)
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss, argnums=(0, 1, 2))(
+        embed, stages, final, ids, labels
+    )
+
+    mesh = make_mesh(
+        axis_sizes=(n_data, n_pipe), axis_names=("data", "pipe")
+    )
+    train = make_gpt_pipeline_train_fn(
+        cfg, layers_per_stage=1, num_microbatches=2,
+        params_varying_over=("data",),
+    )
+
+    def step(embed, stacked, final, ids, labels):
+        loss, grads = train(embed, stacked, final, ids, labels)
+        # local grads -> data-parallel mean (the pluggable-reduction seam)
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.pmean(g, "data"), grads
+        )
+        return jax.lax.pmean(loss, "data"), grads
+
+    loss, (embed_g, stage_g, final_g) = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(P(), P("pipe"), P(), P("data"), P("data")),
+            out_specs=(P(), (P(), P("pipe"), P())),
+        )
+    )(embed, stacked, final, ids, labels)
+
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=2e-5)
+    ref_embed_g, ref_stage_g, ref_final_g = ref_g
+    for got, want in (
+        (embed_g, ref_embed_g),
+        (stage_g, stacked_stage_params(ref_stage_g)),
+        (final_g, ref_final_g),
+    ):
+        for a, e in zip(
+            jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(want)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(e), rtol=5e-4, atol=1e-5
+            )

@@ -49,18 +49,53 @@ def _summary(reducer, algo):
 
 def test_exact_hlo_payload_matches_analytic(devices):
     step, s = _summary(ExactReducer(), "sgd")
-    # compiled payload = packed gradient + the 4-byte loss pmean
-    assert s["total_payload_bytes"] == step.bits_per_step // 8 + 4
+    # bits_per_step is the WHOLE step's wire cost (reducer payload + the
+    # 4-byte loss pmean, trainer.LOSS_SYNC_BITS) — byte-exact vs compiled HLO
+    assert s["total_payload_bytes"] == step.bits_per_step // 8
     # combiner merges the gradient and loss all-reduces into ONE collective
     assert s["by_kind"] == {"all-reduce": 1}
 
 
 def test_powersgd_hlo_payload_matches_analytic(devices):
     step, s = _summary(PowerSGDReducer(compression_rank=2, matricize="last"), "ef_momentum")
-    assert s["total_payload_bytes"] == step.bits_per_step // 8 + 4
+    assert s["total_payload_bytes"] == step.bits_per_step // 8
     # the P / rank-1 / Q / loss collectives compile to at most 3 (Q depends
     # on allreduced-P so it cannot merge with it; the rest may combine)
     assert 2 <= s["by_kind"]["all-reduce"] <= 3
+
+
+def test_full_step_with_batch_stats_no_unaccounted_collectives(devices):
+    """Round-1 verdict item 4: the entire compiled train step — including a
+    model WITH BatchNorm running stats in model_state — must contain no
+    collective payload the analytic ``bits_per_step`` doesn't carry. BN stats
+    stay per-worker (zero wire bytes, the reference's unsynced-BN torch-DDP
+    semantics), so the only non-reducer collective is the scalar loss pmean."""
+    from network_distributed_pytorch_tpu.experiments.common import (
+        image_classifier_loss,
+    )
+    from network_distributed_pytorch_tpu.models import resnet18
+
+    model = resnet18(num_classes=10, norm="batch", stem="cifar", width=8)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, *IMG)), train=True)
+    loss_fn = image_classifier_loss(model, has_batch_stats=True)
+    batch = (jnp.zeros((16, *IMG)), jnp.zeros((16,), jnp.int32))
+    mesh = make_mesh()
+    for reducer, algo in (
+        (ExactReducer(), "sgd"),
+        (PowerSGDReducer(compression_rank=2, matricize="last"), "ef_momentum"),
+    ):
+        step = make_train_step(
+            loss_fn, reducer, variables["params"], 0.05, 0.9, algo,
+            mesh=mesh, donate_state=False,
+        )
+        state = step.init_state(
+            variables["params"],
+            model_state={"batch_stats": variables["batch_stats"]},
+        )
+        s = collective_summary(compiled_hlo_text(step.fn, state, batch))
+        assert s["total_payload_bytes"] == step.bits_per_step // 8, (
+            algo, s["by_kind"], s["total_payload_bytes"], step.bits_per_step // 8
+        )
 
 
 def test_fsdp_hlo_payload_matches_analytic(devices):
@@ -82,6 +117,6 @@ def test_fsdp_hlo_payload_matches_analytic(devices):
 
     assert s["by_kind"].get("reduce-scatter", 0) >= 1, s["by_kind"]
     assert s["by_kind"].get("all-gather", 0) >= 1, s["by_kind"]
-    # analytic: gather + scatter of every padded leaf; compiled adds the
-    # 4-byte loss pmean (model_state is {} here)
-    assert s["total_payload_bytes"] == step.bits_per_step // 8 + 4
+    # analytic: gather + scatter of every padded leaf + the loss pmean
+    # (LOSS_SYNC_BITS); model_state is {} here
+    assert s["total_payload_bytes"] == step.bits_per_step // 8

@@ -146,6 +146,7 @@ def test_resnet_forward_parity(stages, bottleneck):
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_distilbert_forward_parity():
     transformers = pytest.importorskip("transformers")
     hf_cfg = transformers.DistilBertConfig(

@@ -5,6 +5,7 @@ formula, and training."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from network_distributed_pytorch_tpu.parallel import make_mesh
@@ -116,6 +117,7 @@ def test_moe_aux_loss_formula():
     np.testing.assert_allclose(float(res.aux_loss), expected, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_moe_trains(devices):
     """The routed layer learns a piecewise target on the 8-device mesh."""
     rng = np.random.RandomState(7)

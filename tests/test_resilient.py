@@ -6,6 +6,7 @@ continues exactly)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from network_distributed_pytorch_tpu.experiments.common import (
     resilient_train_loop,
@@ -63,6 +64,7 @@ def _crashing_batches(crash_at_epoch):
     return fn
 
 
+@pytest.mark.slow
 def test_crash_resume_matches_uninterrupted(devices, tmp_path):
     step, params = _setup()
 

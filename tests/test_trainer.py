@@ -4,6 +4,7 @@ exact-DDP ≡ single-device large-batch; PowerSGD trains; bits accounting."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from network_distributed_pytorch_tpu.models import SmallCNN, resnet18
 from network_distributed_pytorch_tpu.parallel import (
@@ -68,6 +69,7 @@ def test_exact_ddp_equals_single_device_large_batch(devices):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_powersgd_training_reduces_loss(devices):
     params, loss_fn = _cnn_setup()
     mesh = make_mesh()
@@ -95,9 +97,11 @@ def test_bits_compressed_below_exact():
     assert exact.bits_per_step == 32 * total
 
 
+@pytest.mark.slow
 def test_resnet_batchnorm_distributed_step(devices):
-    """ResNet-18 with BatchNorm: model_state (running stats) is carried and
-    synced; one distributed PowerSGD step runs and updates the stats."""
+    """ResNet-18 with BatchNorm: model_state (running stats) is carried
+    per-worker (unsynced, like torch DDP); one distributed PowerSGD step
+    runs and updates the stats."""
     model = resnet18(norm="batch", stem="cifar", width=8, num_classes=10)
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, *IMG)), train=True)
     params, batch_stats = variables["params"], variables["batch_stats"]
@@ -125,6 +129,7 @@ def test_resnet_batchnorm_distributed_step(devices):
     assert any(not np.allclose(np.asarray(a), np.asarray(b)) for a, b in zip(before, after))
 
 
+@pytest.mark.slow
 def test_scanned_epoch_equals_stepwise(devices):
     """lax.scan multi-step runner must be numerically identical to the
     step-at-a-time loop (same collectives, same EF chain)."""

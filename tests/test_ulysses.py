@@ -51,6 +51,7 @@ def _run_sharded(fn, q, k, v, mask, causal):
 
 
 @pytest.mark.parametrize("causal", [False, True], ids=["bidir", "causal"])
+@pytest.mark.slow
 def test_ulysses_matches_full_attention(devices, causal):
     q, k, v = _qkv(1)
     mask = jnp.zeros((B, T)).at[1, 48:].set(-jnp.inf)  # pad tail of row 1
@@ -82,6 +83,7 @@ def test_ulysses_rejects_indivisible_heads(devices):
         )(q)
 
 
+@pytest.mark.slow
 def test_ulysses_distilbert_encoder_matches_single_device(devices):
     from network_distributed_pytorch_tpu.models.distilbert import (
         DistilBertConfig,
