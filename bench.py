@@ -226,7 +226,104 @@ def _measure(results: dict) -> dict:
     if flops_chunk > 0 and peak > 0:
         results["mfu"] = flops_chunk / dt / peak
         results["flops_per_step"] = flops_chunk / CHUNK
+
+    _overlap_evidence(results, make_model, mesh)
     return results
+
+
+def _overlap_evidence(results: dict, make_model, mesh) -> None:
+    """Comm/compute concurrency evidence for the PowerSGD step, from the
+    scheduled v5e executable (SURVEY §5 set 'assert via profile' as the bar
+    for replacing the reference's async-handle overlap, ``reducer.py:131-168``).
+
+    Two findings are extracted from the post-optimization HLO and persisted
+    as ``OVERLAP.json``: (a) any async ``*-start``/``*-done`` collective
+    windows and the compute scheduled inside them (``utils.overlap``), and
+    (b) what the all-reduce combiner did to the 4 logical collectives
+    (P, rank-1, Q, loss) — on v5e it MERGES the rank-1 payload into the Q
+    all-reduce, eliminating the separate collective the reference could only
+    hide. Unless the bench is already running on a ≥2-chip TPU mesh, the
+    step is compiled against an 8-chip v5e topology AOT — the schedule IS
+    the evidence, no execution needed. Best-effort: failures are recorded,
+    never fatal."""
+    import jax
+    import jax.numpy as jnp
+
+    from network_distributed_pytorch_tpu.experiments.common import image_classifier_loss
+    from network_distributed_pytorch_tpu.parallel import PowerSGDReducer, make_mesh
+    from network_distributed_pytorch_tpu.parallel.trainer import make_train_step
+    from network_distributed_pytorch_tpu.utils.hlo_audit import (
+        collective_summary,
+        compiled_hlo_text,
+    )
+    from network_distributed_pytorch_tpu.utils.overlap import overlap_report
+
+    try:
+        target_mesh = mesh
+        topology_note = "attached TPU devices"
+        if mesh.size < 2 or jax.devices()[0].platform != "tpu":
+            from jax.experimental import topologies
+
+            topo = topologies.get_topology_desc(
+                platform="tpu", topology_name="v5e:2x4"
+            )
+            target_mesh = make_mesh(devices=topo.devices)
+            topology_note = "AOT v5e:2x4 topology (no execution)"
+
+        model = make_model(jnp.bfloat16)
+        variables = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=True
+        )
+        loss_fn = image_classifier_loss(model, has_batch_stats=True)
+        step = make_train_step(
+            loss_fn,
+            PowerSGDReducer(random_seed=714, compression_rank=4, matricize="last"),
+            variables["params"], learning_rate=0.001, momentum=0.9,
+            algorithm="ef_momentum", mesh=target_mesh, donate_state=False,
+        )
+        state_abs = jax.eval_shape(
+            lambda p, bs: step.init_state(p, model_state={"batch_stats": bs}),
+            variables["params"], variables["batch_stats"],
+        )
+        batch_abs = (
+            jax.ShapeDtypeStruct((8 * target_mesh.size, 32, 32, 3), jnp.float32),
+            jax.ShapeDtypeStruct((8 * target_mesh.size,), jnp.int32),
+        )
+        hlo = compiled_hlo_text(step.fn, state_abs, batch_abs)
+        rep = overlap_report(hlo)
+        aud = collective_summary(hlo)
+        rep["compiled_collectives"] = {
+            "count": aud["count"],
+            "by_kind": aud["by_kind"],
+            "ops": [
+                {
+                    "kind": o.kind,
+                    "dtype": o.dtype,
+                    "shapes": [list(s) for s in o.shape],
+                    "payload_bytes": o.payload_bytes,
+                }
+                for o in aud["ops"]
+            ],
+        }
+        # P, rank-1, Q, loss — reducer.py:126-147 + the loss pmean
+        rep["logical_collectives"] = 4
+        rep["combiner_merged"] = aud["count"] < 4
+        rep["workload"] = "powersgd_r4_" + ("resnet18" if "small" == results.get("preset") else "resnet50")
+        rep["compiled_for"] = topology_note
+        rep["device"] = results.get("device", "?")
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), "OVERLAP.json"),
+            "w",
+        ) as f:
+            json.dump(rep, f, indent=1)
+        results["overlap"] = {
+            "n_async_collectives": rep["n_async_collectives"],
+            "n_overlapped": rep["n_overlapped"],
+            "compiled_collectives": aud["count"],
+            "combiner_merged": rep["combiner_merged"],
+        }
+    except Exception as e:  # noqa: BLE001 — evidence is best-effort
+        results["overlap"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
 
 def main() -> int:
@@ -264,7 +361,7 @@ def main() -> int:
         )
     except Exception as e:
         out["error"] = f"{type(e).__name__}: {e}"[:800]
-    for k in ("mfu", "step_time_ms", "device", "preset"):
+    for k in ("mfu", "step_time_ms", "device", "preset", "overlap"):
         if k in results:
             out[k] = round(results[k], 4) if isinstance(results[k], float) else results[k]
     _emit(out)

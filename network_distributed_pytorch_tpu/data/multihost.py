@@ -36,3 +36,35 @@ def global_batch_from_local(local_batch: Any, mesh: Mesh, axis_name: str = DATA_
         return jax.make_array_from_process_local_data(sharding, x, global_shape)
 
     return jax.tree_util.tree_map(one, local_batch)
+
+
+def global_state_from_host(state: Any, specs: Any, mesh: Mesh):
+    """Place a host-computed pytree (e.g. a freshly-initialized TrainState,
+    identical on every process) as GLOBAL jax.Arrays sharded per ``specs``
+    (a matching pytree of ``PartitionSpec``).
+
+    Multi-process jit requires every input to be a global array over the
+    global mesh — process-local ``jnp`` arrays are rejected. Single-process
+    this degrades to a plain sharded ``device_put`` (same code path as the
+    test mesh). Each process materializes only the shards its own devices
+    hold (``make_array_from_callback`` slices the host value per index).
+    """
+
+    def one(x, spec):
+        x = np.asarray(x)
+        sharding = NamedSharding(mesh, spec)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_callback(
+            x.shape, sharding, lambda idx: x[idx]
+        )
+
+    # specs may be a prefix-tree (e.g. one spec per TrainState field)
+    return jax.tree_util.tree_map(
+        lambda spec, sub: jax.tree_util.tree_map(
+            lambda leaf: one(leaf, spec), sub
+        ),
+        specs,
+        state,
+        is_leaf=lambda t: isinstance(t, PartitionSpec),
+    )

@@ -241,28 +241,28 @@ def make_fsdp_train_step(
 
     _rep = PartitionSpec()
 
+    from .trainer import pad_leading, strip_leading
+
     def sharded_body(state: FSDPState, batch):
         # strip the global leading world axis: (world, chunk) -> (chunk,);
         # replicated opt leaves (spec P()) pass through unchanged
-        strip = lambda t: jax.tree_util.tree_map(lambda x: x[0], t)
         local = FSDPState(
-            strip(state.param_shards),
+            strip_leading(state.param_shards),
             jax.tree_util.tree_map(
                 lambda x, s: x if s == _rep else x[0], state.opt_shards, opt_specs
             ),
-            strip(state.model_state),
+            strip_leading(state.model_state),
         )
         new_state, loss = step(local, batch)
-        pad = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
         return (
             FSDPState(
-                pad(new_state.param_shards),
+                pad_leading(new_state.param_shards),
                 jax.tree_util.tree_map(
                     lambda x, s: x if s == _rep else x[None],
                     new_state.opt_shards,
                     opt_specs,
                 ),
-                pad(new_state.model_state),
+                pad_leading(new_state.model_state),
             ),
             loss,
         )

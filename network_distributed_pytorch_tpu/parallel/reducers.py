@@ -379,12 +379,15 @@ class PowerSGDReducer:
 
     # ---- analytics -------------------------------------------------------
 
-    def bits_per_step(self, grads_template: PyTree) -> int:
+    def bits_per_step(self, grads_template: PyTree, n_workers: int = 1) -> int:
         """Static analytic wire cost:
         32·[(1+k)·Σ(nᵢ+mᵢ)·rᵢ + Σ rank-1 sizes] bits for fp32, where k is
         ``n_power_iterations`` (each extra subspace round repeats the P and Q
         collectives; k=0 recovers the BASELINE.md wire-cost model, reference
-        ``reducer.py:72-98``)."""
+        ``reducer.py:72-98``). ``n_workers`` is accepted for interface
+        uniformity and ignored: allreduce payloads are W-invariant (the
+        summable low-rank factors are PowerSGD's scaling advantage over the
+        gather-family compressors in ``parallel.compression``)."""
         leaves = jax.tree_util.tree_leaves(grads_template)
         metas = self._metas(leaves)
         p_packer, q_packer, rank1_packer = self._packers(leaves, metas)

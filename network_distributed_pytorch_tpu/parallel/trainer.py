@@ -112,6 +112,18 @@ def init_train_state(
     )
 
 
+def strip_leading(tree: PyTree) -> PyTree:
+    """Per-worker global ``(num_devices, *shape)`` leaves → this device's
+    ``(*shape)`` slice (inside shard_map, after the leading axis is sharded)."""
+    return jax.tree_util.tree_map(lambda m: m[0], tree)
+
+
+def pad_leading(tree: PyTree) -> PyTree:
+    """Inverse of :func:`strip_leading`: re-add the length-1 leading axis so
+    the out_specs concatenation rebuilds the global per-worker array."""
+    return jax.tree_util.tree_map(lambda m: m[None], tree)
+
+
 def collapse_per_worker(model_state: PyTree, reduce: str = "mean") -> PyTree:
     """Collapse a per-worker model_state (leading ``num_devices`` axis of
     local BN running stats — the reference's unsynced-BN torch-DDP semantics)
@@ -319,16 +331,15 @@ def make_scanned_train_fn(
         )
 
     def sharded_body(state: TrainState, batches):
-        strip = lambda t: jax.tree_util.tree_map(lambda m: m[0], t)
-        pad = lambda t: jax.tree_util.tree_map(lambda m: m[None], t)
         local = state._replace(
-            memories=strip(state.memories), model_state=strip(state.model_state)
+            memories=strip_leading(state.memories),
+            model_state=strip_leading(state.model_state),
         )
         new_state, losses = scan_steps(local, batches)
         return (
             new_state._replace(
-                memories=pad(new_state.memories),
-                model_state=pad(new_state.model_state),
+                memories=pad_leading(new_state.memories),
+                model_state=pad_leading(new_state.model_state),
             ),
             losses,
         )
@@ -350,17 +361,20 @@ def make_scanned_train_fn(
     fn = jax.jit(sharded, donate_argnums=(0,) if donate_state else ())
     return CompiledStep(
         fn,
-        _reducer_bits(reducer, params_template) + LOSS_SYNC_BITS,
+        _reducer_bits(reducer, params_template, mesh.size) + LOSS_SYNC_BITS,
         mesh,
         reducer,
         optimizer,
     )
 
 
-def _reducer_bits(reducer, params_template: PyTree) -> int:
-    """Static bits-on-wire for one reduction of ``params_template``."""
+def _reducer_bits(reducer, params_template: PyTree, n_workers: int = 1) -> int:
+    """Static bits-on-wire for one reduction of ``params_template``.
+    ``n_workers`` matters for gather-family reducers (their gathered-result
+    payload scales with W, ``parallel.compression``); allreduce payloads
+    ignore it."""
     if hasattr(reducer, "bits_per_step"):
-        return reducer.bits_per_step(params_template)
+        return reducer.bits_per_step(params_template, n_workers=n_workers)
     leaves = jax.tree_util.tree_leaves(params_template)
     return sum(8 * int(l.size) * l.dtype.itemsize for l in leaves)
 
@@ -402,18 +416,15 @@ def make_train_step(
     )
 
     def sharded_body(state: TrainState, batch):
-        # strip the per-worker leading axis off the error memories and
-        # model_state: global (num_devices, *shape) → this device's (*shape)
-        strip = lambda t: jax.tree_util.tree_map(lambda m: m[0], t)
-        pad = lambda t: jax.tree_util.tree_map(lambda m: m[None], t)
         local = state._replace(
-            memories=strip(state.memories), model_state=strip(state.model_state)
+            memories=strip_leading(state.memories),
+            model_state=strip_leading(state.model_state),
         )
         new_state, loss = body(local, batch)
         return (
             new_state._replace(
-                memories=pad(new_state.memories),
-                model_state=pad(new_state.model_state),
+                memories=pad_leading(new_state.memories),
+                model_state=pad_leading(new_state.model_state),
             ),
             loss,
         )
@@ -434,7 +445,7 @@ def make_train_step(
     fn = jax.jit(sharded, donate_argnums=(0,) if donate_state else ())
     return CompiledStep(
         fn,
-        _reducer_bits(reducer, params_template) + LOSS_SYNC_BITS,
+        _reducer_bits(reducer, params_template, mesh.size) + LOSS_SYNC_BITS,
         mesh,
         reducer,
         optimizer,

@@ -60,9 +60,10 @@ def bandwidth_table(
     fabrics: Sequence[str] = ("1GbE", "10GbE", "100GbE", "ICI(v5e)"),
 ) -> Dict[str, FabricEstimate]:
     """Per-fabric step-time estimates for one training step. ``n_collectives``
-    is 3 for PowerSGD (P, Q, rank-1 — ``reducer.py:126-147``) and 1 for the
-    packed exact path (the reference's exact path used ~#params collectives;
-    ours packs into one)."""
+    drives the latency term; pass the COMPILED step's collective count from
+    ``utils.hlo_audit.collective_summary`` (as ``experiments.bandwidth_study``
+    does) — e.g. 3 for PowerSGD (P, Q, rank-1+loss after the combiner,
+    ``reducer.py:126-147``), 1 for the packed exact path."""
     payload = bits_per_step / 8.0
     out: Dict[str, FabricEstimate] = {}
     for fabric in fabrics:

@@ -25,10 +25,15 @@ _COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter", "collective-permut
 
 # result type of a collective op: a single typed shape ("f32[1234,8]{1,0}")
 # or — after XLA's all-reduce combiner merges compatible collectives — a
-# TUPLE of typed shapes ("(f32[1106]{0}, f32[])").
-_SHAPE = r"(\w+)\[([\d,]*)\](?:\{[\d,]*\})?"
+# TUPLE of typed shapes ("(f32[1106]{0}, f32[])"). The optional layout
+# suffix may carry TPU tiling/memory-space annotations ("{0:T(1024)S(1)}"),
+# hence [^}]* rather than digits-only.
+_SHAPE = r"(\w+)\[([\d,]*)\](?:\{[^}]*\})?"
+# tuple result types may nest parens inside TPU layout annotations
+# ("(f32[8]{0:T(1024)S(1)}, f32[])"), hence one level of nesting
+_TUPLE = r"\((?:[^()]|\([^)]*\))*\)"
 _OP_RE = re.compile(
-    r"((?:" + _SHAPE + r")|(?:\([^)]*\)))\s+"
+    r"((?:" + _SHAPE + r")|(?:" + _TUPLE + r"))\s+"
     r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)(?:-start)?\("
 )
 _SHAPE_RE = re.compile(_SHAPE)
@@ -114,7 +119,12 @@ def collective_summary(hlo_text: str) -> Dict[str, object]:
     }
 
 
-def compiled_hlo_text(jitted_fn, *example_args) -> str:
-    """The post-optimization HLO XLA actually runs (combiner passes applied)."""
-    compiled = jitted_fn.lower(*example_args).compile()
+def hlo_text_of_compiled(compiled) -> str:
+    """Post-optimization HLO text of an already-compiled executable."""
     return "\n".join(m.to_string() for m in compiled.runtime_executable().hlo_modules())
+
+
+def compiled_hlo_text(jitted_fn, *example_args) -> str:
+    """The post-optimization HLO XLA actually runs (combiner passes applied).
+    ``example_args`` may be concrete arrays or ``ShapeDtypeStruct``s (AOT)."""
+    return hlo_text_of_compiled(jitted_fn.lower(*example_args).compile())

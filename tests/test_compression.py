@@ -235,8 +235,13 @@ def test_compression_bits_ladder():
     qsgd = QSGDReducer().bits_per_step(template)
     topk = TopKReducer(k_fraction=0.01).bits_per_step(template)
     assert topk < sign < qsgd < exact  # 1% top-k at 64 bits/kept < 1 bit/elem
-    assert sign < exact / 30  # ~32x compression
+    assert sign < exact / 30  # ~32x compression (per contribution, W=1)
     assert qsgd < exact / 3.9  # ~4x
+    # gathered-result convention: the gather family's wire cost scales with
+    # W (each worker receives all contributions) — at W=8 sign is only ~4x
+    # under exact, while PowerSGD's allreduce payload is W-invariant
+    assert SignSGDReducer().bits_per_step(template, n_workers=8) == 8 * sign
+    assert exact / 5 < 8 * sign < exact / 3.9
 
 
 @pytest.mark.parametrize(
@@ -277,4 +282,4 @@ def test_compressors_train_ef_momentum(devices, reducer):
     assert losses[-1] < 0.2 * losses[0], losses
     from network_distributed_pytorch_tpu.parallel.trainer import LOSS_SYNC_BITS
 
-    assert step.bits_per_step == reducer.bits_per_step(params) + LOSS_SYNC_BITS
+    assert step.bits_per_step == reducer.bits_per_step(params, n_workers=8) + LOSS_SYNC_BITS

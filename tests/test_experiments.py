@@ -85,10 +85,16 @@ def test_bandwidth_study(devices):
     out = bandwidth_study.run(global_batch=64, reducer_ranks=(2,))
     res = out["results"]
     assert res["powersgd_r2"]["compression_ratio"] > 10
-    # slower fabrics must cost more time
-    for cfgname in res:
-        p = res[cfgname]["projected_step_s"]
+    for cfgname, r in res.items():
+        # slower fabrics must cost more time
+        p = r["projected_step_s"]
         assert p["1GbE"] > p["10GbE"] > p["100GbE"] > p["ICI(v5e)"]
+        # the projection is fed by the COMPILED step's collectives, and the
+        # analytic wire model must reconcile with them byte-exactly
+        assert r["audited_bits_per_step"] == r["bits_per_step"], (
+            cfgname, r["hlo_collectives"]
+        )
+        assert sum(r["hlo_collectives"].values()) >= 1
 
 
 def test_launch_cli(devices):

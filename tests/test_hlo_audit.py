@@ -120,3 +120,22 @@ def test_fsdp_hlo_payload_matches_analytic(devices):
     # analytic: gather + scatter of every padded leaf + the loss pmean
     # (LOSS_SYNC_BITS); model_state is {} here
     assert s["total_payload_bytes"] == step.bits_per_step // 8
+
+
+def test_audit_parses_tpu_layout_annotations():
+    """TPU HLO shapes carry tiling/memory-space layout suffixes
+    ("{0:T(1024)S(1)}") — the audit must parse them (a v5e-compiled module
+    previously audited as ZERO collectives)."""
+    from network_distributed_pytorch_tpu.utils.hlo_audit import audit_hlo
+
+    hlo = (
+        "  %psum.1 = f32[219724]{0:T(1024)S(1)} all-reduce(%c), "
+        "replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add\n"
+        "  %ar = (f32[53130]{0:T(1024)S(1)}, f32[106280]{0:T(1024)S(1)}, "
+        "f32[]{:T(128)}) all-reduce(%a, %b, %c), "
+        "replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add\n"
+    )
+    ops = audit_hlo(hlo)
+    assert len(ops) == 2
+    assert ops[0].payload_bytes == 4 * 219724
+    assert ops[1].payload_bytes == 4 * (53130 + 106280 + 1)
