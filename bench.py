@@ -107,6 +107,23 @@ def _init_backend():
     if os.environ.get("BENCH_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 
+    # persistent compilation cache: big-model compiles through the TPU
+    # tunnel are minutes-slow and the tunnel is flaky — caching the
+    # serialized executable on disk makes every retry (including this
+    # process's own re-exec ladder) resume instead of re-pay. Best-effort:
+    # backends that can't serialize just ignore it.
+    try:
+        cache_dir = os.environ.get(
+            "BENCH_XLA_CACHE",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla_cache"),
+        )
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:  # noqa: BLE001
+        print(f"# bench: compilation cache unavailable: {e}", file=sys.stderr)
+
     def _probe():
         box = {}
 

@@ -74,6 +74,17 @@ class HashTokenizer:
         return 3 + h % (self.vocab_size - 3)
 
     def __call__(self, texts: Sequence[str]) -> dict:
+        # hot loop runs in the native data runtime when available (parity
+        # asserted in tests/test_native_loader.py); Python loop otherwise
+        from ..native.loader import tokenize_hash
+
+        out = tokenize_hash(texts, self.vocab_size, self.max_len)
+        if out is not None:
+            return out
+        return self.python_call(texts)
+
+    def python_call(self, texts: Sequence[str]) -> dict:
+        """The reference Python implementation (also the native-parity oracle)."""
         ids = np.zeros((len(texts), self.max_len), dtype=np.int32)
         mask = np.zeros((len(texts), self.max_len), dtype=np.int32)
         for row, text in enumerate(texts):

@@ -219,4 +219,55 @@ void ndp_loader_destroy(void* loader) {
   delete L;
 }
 
+// ------------------------------------------------------------- tokenizer
+// Hash tokenizer (parity with data/imdb.HashTokenizer, the framework's
+// IMDb front end standing in for DistilBertTokenizerFast,
+// ddp_powersgd_distillBERT_IMDb/ddp_init.py:74-77): texts arrive as
+// PRE-LOWERCASED UTF-8 bytes (lowercasing is Unicode-aware and stays in
+// Python) with row offsets; each row splits on ASCII whitespace (the byte
+// subset of Python str.split()'s separators), words FNV-1a-hash into
+// [3, vocab), wrapped in [CLS]=1 / [SEP]=2, zero-padded to max_len.
+// Token-for-token equal to the Python implementation for any text whose
+// *whitespace* is ASCII (non-ASCII word bytes hash identically).
+
+static inline bool ndp_is_space(uint8_t b) {
+  // ' ' \t \n \v \f \r and the C0 separators \x1c-\x1f — exactly the
+  // single-byte characters Python's str.split() treats as whitespace
+  return b == 0x20 || (b >= 0x09 && b <= 0x0d) || (b >= 0x1c && b <= 0x1f);
+}
+
+void ndp_tokenize_hash(const uint8_t* bytes, const int64_t* offsets,
+                       int64_t n_texts, int32_t vocab_size, int32_t max_len,
+                       int n_threads, int32_t* ids_out, int32_t* mask_out) {
+  int64_t total = n_texts ? offsets[n_texts] : 0;
+  parallel_for(n_texts, effective_threads(total, n_threads),
+               [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const uint8_t* p = bytes + offsets[i];
+      const uint8_t* end = bytes + offsets[i + 1];
+      int32_t* ids = ids_out + i * max_len;
+      int32_t* mask = mask_out + i * max_len;
+      std::memset(ids, 0, (size_t)max_len * sizeof(int32_t));
+      std::memset(mask, 0, (size_t)max_len * sizeof(int32_t));
+      int32_t pos = 0;
+      ids[pos++] = 1;  // [CLS]
+      const int32_t max_words = max_len - 2;
+      int32_t words = 0;
+      while (p < end && words < max_words) {
+        while (p < end && ndp_is_space(*p)) ++p;
+        if (p >= end) break;
+        uint32_t h = 2166136261u;  // FNV-1a offset basis
+        while (p < end && !ndp_is_space(*p)) {
+          h = (h ^ (uint32_t)*p) * 16777619u;
+          ++p;
+        }
+        ids[pos++] = 3 + (int32_t)(h % (uint32_t)(vocab_size - 3));
+        ++words;
+      }
+      ids[pos++] = 2;  // [SEP]
+      for (int32_t j = 0; j < pos; ++j) mask[j] = 1;
+    }
+  });
+}
+
 }  // extern "C"

@@ -67,6 +67,34 @@ def gather_normalize_u8(
     return ((src[idx].astype(np.float32) / 255.0) - mean) / std
 
 
+def tokenize_hash(texts, vocab_size: int, max_len: int) -> Optional[dict]:
+    """Native hash tokenization (``data.imdb.HashTokenizer``'s hot loop in
+    multithreaded C++). Lowercasing AND whitespace splitting stay in Python
+    (both Unicode-aware and C-speed in CPython — ``" ".join(t.split())``
+    canonicalizes NBSP/NEL/etc to single spaces); the C++ side re-splits on
+    the now-guaranteed ASCII spaces and FNV-1a-hashes the word bytes, which
+    is the actually-hot loop. Token-for-token equal to the Python path for
+    ALL input. Returns None when the native library is unavailable (caller
+    falls back to the Python loop)."""
+    lib = load_library()
+    if lib is None:
+        return None
+    enc = [" ".join(t.lower().split()).encode("utf-8") for t in texts]
+    offsets = np.zeros(len(enc) + 1, np.int64)
+    if enc:
+        np.cumsum([len(b) for b in enc], out=offsets[1:])
+    blob = b"".join(enc)
+    buf = np.frombuffer(blob, np.uint8) if blob else np.zeros(1, np.uint8)
+    ids = np.zeros((len(enc), max_len), np.int32)
+    mask = np.zeros((len(enc), max_len), np.int32)
+    if enc:
+        lib.ndp_tokenize_hash(
+            buf.ctypes.data, offsets.ctypes.data, len(enc), vocab_size,
+            max_len, _N_THREADS, ids.ctypes.data, mask.ctypes.data,
+        )
+    return {"input_ids": ids, "attention_mask": mask}
+
+
 class NativeBatchLoader:
     """Prefetching batch loader over an in-memory (x, y) dataset.
 

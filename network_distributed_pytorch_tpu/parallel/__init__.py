@@ -16,6 +16,7 @@ from .comm import (  # noqa: F401
     all_gather_replicated,
 )
 from .packing import TensorPacker  # noqa: F401
+from .hierarchical import HierarchicalReducer  # noqa: F401
 from .reducers import ExactReducer, PowerSGDReducer  # noqa: F401
 from .compression import (  # noqa: F401
     TopKReducer,

@@ -45,6 +45,10 @@ class CollectiveOp:
     dtype: str
     shape: tuple
     payload_bytes: int
+    # participants of the op's FIRST replica group ({{0,1},{2,3}} → (0, 1));
+    # None when the HLO uses the iota form or omits groups (= all devices)
+    group: tuple = None
+    group_size: int = 0
 
 
 _GROUPS_RE = re.compile(r"replica_groups=(?:\{\{([\d,]+)\}|\[(\d+),(\d+)\])")
@@ -61,17 +65,19 @@ def _module_world(hlo_text: str) -> int:
     return best
 
 
-def _group_size(hlo_text: str, op_end: int) -> int:
-    """Replica-group size of the collective whose match ends at ``op_end``
-    (first group of `{{0,1,...},...}`, or S from the iota form `[G,S]<=[N]`).
-    Empty/absent replica_groups = one group of every participant."""
+def _group_info(hlo_text: str, op_end: int) -> tuple:
+    """(members, size) of the FIRST replica group of the collective whose
+    match ends at ``op_end`` — members from the explicit `{{0,1,...},...}`
+    form (None for the iota form `[G,S]<=[N]`); size from either. Empty or
+    absent replica_groups = one group of every participant."""
     line_end = hlo_text.find("\n", op_end)
     m = _GROUPS_RE.search(hlo_text, op_end, line_end if line_end != -1 else len(hlo_text))
     if m is None:
-        return _module_world(hlo_text)
+        return None, _module_world(hlo_text)
     if m.group(1) is not None:
-        return m.group(1).count(",") + 1
-    return int(m.group(3))
+        members = tuple(int(d) for d in m.group(1).split(","))
+        return members, len(members)
+    return None, int(m.group(3))
 
 
 def audit_hlo(hlo_text: str) -> List[CollectiveOp]:
@@ -98,10 +104,13 @@ def audit_hlo(hlo_text: str) -> List[CollectiveOp]:
             payload += n * _DTYPE_BYTES.get(dtype, 4)
             shapes.append(shape)
             dtypes.append(dtype)
+        group, gsize = _group_info(hlo_text, m.end())
         if kind == "reduce-scatter":
-            payload *= _group_size(hlo_text, m.end())
+            payload *= gsize
         ops.append(
-            CollectiveOp(kind, "+".join(dtypes), tuple(shapes), payload)
+            CollectiveOp(
+                kind, "+".join(dtypes), tuple(shapes), payload, group, gsize
+            )
         )
     return ops
 

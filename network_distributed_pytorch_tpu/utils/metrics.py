@@ -74,6 +74,7 @@ class MetricsLogger:
         times = [r.step_time_s for r in self.records[1:]]  # drop compile step
         return {
             "steps": len(self.records),
+            "first_loss": self.records[0].loss if self.records else None,
             "final_loss": self.records[-1].loss if self.records else None,
             "mean_step_time_s": sum(times) / len(times) if times else None,
             "bits_communicated": self._bits,

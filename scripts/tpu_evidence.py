@@ -1,0 +1,209 @@
+"""Full-preset hardware evidence on the real TPU chip (round-1 verdict #7).
+
+Runs the two flagship workloads at the REFERENCE's full configurations —
+PowerSGD CIFAR-10 (ResNet-152, global batch 512, r=4,
+``ddp_powersgd_guide_cifar10/ddp_init.py:26-36``) and PowerSGD IMDb
+(DistilBERT-base, 16/worker, r=16,
+``ddp_powersgd_distillBERT_IMDb/ddp_init.py:33-38``) — for a bounded number
+of steps on whatever accelerator is attached, recording step time,
+bytes/step, and the loss descent into ``artifacts/TPU_EVIDENCE.json``.
+Also captures a ``jax.profiler`` trace of a few ResNet-152 PowerSGD steps
+into ``artifacts/tpu_trace/`` (SURVEY §5 profiling evidence).
+
+Resilient by construction (the TPU tunnel is one-shot and can hang at
+backend init): the first device probe runs in a daemon thread with a
+deadline, every phase is individually try/except'd, and the artifact is
+written after every phase — a crash mid-script loses nothing already done.
+
+Usage:  python scripts/tpu_evidence.py [--steps N] [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+ARTIFACTS = os.path.join(REPO, "artifacts")
+OUT = os.path.join(ARTIFACTS, "TPU_EVIDENCE.json")
+
+evidence: dict = {"phases": {}}
+
+
+def _save() -> None:
+    os.makedirs(ARTIFACTS, exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(evidence, f, indent=1)
+
+
+def _probe_devices(timeout_s: int) -> list:
+    import threading
+
+    import jax
+
+    box: dict = {}
+
+    def worker():
+        try:
+            box["devices"] = jax.devices()
+        except BaseException as e:  # noqa: BLE001 — relayed
+            box["error"] = e
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise TimeoutError(f"backend init exceeded {timeout_s}s")
+    if "error" in box:
+        raise box["error"]
+    return box["devices"]
+
+
+def _phase(name: str, fn) -> None:
+    t0 = time.perf_counter()
+    try:
+        evidence["phases"][name] = {"ok": True, **fn()}
+    except Exception as e:  # noqa: BLE001 — recorded, never fatal
+        evidence["phases"][name] = {
+            "ok": False,
+            "error": f"{type(e).__name__}: {e}"[:500],
+        }
+    evidence["phases"][name]["wall_s"] = round(time.perf_counter() - t0, 2)
+    _save()
+    print(f"# phase {name}: {evidence['phases'][name].get('ok')}", flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--platform", default=None, help="override (e.g. cpu smoke)")
+    ap.add_argument("--init-timeout", type=int, default=120)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    try:
+        # persistent compile cache (shared with bench.py): retries after a
+        # tunnel kill resume instead of re-paying the multi-minute compile
+        cache_dir = os.path.join(REPO, ".xla_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:  # noqa: BLE001
+        print(f"# compilation cache unavailable: {e}", flush=True)
+
+    try:
+        devices = _probe_devices(args.init_timeout)
+    except BaseException as e:  # noqa: BLE001
+        evidence["error"] = f"backend init failed: {type(e).__name__}: {e}"[:500]
+        _save()
+        print(json.dumps(evidence), flush=True)
+        return 0
+    evidence["device"] = getattr(devices[0], "device_kind", devices[0].platform)
+    evidence["n_devices"] = len(devices)
+    evidence["steps"] = args.steps
+    _save()
+
+    from network_distributed_pytorch_tpu.experiments import (
+        powersgd_cifar10,
+        powersgd_imdb,
+    )
+    from network_distributed_pytorch_tpu.utils.config import ExperimentConfig
+
+    def cifar_full():
+        # the reference's flagship config — ResNet-152, global batch 512,
+        # r=4, EF-SGD lr .001 λ=.9 (ddp_powersgd_guide_cifar10/ddp_init.py)
+        cfg = ExperimentConfig(
+            training_epochs=1, global_batch_size=512, learning_rate=0.001,
+            reducer_rank=4, log_every=0,
+        )
+        out = powersgd_cifar10.run(
+            cfg, preset="full", max_steps_per_epoch=args.steps
+        )
+        return {
+            "experiment": out["experiment"],
+            "losses_first_last": [out.get("first_loss"), out.get("final_loss")],
+            "raw": {
+                k: v
+                for k, v in out.items()
+                if isinstance(v, (int, float, str, bool, list))
+            },
+        }
+
+    def imdb_full():
+        cfg = ExperimentConfig(
+            training_epochs=1, learning_rate=5e-5, reducer_rank=16,
+            global_batch_size=0, log_every=0,
+        )
+        out = powersgd_imdb.run(cfg, preset="full", max_steps_per_epoch=args.steps)
+        return {
+            "experiment": out["experiment"],
+            "raw": {
+                k: v
+                for k, v in out.items()
+                if isinstance(v, (int, float, str, bool, list))
+            },
+        }
+
+    def profile_trace():
+        # a short profiler capture of the bench flagship's PowerSGD step
+        # (ResNet-50 — compiles much faster than recompiling ResNet-152)
+        import jax.numpy as jnp
+
+        from network_distributed_pytorch_tpu.data import synthetic_cifar10
+        from network_distributed_pytorch_tpu.experiments.common import (
+            image_classifier_loss,
+        )
+        from network_distributed_pytorch_tpu.models import resnet50
+        from network_distributed_pytorch_tpu.parallel import (
+            PowerSGDReducer,
+            make_mesh,
+        )
+        from network_distributed_pytorch_tpu.parallel.trainer import make_train_step
+
+        mesh = make_mesh()
+        model = resnet50(num_classes=10, norm="batch", stem="imagenet")
+        variables = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=True
+        )
+        step = make_train_step(
+            image_classifier_loss(model, has_batch_stats=True),
+            PowerSGDReducer(random_seed=714, compression_rank=4, matricize="last"),
+            variables["params"], learning_rate=0.001, momentum=0.9,
+            algorithm="ef_momentum", mesh=mesh, donate_state=False,
+        )
+        state = step.init_state(
+            variables["params"],
+            model_state={"batch_stats": variables["batch_stats"]},
+        )
+        x, y = synthetic_cifar10(256, seed=0)
+        batch = (jnp.asarray(x), jnp.asarray(y))
+        state, loss = step(state, batch)  # compile + warmup
+        jax.block_until_ready(loss)
+        trace_dir = os.path.join(ARTIFACTS, "tpu_trace")
+        with jax.profiler.trace(trace_dir):
+            for _ in range(3):
+                state, loss = step(state, batch)
+            jax.block_until_ready(loss)
+        files = []
+        for root, _dirs, names in os.walk(trace_dir):
+            files += [os.path.join(os.path.relpath(root, ARTIFACTS), n) for n in names]
+        return {"trace_dir": "artifacts/tpu_trace", "trace_files": files[:20]}
+
+    _phase("powersgd_cifar10_full", cifar_full)
+    _phase("powersgd_imdb_full", imdb_full)
+    _phase("profile_trace", profile_trace)
+
+    print(json.dumps({k: evidence["phases"][k].get("ok") for k in evidence["phases"]}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
