@@ -6,6 +6,7 @@ from . import (  # noqa: F401
     bare_init,
     exact_cifar10,
     gpt_lm,
+    gpt_pp,
     imdb_baseline,
     powersgd_cifar10,
     powersgd_imdb,

@@ -41,7 +41,13 @@ def run(
     pretrained_variables=None,
     max_steps_per_epoch: Optional[int] = None,
     eval_after: bool = False,
+    strategy: str = "ddp",
 ) -> Dict:
+    """``strategy="ddp"`` is the reference's replicated-parameter exact DDP;
+    ``strategy="fsdp"`` runs the SAME workload with params/grads/optimizer
+    state ZeRO-3-sharded over the data axis (``parallel.fsdp`` — per-device
+    model+optimizer memory drops by ~1/world; the training math is still
+    exact data-parallel SGD)."""
     config = config or ExperimentConfig(
         training_epochs=1, global_batch_size=256, learning_rate=0.001
     )
@@ -60,15 +66,28 @@ def run(
     model_state = {"batch_stats": variables["batch_stats"]}
 
     loss_fn = image_classifier_loss(model, has_batch_stats=True)
-    step = make_train_step(
-        loss_fn,
-        ExactReducer(),
-        params,
-        learning_rate=config.learning_rate,
-        momentum=config.momentum,
-        algorithm="sgd",  # reference uses optim.SGD(lr, momentum=.9) — ddp_init.py:110
-        mesh=mesh,
-    )
+    assert strategy in ("ddp", "fsdp"), strategy
+    if strategy == "fsdp":
+        from ..parallel.fsdp import make_fsdp_train_step
+
+        step = make_fsdp_train_step(
+            loss_fn,
+            params,
+            learning_rate=config.learning_rate,
+            momentum=config.momentum,
+            algorithm="sgd",
+            mesh=mesh,
+        )
+    else:
+        step = make_train_step(
+            loss_fn,
+            ExactReducer(),
+            params,
+            learning_rate=config.learning_rate,
+            momentum=config.momentum,
+            algorithm="sgd",  # reference uses optim.SGD(lr, momentum=.9) — ddp_init.py:110
+            mesh=mesh,
+        )
     state = step.init_state(params, model_state=model_state)
 
     def batches(epoch):
@@ -84,12 +103,17 @@ def run(
         step, state, batches, config.training_epochs,
         rank=config.process_id, log_every=config.log_every,
     )
-    extra = {"preset": preset, "real_data": is_real, "num_devices": mesh.size}
+    extra = {
+        "preset": preset, "real_data": is_real, "num_devices": mesh.size,
+        "strategy": strategy,
+    }
     if eval_after:
         from .common import evaluate_image_classifier
 
+        eval_params = step.unshard(state) if strategy == "fsdp" else state.params
         test_x, test_y, _ = load_cifar10_or_synthetic(data_dir, train=False)
         extra["eval_accuracy"] = evaluate_image_classifier(
-            model, state.params, step.eval_model_state(state)["batch_stats"], test_x, test_y
+            model, eval_params, step.eval_model_state(state)["batch_stats"],
+            test_x, test_y,
         )
     return summarize("exact_cifar10", logger, extra)

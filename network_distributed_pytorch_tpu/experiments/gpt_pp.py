@@ -1,0 +1,145 @@
+"""GPT pipeline-parallel pretraining (beyond parity): the decoder trained
+over a ``pipe`` mesh axis with the hand-scheduled 1F1B schedule, FULL model
+differentiated (embed/wpe/blocks/ln_f/tied head — ``models.gpt.
+make_gpt_pipeline_train_fn``), driven from the same launcher as every other
+experiment.
+
+The reference has no pipeline parallelism at all (SURVEY §2.3: no stage
+partitioning, no send/recv); this experiment makes the framework's PP
+capability a user-facing entry point rather than a library-only feature.
+Stage activations hop neighbors via ``ppermute`` (ICI on TPU); bytes on
+wire are taken from the compiled step's HLO audit — pipelines move
+activations, not gradients, so the analytic reducer model doesn't apply.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.gpt import (
+    gpt_small,
+    gpt_tiny,
+    make_gpt_pipeline_train_fn,
+    split_gpt_params,
+)
+from ..parallel.mesh import make_mesh
+from ..parallel.pipeline import stacked_stage_params
+from ..utils.config import ExperimentConfig
+from ..utils.metrics import MetricsLogger
+from .common import summarize
+from .gpt_lm import synthetic_lm_batches
+
+
+def run(
+    config: Optional[ExperimentConfig] = None,
+    preset: str = "small",
+    mesh=None,
+    seq_len: int = 32,
+    steps_per_epoch: int = 15,
+    num_microbatches: int = 4,
+    max_steps_per_epoch: Optional[int] = None,
+) -> Dict:
+    config = config or ExperimentConfig(
+        training_epochs=1, global_batch_size=16, learning_rate=0.1,
+    )
+    if max_steps_per_epoch is not None:
+        steps_per_epoch = min(steps_per_epoch, max_steps_per_epoch)
+
+    if mesh is None:
+        devices = jax.devices()
+        mesh = make_mesh(
+            axis_sizes=(len(devices),), axis_names=("pipe",), devices=devices
+        )
+    n_stages = int(mesh.shape["pipe"])
+
+    vocab = 64 if preset == "small" else 1024
+    make_model = gpt_tiny if preset == "small" else gpt_small
+    # one or more homogeneous block stages per device
+    layers_per_stage = 1 if preset == "small" else max(1, 12 // n_stages)
+    model = make_model(
+        vocab_size=vocab,
+        max_position_embeddings=seq_len,
+        n_layers=n_stages * layers_per_stage,
+        dropout=0.0,  # pipeline stages run deterministically (make_gpt_stage_fn)
+        dtype=jnp.dtype(config.compute_dtype),
+    )
+    ids = jnp.zeros((1, seq_len), jnp.int32)
+    params = model.init(jax.random.PRNGKey(config.seed), ids)["params"]
+    embed, stages, final = split_gpt_params(params, n_stages)
+    stacked = stacked_stage_params(stages)
+
+    train = make_gpt_pipeline_train_fn(
+        model.config, layers_per_stage, num_microbatches
+    )
+    lr = config.learning_rate
+    mu = config.momentum
+
+    from jax.sharding import PartitionSpec as P
+
+    def step(carry, x, y):
+        embed, stacked, final, vel = carry
+        loss, grads = train(embed, stacked, final, x, y)
+        new_vel = jax.tree_util.tree_map(
+            lambda v, g: mu * v + g, vel, grads
+        )
+        upd = lambda p, v: jax.tree_util.tree_map(
+            lambda pp, vv: pp - lr * vv, p, v
+        )
+        embed, stacked, final = (
+            upd(embed, new_vel[0]),
+            upd(stacked, new_vel[1]),
+            upd(final, new_vel[2]),
+        )
+        return (embed, stacked, final, new_vel), loss
+
+    carry_specs = (P(), P("pipe"), P(), (P(), P("pipe"), P()))
+    jitted = jax.jit(
+        jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(carry_specs, P(), P()),
+            out_specs=(carry_specs, P()),
+        ),
+        donate_argnums=(0,),  # the carry is threaded, never reused
+    )
+    vel0 = jax.tree_util.tree_map(
+        jnp.zeros_like, (embed, stacked, final)
+    )
+    carry = (embed, stacked, final, vel0)
+
+    # honest wire accounting from the COMPILED step: a pipeline's traffic is
+    # activation ppermute hops (+ the schedule's masked psums), not reducer
+    # payloads — audit what XLA actually emits. AOT-compile ONCE; the same
+    # executable is audited and then drives the loop (shapes are constant).
+    from ..utils.hlo_audit import collective_summary, hlo_text_of_compiled
+
+    x0 = jnp.zeros((config.global_batch_size, seq_len), jnp.int32)
+    compiled = jitted.lower(carry, x0, x0).compile()
+    audit = collective_summary(hlo_text_of_compiled(compiled))
+    bits_per_step = 8 * audit["total_payload_bytes"]
+
+    logger = MetricsLogger(bits_per_step=bits_per_step, log_every=config.log_every)
+    for epoch in range(config.training_epochs):
+        for x, y in synthetic_lm_batches(
+            vocab, config.global_batch_size, seq_len, steps_per_epoch,
+            config.seed + epoch,
+        ):
+            logger.start_step()
+            carry, loss = compiled(carry, x, y)
+            logger.end_step(epoch, float(jax.device_get(loss)))
+        logger.end_epoch(epoch, rank=config.process_id)
+    return summarize(
+        "gpt_pp",
+        logger,
+        {
+            "n_stages": n_stages,
+            "layers_per_stage": layers_per_stage,
+            "num_microbatches": num_microbatches,
+            "vocab": vocab,
+            "seq_len": seq_len,
+            "hlo_collectives": audit["by_kind"],
+        },
+    )

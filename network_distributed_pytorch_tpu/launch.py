@@ -23,6 +23,7 @@ from .experiments import (
     bare_init,
     exact_cifar10,
     gpt_lm,
+    gpt_pp,
     imdb_baseline,
     powersgd_cifar10,
     powersgd_imdb,
@@ -38,6 +39,7 @@ EXPERIMENTS = {
     "imdb_baseline": imdb_baseline.run,
     "bandwidth_study": bandwidth_study.run,
     "gpt_lm": gpt_lm.run,
+    "gpt_pp": gpt_pp.run,
 }
 
 
@@ -67,6 +69,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32")
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--max-steps-per-epoch", type=int, default=None)
+    p.add_argument(
+        "--strategy", choices=["ddp", "fsdp"], default="ddp",
+        help="exact_cifar10 only: replicated DDP or ZeRO-3 fully-sharded",
+    )
     p.add_argument("--json", action="store_true", help="print the summary as JSON")
     return p
 
@@ -114,13 +120,15 @@ def main(argv=None) -> dict:
     if args.experiment in ("exact_cifar10", "powersgd_cifar10"):
         kwargs.update(preset=args.preset, data_dir=args.data_dir,
                       max_steps_per_epoch=args.max_steps_per_epoch)
+        if args.experiment == "exact_cifar10":
+            kwargs.update(strategy=args.strategy)
     elif args.experiment in ("powersgd_imdb", "imdb_baseline"):
         kwargs.update(preset=args.preset,
                       data_dir=None if args.data_dir == "./data" else args.data_dir,
                       max_steps_per_epoch=args.max_steps_per_epoch)
     elif args.experiment == "bandwidth_study":
         kwargs.update(preset=args.preset)
-    elif args.experiment == "gpt_lm":
+    elif args.experiment in ("gpt_lm", "gpt_pp"):
         kwargs.update(preset=args.preset, max_steps_per_epoch=args.max_steps_per_epoch)
 
     result = fn(**kwargs)

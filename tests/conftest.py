@@ -24,6 +24,18 @@ import jax  # noqa: E402
 # this conftest ran, the env var alone is too late — set the config directly.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite is hundreds of small XLA compiles;
+# caching serialized executables across runs cuts re-run wall time sharply
+# (first run pays, repeats hit). Safe to delete .xla_cache_tests/ anytime.
+_cache = os.path.join(os.path.dirname(os.path.dirname(__file__)), ".xla_cache_tests")
+try:
+    os.makedirs(_cache, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+except Exception:  # noqa: BLE001 — cache is an optimization, never required
+    pass
+
 import pytest  # noqa: E402
 
 
