@@ -57,3 +57,32 @@ def iterate_batches(
 
 def steps_per_epoch(n: int, batch_size: int, drop_last: bool = True) -> int:
     return n // batch_size if drop_last else -(-n // batch_size)
+
+
+def device_prefetch(batches, sharding=None, depth: int = 2):
+    """Asynchronously stage up to ``depth`` upcoming batches on device.
+
+    ``jax.device_put`` dispatches the host→device copy without blocking, so
+    staging batch N+1 (and N+2) while the jitted step runs batch N overlaps
+    the transfer with compute — the input-pipeline overlap torch DataLoader
+    gets from pinned-memory prefetch, done the JAX way. ``sharding`` should
+    be the step's batch sharding (e.g. ``mesh_lib.data_sharding(mesh)``) so
+    the copy lands directly in the right layout; None = default device
+    (single-process path).
+    """
+    from collections import deque
+
+    import jax
+
+    def stage(batch):
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, sharding), batch
+        )
+
+    queue = deque()
+    for batch in batches:
+        queue.append(stage(batch))
+        if len(queue) > depth:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()

@@ -7,6 +7,7 @@ from . import (  # noqa: F401
     exact_cifar10,
     gpt_lm,
     gpt_pp,
+    gpt_sp,
     imdb_baseline,
     powersgd_cifar10,
     powersgd_imdb,

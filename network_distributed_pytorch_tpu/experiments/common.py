@@ -28,9 +28,15 @@ def train_loop(
     watchdog: Any = None,
     heartbeat: Any = None,
     on_epoch_end: Optional[Callable[[int, TrainState], None]] = None,
+    prefetch: int = 2,
 ) -> Tuple[TrainState, MetricsLogger]:
     """Run ``epochs`` passes, logging loss / step-time / cumulative bits
     (the reference's per-epoch banner + the bits it never reported).
+
+    ``prefetch``: stage that many upcoming batches on device asynchronously
+    (``data.device_prefetch``, placed with the step's batch sharding) so the
+    host→device copy of batch N+1 overlaps the compute of batch N; 0
+    disables.
 
     Optional hooks (all default-off; :func:`resilient_train_loop` wires
     them): a ``utils.failure.StepWatchdog`` around every step, a
@@ -40,9 +46,27 @@ def train_loop(
     """
     import contextlib
 
+    from ..data import device_prefetch
+    from ..parallel.mesh import DATA_AXIS, data_sharding
+
+    # prefetch needs the step's batch sharding; on a mesh without the
+    # standard 'data' axis (e.g. the hierarchical ('dcn','ici') layout) the
+    # right spec isn't derivable here, so prefetch is skipped rather than
+    # mis-placed (a default-device put would force a reshard copy anyway)
+    mesh = getattr(step, "mesh", None)
+    sharding = None
+    if prefetch and mesh is not None:
+        if DATA_AXIS in mesh.axis_names:
+            sharding = data_sharding(mesh)
+        else:
+            prefetch = 0
+
     logger = MetricsLogger(bits_per_step=step.bits_per_step, log_every=log_every)
     for epoch in range(start_epoch, epochs):
-        for batch in batches_for_epoch(epoch):
+        batches = batches_for_epoch(epoch)
+        if prefetch:
+            batches = device_prefetch(batches, sharding, depth=prefetch)
+        for batch in batches:
             logger.start_step()
             ctx = (
                 watchdog.watch(f"epoch {epoch}")
@@ -59,6 +83,38 @@ def train_loop(
         if on_epoch_end is not None:
             on_epoch_end(epoch, state)
     return state, logger
+
+
+def audited_carry_loop(
+    jitted,
+    carry,
+    batches_for_epoch: Callable[[int], Iterator[Any]],
+    epochs: int,
+    example_batch,
+    rank: int = 0,
+    log_every: int = 0,
+) -> Tuple[Any, MetricsLogger, Dict]:
+    """Shared driver for hand-rolled ``(carry, *batch) -> (carry, loss)``
+    steps (the pipeline/sequence-parallel experiments, whose wire traffic is
+    activation collectives rather than reducer payloads): AOT-compile ONCE,
+    audit that same executable's HLO for honest bits-per-step, then run the
+    epoch loop on it. Returns ``(carry, logger, audit_summary)``."""
+    import jax as _jax
+
+    from ..utils.hlo_audit import collective_summary, hlo_text_of_compiled
+
+    compiled = jitted.lower(carry, *example_batch).compile()
+    audit = collective_summary(hlo_text_of_compiled(compiled))
+    logger = MetricsLogger(
+        bits_per_step=8 * audit["total_payload_bytes"], log_every=log_every
+    )
+    for epoch in range(epochs):
+        for batch in batches_for_epoch(epoch):
+            logger.start_step()
+            carry, loss = compiled(carry, *batch)
+            logger.end_step(epoch, float(_jax.device_get(loss)))
+        logger.end_epoch(epoch, rank=rank)
+    return carry, logger, audit
 
 
 def image_classifier_loss(model: nn.Module, has_batch_stats: bool):

@@ -28,8 +28,7 @@ from ..models.gpt import (
 from ..parallel.mesh import make_mesh
 from ..parallel.pipeline import stacked_stage_params
 from ..utils.config import ExperimentConfig
-from ..utils.metrics import MetricsLogger
-from .common import summarize
+from .common import audited_carry_loop, summarize
 from .gpt_lm import synthetic_lm_batches
 
 
@@ -112,25 +111,17 @@ def run(
 
     # honest wire accounting from the COMPILED step: a pipeline's traffic is
     # activation ppermute hops (+ the schedule's masked psums), not reducer
-    # payloads — audit what XLA actually emits. AOT-compile ONCE; the same
-    # executable is audited and then drives the loop (shapes are constant).
-    from ..utils.hlo_audit import collective_summary, hlo_text_of_compiled
-
+    # payloads — common.audited_carry_loop audits the ONE AOT executable
+    # that also drives the loop
     x0 = jnp.zeros((config.global_batch_size, seq_len), jnp.int32)
-    compiled = jitted.lower(carry, x0, x0).compile()
-    audit = collective_summary(hlo_text_of_compiled(compiled))
-    bits_per_step = 8 * audit["total_payload_bytes"]
-
-    logger = MetricsLogger(bits_per_step=bits_per_step, log_every=config.log_every)
-    for epoch in range(config.training_epochs):
-        for x, y in synthetic_lm_batches(
-            vocab, config.global_batch_size, seq_len, steps_per_epoch,
-            config.seed + epoch,
-        ):
-            logger.start_step()
-            carry, loss = compiled(carry, x, y)
-            logger.end_step(epoch, float(jax.device_get(loss)))
-        logger.end_epoch(epoch, rank=config.process_id)
+    batches = lambda epoch: synthetic_lm_batches(
+        vocab, config.global_batch_size, seq_len, steps_per_epoch,
+        config.seed + epoch,
+    )
+    carry, logger, audit = audited_carry_loop(
+        jitted, carry, batches, config.training_epochs, (x0, x0),
+        rank=config.process_id, log_every=config.log_every,
+    )
     return summarize(
         "gpt_pp",
         logger,

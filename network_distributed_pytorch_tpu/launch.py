@@ -24,6 +24,7 @@ from .experiments import (
     exact_cifar10,
     gpt_lm,
     gpt_pp,
+    gpt_sp,
     imdb_baseline,
     powersgd_cifar10,
     powersgd_imdb,
@@ -40,6 +41,7 @@ EXPERIMENTS = {
     "bandwidth_study": bandwidth_study.run,
     "gpt_lm": gpt_lm.run,
     "gpt_pp": gpt_pp.run,
+    "gpt_sp": gpt_sp.run,
 }
 
 
@@ -128,7 +130,7 @@ def main(argv=None) -> dict:
                       max_steps_per_epoch=args.max_steps_per_epoch)
     elif args.experiment == "bandwidth_study":
         kwargs.update(preset=args.preset)
-    elif args.experiment in ("gpt_lm", "gpt_pp"):
+    elif args.experiment in ("gpt_lm", "gpt_pp", "gpt_sp"):
         kwargs.update(preset=args.preset, max_steps_per_epoch=args.max_steps_per_epoch)
 
     result = fn(**kwargs)

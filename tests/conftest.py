@@ -26,8 +26,32 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: the suite is hundreds of small XLA compiles;
 # caching serialized executables across runs cuts re-run wall time sharply
-# (first run pays, repeats hit). Safe to delete .xla_cache_tests/ anytime.
-_cache = os.path.join(os.path.dirname(os.path.dirname(__file__)), ".xla_cache_tests")
+# (first run pays, repeats hit). XLA:CPU AOT entries bake in the compiling
+# host's CPU features and can SIGILL if replayed on a lesser machine, so the
+# cache directory is keyed by a fingerprint of this host's feature set — a
+# different machine/image gets a fresh cache instead of stale executables.
+# Safe to delete .xla_cache_tests/ anytime.
+def _host_fingerprint() -> str:
+    import hashlib
+    import platform as _platform
+
+    feat = _platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feat += line
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256(feat.encode()).hexdigest()[:12]
+
+
+_cache = os.path.join(
+    os.path.dirname(os.path.dirname(__file__)),
+    ".xla_cache_tests",
+    _host_fingerprint(),
+)
 try:
     os.makedirs(_cache, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", _cache)
