@@ -40,7 +40,18 @@ def run(
     steps_per_epoch: int = 15,
     num_microbatches: int = 4,
     max_steps_per_epoch: Optional[int] = None,
+    data_shards: int = 1,
+    reducer: str = "exact",
 ) -> Dict:
+    """``data_shards > 1`` composes DATA parallelism on top of the pipeline:
+    a ``('data', 'pipe')`` mesh, batch sharded over ``data``, per-shard
+    LOCAL gradients from the schedule (``params_varying_over``) reduced
+    across shards by a pluggable reducer — ``"exact"`` (pmean) or
+    ``"powersgd"`` (the reference's compressed algorithm, with its
+    error-feedback chain carried per worker). Compressed data parallelism
+    COMPOSED with pipeline parallelism is exactly the seam the reference's
+    hand-rolled-sync design exists for (SURVEY §2.3), applied to a strategy
+    it never had."""
     config = config or ExperimentConfig(
         training_epochs=1, global_batch_size=16, learning_rate=0.1,
     )
@@ -49,10 +60,19 @@ def run(
 
     if mesh is None:
         devices = jax.devices()
-        mesh = make_mesh(
-            axis_sizes=(len(devices),), axis_names=("pipe",), devices=devices
-        )
+        if data_shards > 1:
+            assert len(devices) % data_shards == 0, (len(devices), data_shards)
+            mesh = make_mesh(
+                axis_sizes=(data_shards, len(devices) // data_shards),
+                axis_names=("data", "pipe"),
+                devices=devices,
+            )
+        else:
+            mesh = make_mesh(
+                axis_sizes=(len(devices),), axis_names=("pipe",), devices=devices
+            )
     n_stages = int(mesh.shape["pipe"])
+    n_data = int(mesh.shape["data"]) if "data" in mesh.axis_names else 1
 
     vocab = 64 if preset == "small" else 1024
     make_model = gpt_tiny if preset == "small" else gpt_small
@@ -70,44 +90,118 @@ def run(
     embed, stages, final = split_gpt_params(params, n_stages)
     stacked = stacked_stage_params(stages)
 
+    assert reducer in ("exact", "powersgd"), reducer
+    if reducer == "powersgd" and n_data <= 1:
+        raise ValueError(
+            "reducer='powersgd' needs data_shards > 1: with a single data"
+            " shard there is no cross-shard collective to compress — the"
+            " rank-r approximation would only add gradient error for zero"
+            " wire savings"
+        )
     train = make_gpt_pipeline_train_fn(
-        model.config, layers_per_stage, num_microbatches
+        model.config,
+        layers_per_stage,
+        num_microbatches,
+        params_varying_over=("data",) if n_data > 1 else (),
     )
     lr = config.learning_rate
     mu = config.momentum
 
     from jax.sharding import PartitionSpec as P
 
-    def step(carry, x, y):
-        embed, stacked, final, vel = carry
-        loss, grads = train(embed, stacked, final, x, y)
-        new_vel = jax.tree_util.tree_map(
-            lambda v, g: mu * v + g, vel, grads
-        )
-        upd = lambda p, v: jax.tree_util.tree_map(
-            lambda pp, vv: pp - lr * vv, p, v
-        )
-        embed, stacked, final = (
-            upd(embed, new_vel[0]),
-            upd(stacked, new_vel[1]),
-            upd(final, new_vel[2]),
-        )
-        return (embed, stacked, final, new_vel), loss
+    from ..parallel import ExactReducer, PowerSGDReducer
+    from ..parallel.trainer import pad_leading, strip_leading
 
-    carry_specs = (P(), P("pipe"), P(), (P(), P("pipe"), P()))
+    def make_red():
+        return (
+            PowerSGDReducer(
+                random_seed=config.seed, compression_rank=config.reducer_rank,
+                matricize="last",
+            )
+            if reducer == "powersgd"
+            else ExactReducer()
+        )
+
+    # one reducer PER param group: the stage grads are pipe-VARYING while
+    # embed/final grads are pipe-invariant — a single packed reduction would
+    # mix the two and poison the replicated params' variance. The stacked
+    # group's state (PowerSGD warm-start Q) is pipe-varying, so it is
+    # carried per-pipe-device (leading 'pipe' axis, strip/pad).
+    red_e, red_s, red_f = make_red(), make_red(), make_red()
+    params0 = (embed, stacked, final)
+    # the stacked reducer runs on THIS device's (1, ...) stage slice, so its
+    # state is sized from the local template, then tiled per pipe device
+    local_stacked = jax.tree_util.tree_map(lambda p: p[:1], stacked)
+    reducer_state0 = (
+        red_e.init(embed),
+        jax.tree_util.tree_map(
+            lambda x_: jnp.broadcast_to(x_[None], (n_stages,) + jnp.shape(x_)),
+            red_s.init(local_stacked),
+        ),
+        red_f.init(final),
+    )
+    data_axis = "data" if n_data > 1 else None
+
+    def step(carry, x, y):
+        params3, vel, mem, rstate = carry
+        rs_e, rs_s, rs_f = rstate
+        rs_s = strip_leading(rs_s)
+        if data_axis is not None:
+            mem = strip_leading(mem)
+        loss, grads = train(*params3, x, y)
+        if data_axis is not None:
+            loss = jax.lax.pmean(loss, data_axis)
+        # EF chain over the data axis (Algorithm 2: send = g + e); with the
+        # exact reducer the memories stay zero and this is plain pmean-DDP
+        send = jax.tree_util.tree_map(jnp.add, grads, mem)
+        rs_e, d_e, m_e, _ = red_e.reduce(rs_e, send[0], data_axis)
+        rs_s, d_s, m_s, _ = red_s.reduce(rs_s, send[1], data_axis)
+        rs_f, d_f, m_f, _ = red_f.reduce(rs_f, send[2], data_axis)
+        delta, mem = (d_e, d_s, d_f), (m_e, m_s, m_f)
+        new_vel = jax.tree_util.tree_map(lambda v, d: mu * v + d, vel, delta)
+        update = (
+            jax.tree_util.tree_map(jnp.add, delta, new_vel)
+            if reducer == "powersgd"  # ef_momentum: p -= lr*(delta + m)
+            else new_vel  # torch SGD: p -= lr*v
+        )
+        params3 = jax.tree_util.tree_map(
+            lambda p, u: p - lr * u, params3, update
+        )
+        if data_axis is not None:
+            mem = pad_leading(mem)
+        rstate = (rs_e, pad_leading(rs_s), rs_f)
+        return (params3, new_vel, mem, rstate), loss
+
+    psp = (P(), P("pipe"), P())
+    if n_data > 1:
+        # memories are per-data-worker: leading axis over 'data'; the stage
+        # slice inside keeps its 'pipe' sharding on the next dim
+        mem_spec = (
+            P("data"), P("data", "pipe"), P("data"),
+        )
+        batch_spec = P("data")
+    else:
+        mem_spec = psp
+        batch_spec = P()
+    carry_specs = (psp, psp, mem_spec, psp)
     jitted = jax.jit(
         jax.shard_map(
             step,
             mesh=mesh,
-            in_specs=(carry_specs, P(), P()),
+            in_specs=(carry_specs, batch_spec, batch_spec),
             out_specs=(carry_specs, P()),
         ),
         donate_argnums=(0,),  # the carry is threaded, never reused
     )
-    vel0 = jax.tree_util.tree_map(
-        jnp.zeros_like, (embed, stacked, final)
+    vel0 = jax.tree_util.tree_map(jnp.zeros_like, params0)
+    # distinct buffers from vel0 — the donated carry must not alias
+    mem0 = jax.tree_util.tree_map(
+        (lambda p: jnp.zeros((n_data,) + p.shape, p.dtype))
+        if n_data > 1
+        else jnp.zeros_like,
+        params0,
     )
-    carry = (embed, stacked, final, vel0)
+    carry = (params0, vel0, mem0, reducer_state0)
 
     # honest wire accounting from the COMPILED step: a pipeline's traffic is
     # activation ppermute hops (+ the schedule's masked psums), not reducer
@@ -127,6 +221,8 @@ def run(
         logger,
         {
             "n_stages": n_stages,
+            "data_shards": n_data,
+            "reducer": reducer,
             "layers_per_stage": layers_per_stage,
             "num_microbatches": num_microbatches,
             "vocab": vocab,

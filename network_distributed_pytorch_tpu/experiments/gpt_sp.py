@@ -63,8 +63,18 @@ def run(
         dtype=jnp.dtype(config.compute_dtype),
     )
     if seq_impl == "ulysses":
-        # ulysses redistributes heads over shards: n_heads % n_shards == 0
-        overrides.update(n_heads=n_shards, dim=4 * n_shards, hidden_dim=8 * n_shards)
+        # ulysses redistributes heads over shards (n_heads % n_shards == 0).
+        # Only the head COUNT is adjusted when needed — the preset's dim and
+        # hidden size are preserved (head_dim just shrinks).
+        base_heads = make_model(**overrides).config.n_heads
+        if base_heads % n_shards != 0:
+            base_dim = make_model(**overrides).config.dim
+            assert base_dim % n_shards == 0, (
+                f"ulysses on {n_shards} shards needs n_heads (or dim)"
+                f" divisible by the shard count; preset has"
+                f" n_heads={base_heads}, dim={base_dim}"
+            )
+            overrides["n_heads"] = n_shards
     model = make_model(seq_axis="seq", seq_impl=seq_impl, **overrides)
     init_model = make_model(**overrides)
     params = init_model.init(

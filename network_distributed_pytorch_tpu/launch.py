@@ -75,6 +75,16 @@ def build_parser() -> argparse.ArgumentParser:
         "--strategy", choices=["ddp", "fsdp"], default="ddp",
         help="exact_cifar10 only: replicated DDP or ZeRO-3 fully-sharded",
     )
+    p.add_argument(
+        "--data-shards", type=int, default=1,
+        help="gpt_pp only: compose data parallelism over the pipeline "
+             "(mesh ('data','pipe'))",
+    )
+    p.add_argument(
+        "--pp-reducer", choices=["exact", "powersgd"], default="exact",
+        help="gpt_pp only: cross-shard gradient reduction when "
+             "--data-shards > 1",
+    )
     p.add_argument("--json", action="store_true", help="print the summary as JSON")
     return p
 
@@ -132,6 +142,8 @@ def main(argv=None) -> dict:
         kwargs.update(preset=args.preset)
     elif args.experiment in ("gpt_lm", "gpt_pp", "gpt_sp"):
         kwargs.update(preset=args.preset, max_steps_per_epoch=args.max_steps_per_epoch)
+        if args.experiment == "gpt_pp":
+            kwargs.update(data_shards=args.data_shards, reducer=args.pp_reducer)
 
     result = fn(**kwargs)
     if args.json:

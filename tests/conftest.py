@@ -35,7 +35,12 @@ def _host_fingerprint() -> str:
     import hashlib
     import platform as _platform
 
-    feat = _platform.machine()
+    # machine + full platform string + processor brand: on hosts without
+    # /proc/cpuinfo (macOS) the platform/processor strings still separate
+    # e.g. Rosetta from native and most ISA generations
+    feat = "|".join(
+        (_platform.machine(), _platform.platform(), _platform.processor())
+    )
     try:
         with open("/proc/cpuinfo") as f:
             for line in f:
