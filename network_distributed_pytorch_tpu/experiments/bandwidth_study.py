@@ -107,6 +107,45 @@ def run(
 
     tables = {}
     results = {}
+
+    # communication AVOIDANCE baseline (parallel.localsgd): sync_every local
+    # steps then ONE parameter allreduce — the PowerSGD paper's own baseline
+    # family, projected at its amortized per-step wire cost
+    from ..parallel import make_local_sgd_train_fn
+
+    sync_every = 8
+    local = make_local_sgd_train_fn(
+        loss_fn, variables["params"], learning_rate=config.learning_rate,
+        momentum=config.momentum, sync_every=sync_every, mesh=mesh,
+        donate_state=False,
+    )
+    lstate = local.init_state(
+        variables["params"], model_state={"batch_stats": variables["batch_stats"]}
+    )
+    lbatches = tuple(
+        jnp.broadcast_to(b[None], (sync_every,) + b.shape) for b in batch
+    )
+    lstate, llosses = local(lstate, lbatches)  # compile + warmup
+    jax.block_until_ready(llosses)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        lstate, llosses = local(lstate, lbatches)
+    jax.block_until_ready(llosses)
+    l_step_s = (time.perf_counter() - t0) / (3 * sync_every)
+    l_bits_per_step = local.bits_per_round / sync_every
+    l_table = bandwidth_table(
+        l_bits_per_step, l_step_s, n_workers,
+        n_collectives=1.0 / sync_every,  # one collective per sync_every steps
+    )
+    tables[f"local_sgd_h{sync_every}"] = l_table
+    results[f"local_sgd_h{sync_every}"] = {
+        "bits_per_step": l_bits_per_step,
+        "bits_per_round": local.bits_per_round,
+        "sync_every": sync_every,
+        "mbytes_per_step": l_bits_per_step / 8e6,
+        "measured_step_s": l_step_s,
+        "projected_step_s": {f: e.step_time_s for f, e in l_table.items()},
+    }
     for name, (reducer, algorithm) in configs.items():
         step_mesh, step_axis = mesh, "data"
         if name.startswith("hier_"):

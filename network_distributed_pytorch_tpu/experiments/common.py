@@ -93,27 +93,45 @@ def audited_carry_loop(
     example_batch,
     rank: int = 0,
     log_every: int = 0,
+    checkpoint_dir: Optional[str] = None,
 ) -> Tuple[Any, MetricsLogger, Dict]:
     """Shared driver for hand-rolled ``(carry, *batch) -> (carry, loss)``
     steps (the pipeline/sequence-parallel experiments, whose wire traffic is
     activation collectives rather than reducer payloads): AOT-compile ONCE,
     audit that same executable's HLO for honest bits-per-step, then run the
-    epoch loop on it. Returns ``(carry, logger, audit_summary)``."""
+    epoch loop on it. With ``checkpoint_dir``, the carry is saved at every
+    epoch boundary and the newest checkpoint is resumed on entry
+    (deterministic per-epoch batch streams ⇒ a crash-restart converges to
+    the same state as an uninterrupted run, like ``resilient_train_loop``).
+    Returns ``(carry, logger, audit_summary)``."""
     import jax as _jax
 
     from ..utils.hlo_audit import collective_summary, hlo_text_of_compiled
+
+    start_epoch = 0
+    if checkpoint_dir is not None:
+        from ..utils.checkpoint import latest_step_path, restore_checkpoint
+
+        latest = latest_step_path(checkpoint_dir)
+        if latest is not None:
+            carry = restore_checkpoint(latest, _jax.device_get(carry))
+            start_epoch = int(latest.rsplit("step_", 1)[1]) + 1
 
     compiled = jitted.lower(carry, *example_batch).compile()
     audit = collective_summary(hlo_text_of_compiled(compiled))
     logger = MetricsLogger(
         bits_per_step=8 * audit["total_payload_bytes"], log_every=log_every
     )
-    for epoch in range(epochs):
+    for epoch in range(start_epoch, epochs):
         for batch in batches_for_epoch(epoch):
             logger.start_step()
             carry, loss = compiled(carry, *batch)
             logger.end_step(epoch, float(_jax.device_get(loss)))
         logger.end_epoch(epoch, rank=rank)
+        if checkpoint_dir is not None:
+            from ..utils.checkpoint import save_checkpoint
+
+            save_checkpoint(checkpoint_dir, carry, step=epoch)
     return carry, logger, audit
 
 
@@ -187,8 +205,23 @@ def evaluate_text_classifier(model, params, split, batch_size: int = 64) -> floa
     return correct / max(total, 1)
 
 
-def summarize(name: str, logger: MetricsLogger, extra: Optional[Dict] = None) -> Dict:
+def summarize(
+    name: str,
+    logger: MetricsLogger,
+    extra: Optional[Dict] = None,
+    perplexity: bool = False,
+) -> Dict:
+    """Summary dict for an experiment run. ``perplexity=True`` (LM
+    experiments) adds ``final_perplexity = exp(final_loss)``, None-safe for
+    resumed-already-complete runs with zero recorded steps."""
     out = {"experiment": name, **logger.summary()}
+    if perplexity:
+        import math
+
+        fl = out.get("final_loss")
+        out["final_perplexity"] = (
+            math.exp(min(fl, 30.0)) if fl is not None else None
+        )
     if extra:
         out.update(extra)
     return out

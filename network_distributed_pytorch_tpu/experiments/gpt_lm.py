@@ -95,5 +95,8 @@ def run(
     return summarize(
         "gpt_lm",
         logger,
-        {"reducer": reducer, "vocab": vocab, "seq_len": seq_len},
+        {
+            "reducer": reducer, "vocab": vocab, "seq_len": seq_len,
+        },
+        perplexity=True,
     )

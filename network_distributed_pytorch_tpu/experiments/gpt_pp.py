@@ -42,6 +42,7 @@ def run(
     max_steps_per_epoch: Optional[int] = None,
     data_shards: int = 1,
     reducer: str = "exact",
+    checkpoint_dir: Optional[str] = None,
 ) -> Dict:
     """``data_shards > 1`` composes DATA parallelism on top of the pipeline:
     a ``('data', 'pipe')`` mesh, batch sharded over ``data``, per-shard
@@ -110,7 +111,12 @@ def run(
     from jax.sharding import PartitionSpec as P
 
     from ..parallel import ExactReducer, PowerSGDReducer
-    from ..parallel.trainer import pad_leading, strip_leading
+    from ..parallel.trainer import (
+        ef_momentum_update,
+        pad_leading,
+        sgd_momentum_update,
+        strip_leading,
+    )
 
     def make_red():
         return (
@@ -122,35 +128,43 @@ def run(
             else ExactReducer()
         )
 
-    # one reducer PER param group: the stage grads are pipe-VARYING while
-    # embed/final grads are pipe-invariant — a single packed reduction would
-    # mix the two and poison the replicated params' variance. The stacked
-    # group's state (PowerSGD warm-start Q) is pipe-varying, so it is
-    # carried per-pipe-device (leading 'pipe' axis, strip/pad).
-    red_e, red_s, red_f = make_red(), make_red(), make_red()
     params0 = (embed, stacked, final)
-    # the stacked reducer runs on THIS device's (1, ...) stage slice, so its
-    # state is sized from the local template, then tiled per pipe device
-    local_stacked = jax.tree_util.tree_map(lambda p: p[:1], stacked)
-    reducer_state0 = (
-        red_e.init(embed),
-        jax.tree_util.tree_map(
-            lambda x_: jnp.broadcast_to(x_[None], (n_stages,) + jnp.shape(x_)),
-            red_s.init(local_stacked),
-        ),
-        red_f.init(final),
-    )
     data_axis = "data" if n_data > 1 else None
+    if data_axis is not None:
+        # one reducer PER param group: the stage grads are pipe-VARYING
+        # while embed/final grads are pipe-invariant — a single packed
+        # reduction would mix the two and poison the replicated params'
+        # variance. The stacked group's state (PowerSGD warm-start Q) is
+        # pipe-varying, so it is carried per-pipe-device (leading 'pipe'
+        # axis, strip/pad), sized from THIS device's (1, ...) stage slice.
+        red_e, red_s, red_f = make_red(), make_red(), make_red()
+        local_stacked = jax.tree_util.tree_map(lambda p: p[:1], stacked)
+        reducer_state0 = (
+            red_e.init(embed),
+            jax.tree_util.tree_map(
+                lambda x_: jnp.broadcast_to(
+                    x_[None], (n_stages,) + jnp.shape(x_)
+                ),
+                red_s.init(local_stacked),
+            ),
+            red_f.init(final),
+        )
+    else:
+        # pipeline-only: no cross-shard reduction — no EF state at all
+        reducer_state0 = ({}, {}, {})
 
     def step(carry, x, y):
         params3, vel, mem, rstate = carry
+        loss, grads = train(*params3, x, y)
+        if data_axis is None:
+            # pipeline-only: no cross-shard collective, no EF machinery —
+            # grads feed the optimizer directly (mem/rstate ride as empty)
+            params3, new_vel = sgd_momentum_update(params3, vel, grads, lr, mu)
+            return (params3, new_vel, mem, rstate), loss
         rs_e, rs_s, rs_f = rstate
         rs_s = strip_leading(rs_s)
-        if data_axis is not None:
-            mem = strip_leading(mem)
-        loss, grads = train(*params3, x, y)
-        if data_axis is not None:
-            loss = jax.lax.pmean(loss, data_axis)
+        mem = strip_leading(mem)
+        loss = jax.lax.pmean(loss, data_axis)
         # EF chain over the data axis (Algorithm 2: send = g + e); with the
         # exact reducer the memories stay zero and this is plain pmean-DDP
         send = jax.tree_util.tree_map(jnp.add, grads, mem)
@@ -158,19 +172,11 @@ def run(
         rs_s, d_s, m_s, _ = red_s.reduce(rs_s, send[1], data_axis)
         rs_f, d_f, m_f, _ = red_f.reduce(rs_f, send[2], data_axis)
         delta, mem = (d_e, d_s, d_f), (m_e, m_s, m_f)
-        new_vel = jax.tree_util.tree_map(lambda v, d: mu * v + d, vel, delta)
-        update = (
-            jax.tree_util.tree_map(jnp.add, delta, new_vel)
-            if reducer == "powersgd"  # ef_momentum: p -= lr*(delta + m)
-            else new_vel  # torch SGD: p -= lr*v
+        update_rule = (
+            ef_momentum_update if reducer == "powersgd" else sgd_momentum_update
         )
-        params3 = jax.tree_util.tree_map(
-            lambda p, u: p - lr * u, params3, update
-        )
-        if data_axis is not None:
-            mem = pad_leading(mem)
-        rstate = (rs_e, pad_leading(rs_s), rs_f)
-        return (params3, new_vel, mem, rstate), loss
+        params3, new_vel = update_rule(params3, vel, delta, lr, mu)
+        return (params3, new_vel, pad_leading(mem), (rs_e, pad_leading(rs_s), rs_f)), loss
 
     psp = (P(), P("pipe"), P())
     if n_data > 1:
@@ -194,12 +200,14 @@ def run(
         donate_argnums=(0,),  # the carry is threaded, never reused
     )
     vel0 = jax.tree_util.tree_map(jnp.zeros_like, params0)
-    # distinct buffers from vel0 — the donated carry must not alias
-    mem0 = jax.tree_util.tree_map(
-        (lambda p: jnp.zeros((n_data,) + p.shape, p.dtype))
+    # per-data-worker EF memories (distinct buffers from vel0 — the donated
+    # carry must not alias); empty on the pipeline-only path
+    mem0 = (
+        jax.tree_util.tree_map(
+            lambda p: jnp.zeros((n_data,) + p.shape, p.dtype), params0
+        )
         if n_data > 1
-        else jnp.zeros_like,
-        params0,
+        else ({}, {}, {})
     )
     carry = (params0, vel0, mem0, reducer_state0)
 
@@ -215,6 +223,7 @@ def run(
     carry, logger, audit = audited_carry_loop(
         jitted, carry, batches, config.training_epochs, (x0, x0),
         rank=config.process_id, log_every=config.log_every,
+        checkpoint_dir=checkpoint_dir,
     )
     return summarize(
         "gpt_pp",
@@ -229,4 +238,5 @@ def run(
             "seq_len": seq_len,
             "hlo_collectives": audit["by_kind"],
         },
+        perplexity=True,
     )

@@ -39,6 +39,7 @@ def run(
     seq_len: int = 256,
     steps_per_epoch: int = 15,
     max_steps_per_epoch: Optional[int] = None,
+    checkpoint_dir: Optional[str] = None,
 ) -> Dict:
     config = config or ExperimentConfig(
         training_epochs=1, global_batch_size=8, learning_rate=0.1,
@@ -119,6 +120,7 @@ def run(
     carry, logger, audit = audited_carry_loop(
         jitted, carry, batches, config.training_epochs, (x0, x0),
         rank=config.process_id, log_every=config.log_every,
+        checkpoint_dir=checkpoint_dir,
     )
     return summarize(
         "gpt_sp",
@@ -131,4 +133,5 @@ def run(
             "vocab": vocab,
             "hlo_collectives": audit["by_kind"],
         },
+        perplexity=True,
     )

@@ -85,6 +85,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="gpt_pp only: cross-shard gradient reduction when "
              "--data-shards > 1",
     )
+    p.add_argument(
+        "--checkpoint-dir", type=str, default=None,
+        help="gpt_pp/gpt_sp: save the carry per epoch and resume the newest",
+    )
     p.add_argument("--json", action="store_true", help="print the summary as JSON")
     return p
 
@@ -144,6 +148,8 @@ def main(argv=None) -> dict:
         kwargs.update(preset=args.preset, max_steps_per_epoch=args.max_steps_per_epoch)
         if args.experiment == "gpt_pp":
             kwargs.update(data_shards=args.data_shards, reducer=args.pp_reducer)
+        if args.experiment in ("gpt_pp", "gpt_sp"):
+            kwargs.update(checkpoint_dir=args.checkpoint_dir)
 
     result = fn(**kwargs)
     if args.json:

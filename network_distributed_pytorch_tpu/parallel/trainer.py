@@ -124,6 +124,31 @@ def pad_leading(tree: PyTree) -> PyTree:
     return jax.tree_util.tree_map(lambda m: m[None], tree)
 
 
+def sgd_momentum_update(
+    params: PyTree, momenta: PyTree, delta: PyTree, lr: float, mu: float
+) -> Tuple[PyTree, PyTree]:
+    """torch ``optim.SGD`` with momentum: ``v ← μ·v + Δ; p ← p − lr·v``
+    (the exact-DDP trainer's rule, ``ddp_guide_cifar10/ddp_init.py:110``).
+    Shared by ``make_step_fn`` and the hand-rolled experiment steps."""
+    momenta = jax.tree_util.tree_map(lambda m, d: mu * m + d, momenta, delta)
+    params = jax.tree_util.tree_map(
+        lambda p, m: p - lr * m, params, momenta
+    )
+    return params, momenta
+
+
+def ef_momentum_update(
+    params: PyTree, momenta: PyTree, delta: PyTree, lr: float, mu: float
+) -> Tuple[PyTree, PyTree]:
+    """PowerSGD Algorithm 2 lines 12-13: ``m ← λ·m + Δ; p ← p − lr·(Δ + m)``
+    (``ddp_powersgd_guide_cifar10/ddp_init.py:166-178``)."""
+    momenta = jax.tree_util.tree_map(lambda m, d: mu * m + d, momenta, delta)
+    params = jax.tree_util.tree_map(
+        lambda p, d, m: p - lr * (d + m), params, delta, momenta
+    )
+    return params, momenta
+
+
 def collapse_per_worker(model_state: PyTree, reduce: str = "mean") -> PyTree:
     """Collapse a per-worker model_state (leading ``num_devices`` axis of
     local BN running stats — the reference's unsynced-BN torch-DDP semantics)
@@ -204,16 +229,9 @@ def make_step_fn(
             reducer_state, delta, memories, _ = reducer.reduce(
                 state.reducer_state, send, axis_name
             )
-            # (Algo 2 line 12) m ← λ·m + Δ  (ddp_init.py:166-172)
-            momenta = jax.tree_util.tree_map(
-                lambda m, d: momentum * m + d, state.momenta, delta
-            )
-            # (Algo 2 line 13) p ← p − lr·(Δ + m)  (ddp_init.py:172-178)
-            params = jax.tree_util.tree_map(
-                lambda p, d, m: p - learning_rate * (d + m),
-                state.params,
-                delta,
-                momenta,
+            # (Algo 2 lines 12-13)
+            params, momenta = ef_momentum_update(
+                state.params, state.momenta, delta, learning_rate, momentum
             )
         elif algorithm == "optax":
             reducer_state, delta, memories, _ = reducer.reduce(
@@ -229,25 +247,24 @@ def make_step_fn(
                 state.reducer_state, grads, axis_name
             )
             if algorithm == "sgd":
-                # torch SGD: v ← μ·v + g; p ← p − lr·v
-                momenta = jax.tree_util.tree_map(
-                    lambda m, d: momentum * m + d, state.momenta, delta
-                )
-                update = momenta
-            elif algorithm == "sgd_nesterov":
-                # torch SGD nesterov: v ← μ·v + g; p ← p − lr·(g + μ·v)
-                momenta = jax.tree_util.tree_map(
-                    lambda m, d: momentum * m + d, state.momenta, delta
-                )
-                update = jax.tree_util.tree_map(
-                    lambda d, m: d + momentum * m, delta, momenta
+                params, momenta = sgd_momentum_update(
+                    state.params, state.momenta, delta, learning_rate, momentum
                 )
             else:
-                momenta = state.momenta
-                update = delta
-            params = jax.tree_util.tree_map(
-                lambda p, u: p - learning_rate * u, state.params, update
-            )
+                if algorithm == "sgd_nesterov":
+                    # torch SGD nesterov: v ← μ·v + g; p ← p − lr·(g + μ·v)
+                    momenta = jax.tree_util.tree_map(
+                        lambda m, d: momentum * m + d, state.momenta, delta
+                    )
+                    update = jax.tree_util.tree_map(
+                        lambda d, m: d + momentum * m, delta, momenta
+                    )
+                else:
+                    momenta = state.momenta
+                    update = delta
+                params = jax.tree_util.tree_map(
+                    lambda p, u: p - learning_rate * u, state.params, update
+                )
 
         # report the globally-averaged loss (the reference prints per-rank
         # epoch means, ddp_init.py:183; global mean is strictly more useful)

@@ -23,8 +23,9 @@ import orbax.checkpoint as ocp
 from ..parallel.trainer import TrainState
 
 
-def save_checkpoint(path: str, state: TrainState, step: Optional[int] = None) -> str:
-    """Save a TrainState (blocking). Returns the final checkpoint path."""
+def save_checkpoint(path: str, state: Any, step: Optional[int] = None) -> str:
+    """Save a state pytree — a ``TrainState`` or any experiment carry —
+    (blocking). Returns the final checkpoint path."""
     path = os.path.abspath(path)
     if step is not None:
         path = os.path.join(path, f"step_{step}")
@@ -33,10 +34,10 @@ def save_checkpoint(path: str, state: TrainState, step: Optional[int] = None) ->
     return path
 
 
-def restore_checkpoint(path: str, template: TrainState) -> TrainState:
+def restore_checkpoint(path: str, template: Any) -> Any:
     """Restore into the shapes/dtypes (and shardings) of ``template`` —
-    build the template with the same ``CompiledStep.init_state`` used for
-    the original run."""
+    build the template the same way the original run built its initial
+    state (e.g. ``CompiledStep.init_state``)."""
     with ocp.StandardCheckpointer() as ckptr:
         restored = ckptr.restore(os.path.abspath(path), template)
     # orbax hands back arrays COMMITTED to one device; the jitted shard_map
@@ -44,7 +45,9 @@ def restore_checkpoint(path: str, template: TrainState) -> TrainState:
     # arrays instead — uncommitted inputs let jit place each leaf on the
     # step's own sharding, exactly like the freshly-initialized state.
     restored = jax.device_get(restored)
-    return TrainState(*restored) if not isinstance(restored, TrainState) else restored
+    if isinstance(template, TrainState) and not isinstance(restored, TrainState):
+        return TrainState(*restored)
+    return restored
 
 
 def latest_step_path(root: str) -> Optional[str]:

@@ -35,12 +35,11 @@ def _host_fingerprint() -> str:
     import hashlib
     import platform as _platform
 
-    # machine + full platform string + processor brand: on hosts without
-    # /proc/cpuinfo (macOS) the platform/processor strings still separate
-    # e.g. Rosetta from native and most ISA generations
-    feat = "|".join(
-        (_platform.machine(), _platform.platform(), _platform.processor())
-    )
+    # machine + processor brand (NOT platform.platform(): that embeds the
+    # kernel build string, which would invalidate the whole cache on every
+    # routine kernel update); on hosts without /proc/cpuinfo (macOS) the
+    # processor string still separates e.g. Rosetta from native
+    feat = "|".join((_platform.machine(), _platform.processor()))
     try:
         with open("/proc/cpuinfo") as f:
             for line in f:

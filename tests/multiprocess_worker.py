@@ -32,7 +32,10 @@ from network_distributed_pytorch_tpu.data.multihost import (  # noqa: E402
     global_batch_from_local,
     global_state_from_host,
 )
-from network_distributed_pytorch_tpu.parallel import ExactReducer  # noqa: E402
+from network_distributed_pytorch_tpu.parallel import (  # noqa: E402
+    ExactReducer,
+    PowerSGDReducer,
+)
 from network_distributed_pytorch_tpu.parallel.mesh import (  # noqa: E402
     DistributedConfig,
     initialize_distributed,
@@ -75,30 +78,46 @@ def main() -> int:
 
         return jnp.mean((xb @ p["w"] + p["b"] - yb) ** 2)
 
-    step = make_train_step(
-        stateless_loss(loss), ExactReducer(), params, learning_rate=0.05,
-        momentum=0.9, algorithm="sgd", mesh=mesh, donate_state=False,
-    )
-    state = step.init_state(params)
-    state = global_state_from_host(
-        state,
-        TrainState(
-            params=P(), momenta=P(), memories=P("data"),
-            reducer_state=P(), model_state=P("data"),
-        ),
-        mesh,
-    )
     # THIS process's shard of the batch (rank-partitioned, like
     # DataPartitioner.use(rank))
     lo, hi = 8 * pid, 8 * (pid + 1)
     batch = global_batch_from_local((x[lo:hi], y[lo:hi]), mesh)
 
-    losses = []
-    for _ in range(3):
-        state, l = step(state, batch)
-        losses.append(float(l))
-    w0 = float(np.asarray(jax.device_get(state.params["w"]))[0, 0])
-    print(f"RESULT pid={pid} losses={','.join(f'{v:.8f}' for v in losses)} w00={w0:.8f}", flush=True)
+    results = {}
+    for name, reducer, algo in (
+        ("exact", ExactReducer(), "sgd"),
+        # the flagship compressed path: EF chain + warm-start Q across
+        # REAL process boundaries
+        ("powersgd", PowerSGDReducer(
+            random_seed=1234, compression_rank=2, matricize="last"
+        ), "ef_momentum"),
+    ):
+        step = make_train_step(
+            stateless_loss(loss), reducer, params, learning_rate=0.05,
+            momentum=0.9, algorithm=algo, mesh=mesh, donate_state=False,
+        )
+        state = step.init_state(params)
+        state = global_state_from_host(
+            state,
+            TrainState(
+                params=P(), momenta=P(), memories=P("data"),
+                reducer_state=P(), model_state=P("data"),
+            ),
+            mesh,
+        )
+        losses = []
+        for _ in range(3):
+            state, l = step(state, batch)
+            losses.append(float(l))
+        w0 = float(np.asarray(jax.device_get(state.params["w"]))[0, 0])
+        results[name] = (losses, w0)
+
+    for name, (losses, w0) in results.items():
+        print(
+            f"RESULT kind={name} pid={pid} "
+            f"losses={','.join(f'{v:.8f}' for v in losses)} w00={w0:.8f}",
+            flush=True,
+        )
     shutdown_distributed()
     return 0
 

@@ -68,3 +68,27 @@ def test_save_restore_resume_bitexact(tmp_path, devices):
         state2, loss = step(state2, _batch(i))
         losses_resumed.append(float(loss))
     np.testing.assert_allclose(losses_resumed, losses_full[3:], rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_gpt_pp_checkpoint_resume_bitexact(devices, tmp_path):
+    """audited_carry_loop checkpointing: a gpt_pp run interrupted at the
+    epoch boundary and resumed must converge to the SAME final loss as an
+    uninterrupted run (deterministic per-epoch batch streams)."""
+    from network_distributed_pytorch_tpu.experiments import gpt_pp
+    from network_distributed_pytorch_tpu.utils.config import ExperimentConfig
+
+    cfg = lambda e: ExperimentConfig(
+        training_epochs=e, learning_rate=0.15, global_batch_size=16,
+        log_every=0,
+    )
+    kw = dict(preset="small", seq_len=32, steps_per_epoch=6)
+    full = gpt_pp.run(cfg(3), **kw)
+
+    ckpt = str(tmp_path / "pp_ckpt")
+    gpt_pp.run(cfg(1), checkpoint_dir=ckpt, **kw)  # "crash" after epoch 0
+    resumed = gpt_pp.run(cfg(3), checkpoint_dir=ckpt, **kw)  # resumes epoch 1
+
+    np.testing.assert_allclose(
+        resumed["final_loss"], full["final_loss"], rtol=1e-6
+    )

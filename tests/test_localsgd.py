@@ -1,0 +1,108 @@
+"""Local SGD / periodic parameter averaging: H=1 plain-SGD equivalence with
+exact DDP, divergence-then-sync mechanics, byte-exact wire accounting, and
+end-to-end training at H=4."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from network_distributed_pytorch_tpu.parallel import (
+    ExactReducer,
+    make_local_sgd_train_fn,
+    make_mesh,
+)
+from network_distributed_pytorch_tpu.parallel.trainer import (
+    LOSS_SYNC_BITS,
+    make_train_step,
+    stateless_loss,
+)
+
+W = 8
+
+
+def _problem():
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(16, 4).astype(np.float32)
+    x = rng.randn(64, 16).astype(np.float32)
+    y = x @ w_true
+    params = {"w": jnp.zeros((16, 4)), "b": jnp.zeros((4,))}
+
+    def loss(p, batch):
+        xb, yb = batch
+        return jnp.mean((xb @ p["w"] + p["b"] - yb) ** 2)
+
+    return params, stateless_loss(loss), (jnp.asarray(x), jnp.asarray(y))
+
+
+def _stack(batch, h):
+    return tuple(jnp.broadcast_to(b[None], (h,) + b.shape) for b in batch)
+
+
+def test_h1_plain_sgd_equals_exact_ddp(devices):
+    """sync_every=1 + plain SGD == exact-DDP plain SGD step-for-step
+    (averaging post-step params == stepping with the averaged gradient)."""
+    params, loss_fn, batch = _problem()
+    mesh = make_mesh()
+    local = make_local_sgd_train_fn(
+        loss_fn, params, 0.05, sync_every=1, algorithm="sgd_plain",
+        mesh=mesh, donate_state=False,
+    )
+    ddp = make_train_step(
+        loss_fn, ExactReducer(), params, 0.05, algorithm="sgd_plain",
+        mesh=mesh, donate_state=False,
+    )
+    lstate, dstate = local.init_state(params), ddp.init_state(params)
+    for _ in range(10):
+        lstate, llosses = local(lstate, _stack(batch, 1))
+        dstate, dloss = ddp(dstate, batch)
+        np.testing.assert_allclose(
+            float(llosses[0]), float(dloss), rtol=1e-6
+        )
+    np.testing.assert_allclose(
+        np.asarray(local.eval_params(lstate)["w"]),
+        np.asarray(dstate.params["w"]),
+        rtol=1e-5, atol=1e-7,
+    )
+
+
+def test_wire_accounting_hlo_exact(devices):
+    """bits_per_round (one param allreduce + H loss pmeans) must equal the
+    compiled round's collective payloads byte-exactly — and be ~H-fold less
+    per step than exact DDP's gradient allreduce."""
+    from network_distributed_pytorch_tpu.utils.hlo_audit import (
+        collective_summary,
+        compiled_hlo_text,
+    )
+
+    params, loss_fn, batch = _problem()
+    mesh = make_mesh()
+    h = 4
+    local = make_local_sgd_train_fn(
+        loss_fn, params, 0.05, sync_every=h, mesh=mesh, donate_state=False
+    )
+    state = local.init_state(params)
+    s = collective_summary(compiled_hlo_text(local.fn, state, _stack(batch, h)))
+    param_bits = 32 * sum(
+        l.size for l in jax.tree_util.tree_leaves(params)
+    )
+    # the loss pmean lives in the lax.scan BODY: it appears once in the HLO
+    # text but executes sync_every times, so the text-level audit sees
+    # param_bits + ONE loss payload while the true per-round cost carries
+    # sync_every of them (bits_per_round)
+    assert 8 * s["total_payload_bytes"] == param_bits + LOSS_SYNC_BITS
+    assert local.bits_per_round == param_bits + h * LOSS_SYNC_BITS
+    assert local.bits_per_step < param_bits / (h - 1)
+
+
+def test_local_sgd_trains_h4(devices):
+    params, loss_fn, batch = _problem()
+    mesh = make_mesh()
+    local = make_local_sgd_train_fn(
+        loss_fn, params, 0.05, sync_every=4, mesh=mesh, donate_state=False
+    )
+    state = local.init_state(params)
+    losses = []
+    for _ in range(10):  # 40 local steps, 10 syncs
+        state, l = local(state, _stack(batch, 4))
+        losses.extend(np.asarray(l).tolist())
+    assert losses[-1] < 0.05 * losses[0], (losses[0], losses[-1])

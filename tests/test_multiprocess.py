@@ -23,12 +23,17 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _single_process_reference(nproc: int):
-    """The same toy problem on one device (mesh=None), full batch."""
+def _single_process_reference(nproc: int, kind: str = "exact"):
+    """The same toy problem in ONE process on an nproc-device virtual mesh
+    (the same collective code path, no OS-process boundary)."""
     import jax
     import jax.numpy as jnp
 
-    from network_distributed_pytorch_tpu.parallel import ExactReducer
+    from network_distributed_pytorch_tpu.parallel import (
+        ExactReducer,
+        PowerSGDReducer,
+        make_mesh,
+    )
     from network_distributed_pytorch_tpu.parallel.trainer import (
         make_train_step,
         stateless_loss,
@@ -44,9 +49,17 @@ def _single_process_reference(nproc: int):
         xb, yb = batch
         return jnp.mean((xb @ p["w"] + p["b"] - yb) ** 2)
 
+    if kind == "powersgd":
+        reducer, algo = PowerSGDReducer(
+            random_seed=1234, compression_rank=2, matricize="last"
+        ), "ef_momentum"
+        mesh = make_mesh(devices=jax.devices()[:nproc])
+    else:
+        # exact DDP == single-device large batch (equal shards)
+        reducer, algo, mesh = ExactReducer(), "sgd", None
     step = make_train_step(
-        stateless_loss(loss), ExactReducer(), params, learning_rate=0.05,
-        momentum=0.9, algorithm="sgd", mesh=None, donate_state=False,
+        stateless_loss(loss), reducer, params, learning_rate=0.05,
+        momentum=0.9, algorithm=algo, mesh=mesh, donate_state=False,
     )
     state = step.init_state(params)
     batch = (jnp.asarray(x), jnp.asarray(y))
@@ -85,18 +98,21 @@ def test_two_process_rendezvous_matches_single_process(devices):
 
     results = {}
     for out in outs:
-        line = [l for l in out.splitlines() if l.startswith("RESULT")][0]
-        fields = dict(kv.split("=") for kv in line.split()[1:])
-        results[int(fields["pid"])] = (
-            [float(v) for v in fields["losses"].split(",")],
-            float(fields["w00"]),
-        )
-    assert set(results) == {0, 1}
-    # both ranks report the same (pmean'd) losses and identical params
-    assert results[0] == results[1]
-
-    ref_losses, ref_w00 = _single_process_reference(nproc)
-    # exact-DDP over 2 processes == single-device full-batch training: the
-    # mean-of-shard-means equals the full-batch mean for equal shards
-    np.testing.assert_allclose(results[0][0], ref_losses, rtol=1e-6)
-    np.testing.assert_allclose(results[0][1], ref_w00, rtol=1e-6)
+        for line in out.splitlines():
+            if not line.startswith("RESULT"):
+                continue
+            fields = dict(kv.split("=") for kv in line.split()[1:])
+            results[(fields["kind"], int(fields["pid"]))] = (
+                [float(v) for v in fields["losses"].split(",")],
+                float(fields["w00"]),
+            )
+    for kind in ("exact", "powersgd"):
+        assert (kind, 0) in results and (kind, 1) in results, results.keys()
+        # both ranks report the same (pmean'd) losses and identical params
+        assert results[(kind, 0)] == results[(kind, 1)]
+        ref_losses, ref_w00 = _single_process_reference(nproc, kind)
+        # exact: 2-process DDP == single-device full batch; powersgd: the
+        # EF/warm-start chain over REAL process boundaries == the same chain
+        # on a single-process 2-device mesh
+        np.testing.assert_allclose(results[(kind, 0)][0], ref_losses, rtol=1e-6)
+        np.testing.assert_allclose(results[(kind, 0)][1], ref_w00, rtol=1e-6)
