@@ -108,44 +108,74 @@ def run(
     tables = {}
     results = {}
 
-    # communication AVOIDANCE baseline (parallel.localsgd): sync_every local
-    # steps then ONE parameter allreduce — the PowerSGD paper's own baseline
-    # family, projected at its amortized per-step wire cost
-    from ..parallel import make_local_sgd_train_fn
+    # communication AVOIDANCE rows (parallel.localsgd): local SGD — the
+    # PowerSGD paper's own baseline family, sync_every local steps then ONE
+    # parameter allreduce — and DiLoCo with the outer delta PowerSGD-
+    # compressed under error feedback: the fourth quadrant of the study
+    # (exact / compressed / avoided / avoided+compressed). Projections are
+    # fed from the COMPILED round like every other row; the one adjustment
+    # is the in-scan loss pmean, which appears once in HLO text but
+    # executes sync_every times per round (see parallel.localsgd).
+    from ..parallel import make_diloco_train_fn, make_local_sgd_train_fn
+    from ..parallel.trainer import LOSS_SYNC_BITS
 
     sync_every = 8
-    local = make_local_sgd_train_fn(
-        loss_fn, variables["params"], learning_rate=config.learning_rate,
-        momentum=config.momentum, sync_every=sync_every, mesh=mesh,
-        donate_state=False,
-    )
-    lstate = local.init_state(
-        variables["params"], model_state={"batch_stats": variables["batch_stats"]}
-    )
     lbatches = tuple(
         jnp.broadcast_to(b[None], (sync_every,) + b.shape) for b in batch
     )
-    lstate, llosses = local(lstate, lbatches)  # compile + warmup
-    jax.block_until_ready(llosses)
-    t0 = time.perf_counter()
-    for _ in range(3):
-        lstate, llosses = local(lstate, lbatches)
-    jax.block_until_ready(llosses)
-    l_step_s = (time.perf_counter() - t0) / (3 * sync_every)
-    l_bits_per_step = local.bits_per_round / sync_every
-    l_table = bandwidth_table(
-        l_bits_per_step, l_step_s, n_workers,
-        n_collectives=1.0 / sync_every,  # one collective per sync_every steps
+
+    def measure_round(name: str, round_) -> None:
+        state = round_.init_state(
+            variables["params"],
+            model_state={"batch_stats": variables["batch_stats"]},
+        )
+        compiled = round_.fn.lower(state, lbatches).compile()
+        state, losses = compiled(state, lbatches)  # warmup
+        jax.block_until_ready(losses)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            state, losses = compiled(state, lbatches)
+        jax.block_until_ready(losses)
+        step_s = (time.perf_counter() - t0) / (3 * sync_every)
+        audit = collective_summary(hlo_text_of_compiled(compiled))
+        scan_extra = sync_every - 1  # loss pmean executions beyond the audited 1
+        table = bandwidth_table(
+            round_.bits_per_step, step_s, n_workers,
+            n_collectives=(audit["count"] + scan_extra) / sync_every,
+        )
+        tables[name] = table
+        results[name] = {
+            "bits_per_step": round_.bits_per_step,
+            "bits_per_round": round_.bits_per_round,
+            "audited_bits_per_round": (
+                8 * audit["total_payload_bytes"] + scan_extra * LOSS_SYNC_BITS
+            ),
+            "hlo_collectives": audit["by_kind"],
+            "sync_every": sync_every,
+            "mbytes_per_step": round_.bits_per_step / 8e6,
+            "measured_step_s": step_s,
+            "projected_step_s": {f: e.step_time_s for f, e in table.items()},
+        }
+
+    measure_round(
+        f"local_sgd_h{sync_every}",
+        make_local_sgd_train_fn(
+            loss_fn, variables["params"], learning_rate=config.learning_rate,
+            momentum=config.momentum, sync_every=sync_every, mesh=mesh,
+            donate_state=False,
+        ),
     )
-    tables[f"local_sgd_h{sync_every}"] = l_table
-    results[f"local_sgd_h{sync_every}"] = {
-        "bits_per_step": l_bits_per_step,
-        "bits_per_round": local.bits_per_round,
-        "sync_every": sync_every,
-        "mbytes_per_step": l_bits_per_step / 8e6,
-        "measured_step_s": l_step_s,
-        "projected_step_s": {f: e.step_time_s for f, e in l_table.items()},
-    }
+    measure_round(
+        f"diloco_psgd_r4_h{sync_every}",
+        make_diloco_train_fn(
+            loss_fn, variables["params"],
+            inner_learning_rate=config.learning_rate, sync_every=sync_every,
+            mesh=mesh, donate_state=False,
+            reducer=PowerSGDReducer(
+                random_seed=config.seed, compression_rank=4, matricize="last"
+            ),
+        ),
+    )
     for name, (reducer, algorithm) in configs.items():
         step_mesh, step_axis = mesh, "data"
         if name.startswith("hier_"):

@@ -17,7 +17,12 @@ from .comm import (  # noqa: F401
 )
 from .packing import TensorPacker  # noqa: F401
 from .hierarchical import HierarchicalReducer  # noqa: F401
-from .localsgd import CompiledLocalSGD, make_local_sgd_train_fn  # noqa: F401
+from .localsgd import (  # noqa: F401
+    CompiledDiLoCo,
+    CompiledLocalSGD,
+    make_diloco_train_fn,
+    make_local_sgd_train_fn,
+)
 from .reducers import ExactReducer, PowerSGDReducer  # noqa: F401
 from .compression import (  # noqa: F401
     TopKReducer,

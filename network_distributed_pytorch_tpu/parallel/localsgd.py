@@ -114,21 +114,21 @@ def make_local_sgd_train_fn(
     assert algorithm in ("sgd", "sgd_plain")
     assert sync_every >= 1
 
+    from .trainer import sgd_momentum_update
+
     def local_step(carry, batch):
         params, momenta, model_state = carry
         (loss, model_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, model_state, batch
         )
         if algorithm == "sgd":
-            momenta = jax.tree_util.tree_map(
-                lambda m, g: momentum * m + g, momenta, grads
+            params, momenta = sgd_momentum_update(
+                params, momenta, grads, learning_rate, momentum
             )
-            update = momenta
         else:
-            update = grads
-        params = jax.tree_util.tree_map(
-            lambda p, u: p - learning_rate * u, params, update
-        )
+            params = jax.tree_util.tree_map(
+                lambda p, g: p - learning_rate * g, params, grads
+            )
         # per-step global mean loss for reporting (the reference's per-rank
         # prints, made global) — sync_every tiny scalar pmeans per round
         loss = jax.lax.pmean(loss, axis_name)
@@ -168,7 +168,239 @@ def make_local_sgd_train_fn(
         ),
         donate_argnums=(0,) if donate_state else (),
     )
-    leaves = jax.tree_util.tree_leaves(params_template)
-    param_bits = sum(8 * int(l.size) * l.dtype.itemsize for l in leaves)
+    from .reducers import ExactReducer
+    from .trainer import _reducer_bits
+
+    param_bits = _reducer_bits(ExactReducer(), params_template)
     bits_per_round = param_bits + sync_every * LOSS_SYNC_BITS
     return CompiledLocalSGD(fn, bits_per_round, sync_every, mesh, axis_name)
+
+
+# ---------------------------------------------------------------------------
+# DiLoCo: local SGD with an OUTER optimizer over the round's parameter delta
+# ---------------------------------------------------------------------------
+
+
+class DiLoCoState(NamedTuple):
+    """Round carry for :func:`make_diloco_train_fn`.
+
+    ``params``/``outer_momenta``/``reducer_state`` are replicated (identical
+    on every worker after each sync); ``inner_opt``/``memories``/
+    ``model_state`` are genuinely per-worker (leading ``num_devices`` axis):
+    inner optimizer moments persist locally across rounds, and the
+    error-feedback memories hold each worker's own compression residual on
+    its outer delta."""
+
+    params: PyTree
+    outer_momenta: PyTree
+    inner_opt: PyTree
+    memories: PyTree
+    reducer_state: Any
+    model_state: PyTree
+
+
+class CompiledDiLoCo(NamedTuple):
+    """One jitted DiLoCo round: ``fn(state, stacked_batches) -> (state,
+    losses)`` with batch leaves carrying a leading ``sync_every`` axis.
+    ``bits_per_round`` = one reducer pass over a parameter-shaped tree plus
+    ``sync_every`` scalar loss pmeans (same scan-body caveat as
+    :class:`CompiledLocalSGD`)."""
+
+    fn: Callable[[DiLoCoState, Any], Tuple[DiLoCoState, jax.Array]]
+    bits_per_round: int
+    sync_every: int
+    mesh: Mesh
+    axis_name: str
+    reducer: Any
+    inner_optimizer: Any = None
+
+    def __call__(self, state, batches):
+        return self.fn(state, batches)
+
+    @property
+    def bits_per_step(self) -> float:
+        return self.bits_per_round / self.sync_every
+
+    def init_state(self, params: PyTree, model_state: PyTree = None) -> DiLoCoState:
+        n = self.mesh.size
+        tile = lambda t: jax.tree_util.tree_map(
+            lambda p: jnp.broadcast_to(p[None], (n,) + jnp.shape(p)), t
+        )
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        inner = (
+            self.inner_optimizer.init(params)
+            if self.inner_optimizer is not None
+            else zeros
+        )
+        return DiLoCoState(
+            params=params,
+            outer_momenta=zeros,
+            inner_opt=tile(inner),
+            memories=tile(zeros),
+            reducer_state=self.reducer.init(params),
+            model_state=tile({} if model_state is None else model_state),
+        )
+
+    def eval_params(self, state: DiLoCoState) -> PyTree:
+        """Global params are carried replicated — usable directly."""
+        return state.params
+
+    def eval_model_state(self, state: DiLoCoState, reduce: str = "mean") -> PyTree:
+        from .trainer import collapse_per_worker
+
+        return collapse_per_worker(state.model_state, reduce)
+
+
+def make_diloco_train_fn(
+    loss_fn: LossFn,
+    params_template: PyTree,
+    inner_learning_rate: float,
+    outer_learning_rate: float = 0.7,
+    outer_momentum: float = 0.9,
+    outer_nesterov: bool = True,
+    inner_momentum: float = 0.9,
+    sync_every: int = 8,
+    inner_algorithm: str = "sgd",
+    reducer=None,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = DATA_AXIS,
+    donate_state: bool = True,
+    inner_optimizer=None,
+) -> CompiledDiLoCo:
+    """DiLoCo (Douillard et al. 2023): local SGD whose sync step is an OUTER
+    optimization.  Each worker takes ``sync_every`` inner steps; the round's
+    parameter displacement Δ_w = θ₀ − θ_H (the "outer gradient") is
+    averaged across workers, and an outer SGD-with-(Nesterov)-momentum moves
+    the global params along it.  With ``outer_learning_rate=1`` and
+    ``outer_momentum=0`` this IS plain local-SGD parameter averaging
+    (θ₀ − mean(θ₀ − θ_w) = mean(θ_w)) — pinned by test; the outer momentum
+    is what recovers most of the convergence lost to infrequent sync.
+
+    Composition with the reference's actual subject (PowerSGD gradient
+    compression, ``reducer.py:43-170``): pass any of this package's reducers
+    as ``reducer`` and the outer delta is compressed with error feedback —
+    each worker's compression residual stays in its ``memories`` and is
+    re-sent next round, the same telescoping the Algorithm-2 trainer applies
+    per step (``ddp_powersgd_guide_cifar10/ddp_init.py:156-157``).  Wire
+    cost per round then drops below even local SGD's single parameter
+    allreduce: communication avoidance × compression in one compiled
+    program.  Defaults to :class:`~.reducers.ExactReducer` (uncompressed
+    DiLoCo).
+
+    ``inner_algorithm`` ∈ {"sgd", "sgd_plain", "optax"}; the paper's recipe
+    (AdamW inner) is ``inner_algorithm="optax"`` +
+    ``inner_optimizer=optax.adamw(...)`` (inner state kept per-worker
+    across rounds, as in the paper).
+    """
+    from .reducers import ExactReducer
+
+    assert mesh is not None, "DiLoCo is inherently multi-device; pass a mesh"
+    assert inner_algorithm in ("sgd", "sgd_plain", "optax")
+    assert (inner_algorithm == "optax") == (inner_optimizer is not None)
+    assert sync_every >= 1
+    if reducer is None:
+        reducer = ExactReducer()
+
+    def inner_step(carry, batch):
+        params, opt, model_state = carry
+        (loss, model_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, model_state, batch
+        )
+        if inner_algorithm == "optax":
+            import optax
+
+            updates, opt = inner_optimizer.update(grads, opt, params)
+            params = optax.apply_updates(params, updates)
+        elif inner_algorithm == "sgd":
+            from .trainer import sgd_momentum_update
+
+            params, opt = sgd_momentum_update(
+                params, opt, grads, inner_learning_rate, inner_momentum
+            )
+        else:
+            params = jax.tree_util.tree_map(
+                lambda p, g: p - inner_learning_rate * g, params, grads
+            )
+        loss = jax.lax.pmean(loss, axis_name)
+        return (params, opt, model_state), loss
+
+    def sharded_round(state: DiLoCoState, batches):
+        params0 = state.params
+        # cast to device-varying before differentiation so per-worker grads
+        # (and hence deltas) stay unsynchronized until the reducer runs —
+        # same rationale as trainer.make_step_fn
+        local0 = jax.tree_util.tree_map(
+            lambda p: jax.lax.pcast(p, axis_name, to="varying"), params0
+        )
+        (local_params, inner_opt, model_state), losses = jax.lax.scan(
+            inner_step,
+            (local0, strip_leading(state.inner_opt), strip_leading(state.model_state)),
+            batches,
+        )
+        # outer gradient: this worker's round displacement θ₀ − θ_H, plus
+        # the residual its compressor dropped last round (EF telescoping)
+        delta = jax.tree_util.tree_map(
+            lambda a, b: a - b, local0, local_params
+        )
+        send = jax.tree_util.tree_map(
+            jnp.add, delta, strip_leading(state.memories)
+        )
+        reducer_state, dbar, memories, _ = reducer.reduce(
+            state.reducer_state, send, axis_name
+        )
+        # outer SGD with (Nesterov) momentum on the averaged outer gradient
+        if outer_momentum > 0.0:
+            outer_m = jax.tree_util.tree_map(
+                lambda m, d: outer_momentum * m + d, state.outer_momenta, dbar
+            )
+            update = (
+                jax.tree_util.tree_map(
+                    lambda d, m: d + outer_momentum * m, dbar, outer_m
+                )
+                if outer_nesterov
+                else outer_m
+            )
+        else:
+            outer_m = state.outer_momenta
+            update = dbar
+        new_params = jax.tree_util.tree_map(
+            lambda p, u: p - outer_learning_rate * u, params0, update
+        )
+        return (
+            DiLoCoState(
+                params=new_params,
+                outer_momenta=outer_m,
+                inner_opt=pad_leading(inner_opt),
+                memories=pad_leading(memories),
+                reducer_state=reducer_state,
+                model_state=pad_leading(model_state),
+            ),
+            losses,
+        )
+
+    state_specs = DiLoCoState(
+        params=PartitionSpec(),
+        outer_momenta=PartitionSpec(),
+        inner_opt=PartitionSpec(axis_name),
+        memories=PartitionSpec(axis_name),
+        reducer_state=PartitionSpec(),
+        model_state=PartitionSpec(axis_name),
+    )
+    fn = jax.jit(
+        jax.shard_map(
+            sharded_round,
+            mesh=mesh,
+            in_specs=(state_specs, PartitionSpec(None, axis_name)),
+            out_specs=(state_specs, PartitionSpec()),
+        ),
+        donate_argnums=(0,) if donate_state else (),
+    )
+    from .trainer import _reducer_bits
+
+    bits_per_round = (
+        _reducer_bits(reducer, params_template, mesh.size)
+        + sync_every * LOSS_SYNC_BITS
+    )
+    return CompiledDiLoCo(
+        fn, bits_per_round, sync_every, mesh, axis_name, reducer, inner_optimizer
+    )
