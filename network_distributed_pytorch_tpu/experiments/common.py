@@ -17,6 +17,54 @@ from ..parallel.trainer import CompiledStep, TrainState
 from ..utils.metrics import MetricsLogger
 
 
+def accumulated_batches(
+    arrays, config, max_steps_per_epoch: Optional[int] = None
+) -> Callable[[int], Iterator[Any]]:
+    """Per-epoch batch generator honoring ``config.accum_steps``: yields
+    ``(global_batch, ...)`` leaves, or ``(accum, global_batch/accum, ...)``
+    when accumulating (the trainer's batch contract, ``make_step_fn``)."""
+    import jax.numpy as jnp
+
+    from ..data import iterate_batches
+
+    k = config.accum_steps
+    if k < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {k}")
+    if config.global_batch_size % k != 0:
+        raise ValueError(
+            f"global_batch_size {config.global_batch_size} is not divisible"
+            f" by accum_steps {k}"
+        )
+
+    def gen(epoch: int):
+        it = iterate_batches(
+            arrays, config.global_batch_size, seed=config.seed, epoch=epoch
+        )
+        for i, batch in enumerate(it):
+            if max_steps_per_epoch is not None and i >= max_steps_per_epoch:
+                return
+            if k > 1:
+                batch = tuple(
+                    a.reshape((k, a.shape[0] // k) + a.shape[1:]) for a in batch
+                )
+            yield tuple(jnp.asarray(a) for a in batch)
+
+    return gen
+
+
+def accum_batch_sharding(mesh, accum_steps: int):
+    """Prefetch sharding for accumulated batches: the sharded batch dim sits
+    BEHIND the accum axis. None for the unaccumulated default (train_loop
+    derives it)."""
+    if accum_steps <= 1:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..parallel.mesh import DATA_AXIS
+
+    return NamedSharding(mesh, PartitionSpec(None, DATA_AXIS))
+
+
 def train_loop(
     step: CompiledStep,
     state: TrainState,
@@ -29,6 +77,7 @@ def train_loop(
     heartbeat: Any = None,
     on_epoch_end: Optional[Callable[[int, TrainState], None]] = None,
     prefetch: int = 2,
+    batch_sharding: Any = None,
 ) -> Tuple[TrainState, MetricsLogger]:
     """Run ``epochs`` passes, logging loss / step-time / cumulative bits
     (the reference's per-epoch banner + the bits it never reported).
@@ -56,7 +105,9 @@ def train_loop(
     mesh = getattr(step, "mesh", None)
     sharding = None
     if prefetch and mesh is not None:
-        if DATA_AXIS in mesh.axis_names:
+        if batch_sharding is not None:
+            sharding = batch_sharding
+        elif DATA_AXIS in mesh.axis_names:
             sharding = data_sharding(mesh)
         else:
             prefetch = 0

@@ -19,12 +19,18 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from ..data import iterate_batches, load_cifar10_or_synthetic
+from ..data import load_cifar10_or_synthetic
 from ..models import resnet18, resnet50
 from ..parallel import ExactReducer, make_mesh
 from ..parallel.trainer import make_train_step
 from ..utils.config import ExperimentConfig
-from .common import image_classifier_loss, summarize, train_loop
+from .common import (
+    accum_batch_sharding,
+    accumulated_batches,
+    image_classifier_loss,
+    summarize,
+    train_loop,
+)
 
 
 def build_model(preset: str, dtype=jnp.float32):
@@ -70,6 +76,8 @@ def run(
     if strategy == "fsdp":
         from ..parallel.fsdp import make_fsdp_train_step
 
+        if config.accum_steps > 1:
+            raise ValueError("accum_steps is not supported with strategy='fsdp'")
         step = make_fsdp_train_step(
             loss_fn,
             params,
@@ -87,21 +95,17 @@ def run(
             momentum=config.momentum,
             algorithm="sgd",  # reference uses optim.SGD(lr, momentum=.9) — ddp_init.py:110
             mesh=mesh,
+            accum_steps=config.accum_steps,
         )
     state = step.init_state(params, model_state=model_state)
 
-    def batches(epoch):
-        it = iterate_batches(
-            [images, labels], config.global_batch_size, seed=config.seed, epoch=epoch
-        )
-        for i, (x, y) in enumerate(it):
-            if max_steps_per_epoch is not None and i >= max_steps_per_epoch:
-                return
-            yield jnp.asarray(x), jnp.asarray(y)
-
+    batches = accumulated_batches(
+        [images, labels], config, max_steps_per_epoch=max_steps_per_epoch
+    )
     state, logger = train_loop(
         step, state, batches, config.training_epochs,
         rank=config.process_id, log_every=config.log_every,
+        batch_sharding=accum_batch_sharding(mesh, config.accum_steps),
     )
     extra = {
         "preset": preset, "real_data": is_real, "num_devices": mesh.size,

@@ -17,12 +17,18 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from ..data import iterate_batches, load_cifar10_or_synthetic
+from ..data import load_cifar10_or_synthetic
 from ..models import resnet18, resnet152
 from ..parallel import PowerSGDReducer, make_mesh
 from ..parallel.trainer import make_train_step
 from ..utils.config import ExperimentConfig
-from .common import image_classifier_loss, summarize, train_loop
+from .common import (
+    accum_batch_sharding,
+    accumulated_batches,
+    image_classifier_loss,
+    summarize,
+    train_loop,
+)
 
 
 def build_model(preset: str, dtype=jnp.float32):
@@ -72,21 +78,17 @@ def run(
         momentum=config.momentum,  # λ in Algorithm 2 — ddp_init.py:32
         algorithm="ef_momentum",
         mesh=mesh,
+        accum_steps=config.accum_steps,
     )
     state = step.init_state(params, model_state=model_state)
 
-    def batches(epoch):
-        it = iterate_batches(
-            [images, labels], config.global_batch_size, seed=config.seed, epoch=epoch
-        )
-        for i, (x, y) in enumerate(it):
-            if max_steps_per_epoch is not None and i >= max_steps_per_epoch:
-                return
-            yield jnp.asarray(x), jnp.asarray(y)
-
+    batches = accumulated_batches(
+        [images, labels], config, max_steps_per_epoch=max_steps_per_epoch
+    )
     state, logger = train_loop(
         step, state, batches, config.training_epochs,
         rank=config.process_id, log_every=config.log_every,
+        batch_sharding=accum_batch_sharding(mesh, config.accum_steps),
     )
     extra = {
         "preset": preset,

@@ -66,6 +66,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--momentum", type=float, default=None)
     p.add_argument("--reducer-rank", type=int, default=None)
+    p.add_argument(
+        "--accum-steps", type=int, default=None,
+        help="gradient-accumulation microbatches per step (cifar experiments)",
+    )
     p.add_argument("--preset", choices=["small", "full"], default="small")
     p.add_argument("--data-dir", type=str, default="./data")
     p.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32")
@@ -112,6 +116,8 @@ def config_from_args(args) -> ExperimentConfig:
         cfg.momentum = args.momentum
     if args.reducer_rank is not None:
         cfg.reducer_rank = args.reducer_rank
+    if args.accum_steps is not None:
+        cfg.accum_steps = args.accum_steps
     return cfg
 
 

@@ -71,15 +71,16 @@ class CompiledLocalSGD(NamedTuple):
         return self.bits_per_round / self.sync_every
 
     def init_state(self, params: PyTree, model_state: PyTree = None) -> LocalSGDState:
+        from .trainer import tile_per_worker
+
         n = self.mesh.size
-        tile = lambda t: jax.tree_util.tree_map(
-            lambda p: jnp.broadcast_to(p[None], (n,) + jnp.shape(p)), t
-        )
         zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
         return LocalSGDState(
-            params=tile(params),
-            momenta=tile(zeros),
-            model_state=tile({} if model_state is None else model_state),
+            params=tile_per_worker(params, n),
+            momenta=tile_per_worker(zeros, n),
+            model_state=tile_per_worker(
+                {} if model_state is None else model_state, n
+            ),
         )
 
     def eval_params(self, state: LocalSGDState) -> PyTree:
@@ -222,10 +223,9 @@ class CompiledDiLoCo(NamedTuple):
         return self.bits_per_round / self.sync_every
 
     def init_state(self, params: PyTree, model_state: PyTree = None) -> DiLoCoState:
+        from .trainer import tile_per_worker
+
         n = self.mesh.size
-        tile = lambda t: jax.tree_util.tree_map(
-            lambda p: jnp.broadcast_to(p[None], (n,) + jnp.shape(p)), t
-        )
         zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
         inner = (
             self.inner_optimizer.init(params)
@@ -235,10 +235,12 @@ class CompiledDiLoCo(NamedTuple):
         return DiLoCoState(
             params=params,
             outer_momenta=zeros,
-            inner_opt=tile(inner),
-            memories=tile(zeros),
+            inner_opt=tile_per_worker(inner, n),
+            memories=tile_per_worker(zeros, n),
             reducer_state=self.reducer.init(params),
-            model_state=tile({} if model_state is None else model_state),
+            model_state=tile_per_worker(
+                {} if model_state is None else model_state, n
+            ),
         )
 
     def eval_params(self, state: DiLoCoState) -> PyTree:
@@ -254,7 +256,7 @@ class CompiledDiLoCo(NamedTuple):
 def make_diloco_train_fn(
     loss_fn: LossFn,
     params_template: PyTree,
-    inner_learning_rate: float,
+    inner_learning_rate: Optional[float] = None,
     outer_learning_rate: float = 0.7,
     outer_momentum: float = 0.9,
     outer_nesterov: bool = True,
@@ -297,6 +299,16 @@ def make_diloco_train_fn(
     assert mesh is not None, "DiLoCo is inherently multi-device; pass a mesh"
     assert inner_algorithm in ("sgd", "sgd_plain", "optax")
     assert (inner_algorithm == "optax") == (inner_optimizer is not None)
+    # machine-check the LR contract: the optax inner carries its own LR, the
+    # sgd inners need one — a silently-ignored inner_learning_rate is a trap
+    if inner_algorithm == "optax":
+        if inner_learning_rate is not None:
+            raise ValueError(
+                "inner_learning_rate is unused with inner_algorithm='optax'"
+                " — the optax inner_optimizer carries its own learning rate"
+            )
+    elif inner_learning_rate is None:
+        raise ValueError(f"inner_algorithm={inner_algorithm!r} needs inner_learning_rate")
     assert sync_every >= 1
     if reducer is None:
         reducer = ExactReducer()

@@ -99,16 +99,22 @@ def init_train_state(
         )
         # per-worker model_state starts identical everywhere (same init),
         # then each worker's local batches evolve its own copy
-        model_state = jax.tree_util.tree_map(
-            lambda x: jnp.broadcast_to(x[None], (num_devices,) + jnp.shape(x)),
-            model_state,
-        )
+        model_state = tile_per_worker(model_state, num_devices)
     return TrainState(
         params=params,
         momenta=optimizer.init(params) if optimizer is not None else zeros,
         memories=memories,
         reducer_state=reducer.init(params),
         model_state=model_state,
+    )
+
+
+def tile_per_worker(tree: PyTree, num_devices: int) -> PyTree:
+    """Broadcast every leaf to a leading ``num_devices`` axis — the layout
+    of genuinely per-worker carried state (error memories, local momenta,
+    BN stats) before ``shard_map`` strips it back to one worker's copy."""
+    return jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x[None], (num_devices,) + jnp.shape(x)), tree
     )
 
 
@@ -179,6 +185,7 @@ def make_step_fn(
     algorithm: str = "ef_momentum",
     axis_name: Optional[str] = DATA_AXIS,
     optimizer=None,
+    accum_steps: int = 1,
 ) -> Callable[[TrainState, Any], Tuple[TrainState, jax.Array]]:
     """Build the per-device step body: ``(state, local_batch) -> (state, loss)``.
 
@@ -196,9 +203,45 @@ def make_step_fn(
 
     The returned callable is pure; use it directly on one device
     (``axis_name=None``) or inside ``shard_map`` (see ``make_train_step``).
+
+    ``accum_steps > 1`` enables gradient accumulation: batch leaves carry a
+    leading ``accum_steps`` axis and the step scans the microbatches with a
+    summed-gradient carry — device memory holds ONE microbatch's activations
+    at a time (effective batch beyond HBM), while the reducer still runs
+    once per step, so the wire cost is unchanged. The accumulated gradient
+    is the mean over microbatches, identical (for mean losses over
+    equal-size microbatches) to one big-batch gradient — pinned by test.
     """
     assert algorithm in ("ef_momentum", "sgd", "sgd_nesterov", "sgd_plain", "optax")
     assert (algorithm == "optax") == (optimizer is not None)
+    assert accum_steps >= 1
+
+    def grads_of(diff_params, model_state, batch):
+        if accum_steps == 1:
+            return jax.value_and_grad(loss_fn, has_aux=True)(
+                diff_params, model_state, batch
+            )
+
+        def microbatch(carry, mb):
+            mstate, gsum, lsum = carry
+            (loss, mstate), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                diff_params, mstate, mb
+            )
+            gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+            return (mstate, gsum, lsum + loss), None
+
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, diff_params)
+        lsum0 = jnp.zeros((), jnp.float32)
+        if axis_name is not None:
+            # fresh constants are device-invariant; the scan carry must match
+            # the (varying) per-microbatch loss/grads under shard_map's
+            # varying-manual-axes tracking
+            lsum0 = jax.lax.pcast(lsum0, axis_name, to="varying")
+        (model_state, gsum, lsum), _ = jax.lax.scan(
+            microbatch, (model_state, zeros, lsum0), batch
+        )
+        mean = lambda t: jax.tree_util.tree_map(lambda x: x / accum_steps, t)
+        return (lsum / accum_steps, model_state), mean(gsum)
 
     def step(state: TrainState, batch) -> Tuple[TrainState, jax.Array]:
         # (Algo 2 line 6) local stochastic gradient. Params enter the shard_map
@@ -212,7 +255,7 @@ def make_step_fn(
             diff_params = jax.tree_util.tree_map(
                 lambda p: jax.lax.pcast(p, axis_name, to="varying"), state.params
             )
-        (loss, model_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        (loss, model_state), grads = grads_of(
             diff_params, state.model_state, batch
         )
         # non-gradient state (BN running stats) stays PER-WORKER, exactly
@@ -318,6 +361,7 @@ def make_scanned_train_fn(
     axis_name: str = DATA_AXIS,
     donate_state: bool = True,
     optimizer=None,
+    accum_steps: int = 1,
 ) -> "CompiledStep":
     """Multi-step variant: ``fn(state, stacked_batches) -> (state, losses)``
     where each batch leaf has a leading ``num_steps`` axis and the step loop
@@ -327,11 +371,13 @@ def make_scanned_train_fn(
     fetch) that the reference's Python loop pays on every batch disappears —
     one dispatch runs a whole epoch (or chunk) on device, with the same
     collectives. ``bits_per_step`` still refers to ONE step; multiply by the
-    chunk length when accounting.
+    chunk length when accounting. With ``accum_steps > 1`` batch leaves are
+    ``(num_steps, accum_steps, batch, ...)``.
     """
     body = make_step_fn(
         loss_fn, reducer, learning_rate, momentum, algorithm,
         axis_name=axis_name if mesh is not None else None, optimizer=optimizer,
+        accum_steps=accum_steps,
     )
 
     def scan_steps(state: TrainState, batches):
@@ -368,11 +414,17 @@ def make_scanned_train_fn(
         reducer_state=PartitionSpec(),
         model_state=PartitionSpec(axis_name),
     )
+    batch_spec = (
+        PartitionSpec(None, axis_name)
+        if accum_steps == 1
+        else PartitionSpec(None, None, axis_name)
+    )
     sharded = jax.shard_map(
         sharded_body,
         mesh=mesh,
-        # batches: (num_steps, global_batch, ...) — sharded on the batch dim
-        in_specs=(state_specs, PartitionSpec(None, axis_name)),
+        # batches: (num_steps[, accum], global_batch, ...) — sharded on the
+        # batch dim
+        in_specs=(state_specs, batch_spec),
         out_specs=(state_specs, PartitionSpec()),
     )
     fn = jax.jit(sharded, donate_argnums=(0,) if donate_state else ())
@@ -407,6 +459,7 @@ def make_train_step(
     axis_name: str = DATA_AXIS,
     donate_state: bool = True,
     optimizer=None,
+    accum_steps: int = 1,
 ) -> CompiledStep:
     """Compile the full distributed training step.
 
@@ -416,11 +469,15 @@ def make_train_step(
     reducer's collectives riding the mesh (ICI on TPU). Without a mesh: the
     single-process fallback (reference ``reducer.py:13-18``) — same code, no
     collectives.
+
+    ``accum_steps > 1``: gradient accumulation (see :func:`make_step_fn`);
+    batch leaves then carry a leading ``accum_steps`` axis ahead of the
+    sharded batch axis.
     """
     if mesh is None:
         body = make_step_fn(
             loss_fn, reducer, learning_rate, momentum, algorithm,
-            axis_name=None, optimizer=optimizer,
+            axis_name=None, optimizer=optimizer, accum_steps=accum_steps,
         )
         fn = jax.jit(body, donate_argnums=(0,) if donate_state else ())
         return CompiledStep(
@@ -429,7 +486,7 @@ def make_train_step(
 
     body = make_step_fn(
         loss_fn, reducer, learning_rate, momentum, algorithm,
-        axis_name=axis_name, optimizer=optimizer,
+        axis_name=axis_name, optimizer=optimizer, accum_steps=accum_steps,
     )
 
     def sharded_body(state: TrainState, batch):
@@ -453,10 +510,15 @@ def make_train_step(
         reducer_state=PartitionSpec(),
         model_state=PartitionSpec(axis_name),
     )
+    batch_spec = (
+        PartitionSpec(axis_name)
+        if accum_steps == 1
+        else PartitionSpec(None, axis_name)  # (accum, global_batch, ...)
+    )
     sharded = jax.shard_map(
         sharded_body,
         mesh=mesh,
-        in_specs=(state_specs, PartitionSpec(axis_name)),
+        in_specs=(state_specs, batch_spec),
         out_specs=(state_specs, PartitionSpec()),
     )
     fn = jax.jit(sharded, donate_argnums=(0,) if donate_state else ())

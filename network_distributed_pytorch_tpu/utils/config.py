@@ -38,3 +38,4 @@ class ExperimentConfig:
     # TPU-native extras
     compute_dtype: str = "float32"  # "bfloat16" for MXU mixed precision
     log_every: int = 10
+    accum_steps: int = 1  # gradient accumulation microbatches per step

@@ -155,7 +155,7 @@ def test_adamw_inner_optimizer(devices):
     mesh = make_mesh()
     h = 4
     diloco = make_diloco_train_fn(
-        loss_fn, params, inner_learning_rate=0.0,  # unused on the optax path
+        loss_fn, params,  # no inner_learning_rate: the optax inner has its own
         sync_every=h, inner_algorithm="optax",
         inner_optimizer=optax.adamw(3e-2), mesh=mesh, donate_state=False,
     )
