@@ -18,11 +18,15 @@ from ..utils.metrics import MetricsLogger
 
 
 def accumulated_batches(
-    arrays, config, max_steps_per_epoch: Optional[int] = None
+    arrays,
+    config,
+    max_steps_per_epoch: Optional[int] = None,
+    keys: Optional[Tuple[str, ...]] = None,
 ) -> Callable[[int], Iterator[Any]]:
     """Per-epoch batch generator honoring ``config.accum_steps``: yields
     ``(global_batch, ...)`` leaves, or ``(accum, global_batch/accum, ...)``
-    when accumulating (the trainer's batch contract, ``make_step_fn``)."""
+    when accumulating (the trainer's batch contract, ``make_step_fn``).
+    ``keys`` turns each batch into a dict (the HF-style IMDb batches)."""
     import jax.numpy as jnp
 
     from ..data import iterate_batches
@@ -47,7 +51,8 @@ def accumulated_batches(
                 batch = tuple(
                     a.reshape((k, a.shape[0] // k) + a.shape[1:]) for a in batch
                 )
-            yield tuple(jnp.asarray(a) for a in batch)
+            batch = tuple(jnp.asarray(a) for a in batch)
+            yield dict(zip(keys, batch)) if keys else batch
 
     return gen
 

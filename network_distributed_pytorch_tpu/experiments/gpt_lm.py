@@ -45,6 +45,7 @@ def run(
     seq_len: int = 64,
     steps_per_epoch: int = 20,
     max_steps_per_epoch: Optional[int] = None,
+    remat: bool = False,
 ) -> Dict:
     config = config or ExperimentConfig(
         training_epochs=1, global_batch_size=32, learning_rate=0.1,
@@ -55,12 +56,10 @@ def run(
         steps_per_epoch = min(steps_per_epoch, max_steps_per_epoch)
 
     vocab = 64 if preset == "small" else 1024
-    model = (
-        gpt_tiny(vocab_size=vocab, max_position_embeddings=seq_len,
-                 dtype=jnp.dtype(config.compute_dtype))
-        if preset == "small"
-        else gpt_small(vocab_size=vocab, max_position_embeddings=seq_len,
-                       dtype=jnp.dtype(config.compute_dtype))
+    make = gpt_tiny if preset == "small" else gpt_small
+    model = make(
+        vocab_size=vocab, max_position_embeddings=seq_len,
+        dtype=jnp.dtype(config.compute_dtype), remat=remat,
     )
     ids = jnp.zeros((1, seq_len), jnp.int32)
     params = model.init(jax.random.PRNGKey(config.seed), ids)["params"]

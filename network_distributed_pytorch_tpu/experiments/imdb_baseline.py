@@ -14,13 +14,13 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from ..data import iterate_batches, prepare_imdb
+from ..data import prepare_imdb
 from ..models.distilbert import distilbert_base, distilbert_tiny
 from ..parallel import ExactReducer
 from ..parallel.trainer import make_train_step
 from ..utils.config import ExperimentConfig
 from ..utils.losses import cross_entropy_loss
-from .common import summarize, train_loop
+from .common import accumulated_batches, summarize, train_loop
 
 
 def run(
@@ -87,22 +87,15 @@ def run(
         algorithm=algorithm,
         mesh=None,
         optimizer=optimizer,
+        accum_steps=config.accum_steps,
     )
     state = step.init_state(params)
 
     arrays = [train_split["input_ids"], train_split["attention_mask"], train_split["labels"]]
-
-    def batches(epoch):
-        it = iterate_batches(arrays, config.global_batch_size, seed=config.seed, epoch=epoch)
-        for i, (ids, mask, y) in enumerate(it):
-            if max_steps_per_epoch is not None and i >= max_steps_per_epoch:
-                return
-            yield {
-                "input_ids": jnp.asarray(ids),
-                "attention_mask": jnp.asarray(mask),
-                "labels": jnp.asarray(y),
-            }
-
+    batches = accumulated_batches(
+        arrays, config, max_steps_per_epoch=max_steps_per_epoch,
+        keys=("input_ids", "attention_mask", "labels"),
+    )
     state, logger = train_loop(
         step, state, batches, config.training_epochs, log_every=config.log_every
     )

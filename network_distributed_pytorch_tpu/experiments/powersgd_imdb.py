@@ -17,13 +17,18 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from ..data import iterate_batches, prepare_imdb
+from ..data import prepare_imdb
 from ..models.distilbert import distilbert_base, distilbert_tiny
 from ..parallel import PowerSGDReducer, make_mesh
 from ..parallel.trainer import make_train_step
 from ..utils.config import ExperimentConfig
 from ..utils.losses import cross_entropy_loss
-from .common import summarize, train_loop
+from .common import (
+    accum_batch_sharding,
+    accumulated_batches,
+    summarize,
+    train_loop,
+)
 
 
 def run(
@@ -35,6 +40,7 @@ def run(
     pretrained_variables=None,
     max_len: int = 256,
     max_steps_per_epoch: Optional[int] = None,
+    remat: bool = False,
 ) -> Dict:
     config = config or ExperimentConfig(
         training_epochs=5,  # ddp_init.py:36
@@ -47,10 +53,14 @@ def run(
         config.global_batch_size = 16 * mesh.size  # total_batch = 16 * size
 
     if preset == "full":
-        model = distilbert_base(num_labels=2, dtype=jnp.dtype(config.compute_dtype))
+        model = distilbert_base(
+            num_labels=2, dtype=jnp.dtype(config.compute_dtype), remat=remat
+        )
         vocab = model.config.vocab_size
     else:
-        model = distilbert_tiny(num_labels=2, dtype=jnp.dtype(config.compute_dtype))
+        model = distilbert_tiny(
+            num_labels=2, dtype=jnp.dtype(config.compute_dtype), remat=remat
+        )
         vocab = model.config.vocab_size
         max_len = min(max_len, model.config.max_position_embeddings)
 
@@ -95,25 +105,19 @@ def run(
         momentum=config.momentum,
         algorithm="ef_momentum",
         mesh=mesh,
+        accum_steps=config.accum_steps,
     )
     state = step.init_state(params)
 
     arrays = [train_split["input_ids"], train_split["attention_mask"], train_split["labels"]]
-
-    def batches(epoch):
-        it = iterate_batches(arrays, config.global_batch_size, seed=config.seed, epoch=epoch)
-        for i, (ids, mask, y) in enumerate(it):
-            if max_steps_per_epoch is not None and i >= max_steps_per_epoch:
-                return
-            yield {
-                "input_ids": jnp.asarray(ids),
-                "attention_mask": jnp.asarray(mask),
-                "labels": jnp.asarray(y),
-            }
-
+    batches = accumulated_batches(
+        arrays, config, max_steps_per_epoch=max_steps_per_epoch,
+        keys=("input_ids", "attention_mask", "labels"),
+    )
     state, logger = train_loop(
         step, state, batches, config.training_epochs,
         rank=config.process_id, log_every=config.log_every,
+        batch_sharding=accum_batch_sharding(mesh, config.accum_steps),
     )
     return summarize(
         "powersgd_imdb",

@@ -70,6 +70,10 @@ def build_parser() -> argparse.ArgumentParser:
         "--accum-steps", type=int, default=None,
         help="gradient-accumulation microbatches per step (cifar experiments)",
     )
+    p.add_argument(
+        "--remat", action="store_true",
+        help="rematerialize transformer blocks in the backward pass (gpt_lm)",
+    )
     p.add_argument("--preset", choices=["small", "full"], default="small")
     p.add_argument("--data-dir", type=str, default="./data")
     p.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32")
@@ -137,6 +141,20 @@ def main(argv=None) -> dict:
             )
         )
 
+    # reject silently-ignored flags: each experiment supports a known subset
+    _ACCUM_OK = ("exact_cifar10", "powersgd_cifar10", "powersgd_imdb", "imdb_baseline")
+    _REMAT_OK = ("gpt_lm", "powersgd_imdb")
+    if cfg.accum_steps > 1 and args.experiment not in _ACCUM_OK:
+        raise ValueError(
+            f"--accum-steps is not supported by {args.experiment!r}"
+            f" (supported: {', '.join(_ACCUM_OK)})"
+        )
+    if args.remat and args.experiment not in _REMAT_OK:
+        raise ValueError(
+            f"--remat is not supported by {args.experiment!r}"
+            f" (supported: {', '.join(_REMAT_OK)})"
+        )
+
     fn = EXPERIMENTS[args.experiment]
     kwargs = {"config": cfg}
     if args.experiment in ("exact_cifar10", "powersgd_cifar10"):
@@ -148,10 +166,14 @@ def main(argv=None) -> dict:
         kwargs.update(preset=args.preset,
                       data_dir=None if args.data_dir == "./data" else args.data_dir,
                       max_steps_per_epoch=args.max_steps_per_epoch)
+        if args.experiment == "powersgd_imdb":
+            kwargs.update(remat=args.remat)
     elif args.experiment == "bandwidth_study":
         kwargs.update(preset=args.preset)
     elif args.experiment in ("gpt_lm", "gpt_pp", "gpt_sp"):
         kwargs.update(preset=args.preset, max_steps_per_epoch=args.max_steps_per_epoch)
+        if args.experiment == "gpt_lm":
+            kwargs.update(remat=args.remat)
         if args.experiment == "gpt_pp":
             kwargs.update(data_shards=args.data_shards, reducer=args.pp_reducer)
         if args.experiment in ("gpt_pp", "gpt_sp"):

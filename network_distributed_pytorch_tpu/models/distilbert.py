@@ -57,6 +57,9 @@ class DistilBertConfig:
     # single-device attention engine: "einsum" (XLA) or "flash" (the Pallas
     # VMEM-tiled kernel; no attention-weight dropout, as above).
     attn_impl: str = "einsum"
+    # rematerialization: recompute each block in the backward pass instead of
+    # storing activations (jax.checkpoint via nn.remat; see GPTConfig.remat).
+    remat: bool = False
 
 
 class MultiHeadSelfAttention(nn.Module):
@@ -157,8 +160,13 @@ class DistilBertEncoder(nn.Module):
 
         neg_inf = jnp.asarray(jnp.finfo(jnp.float32).min, dtype=cfg.dtype)
         mask = jnp.where(attention_mask > 0, 0.0, neg_inf).astype(cfg.dtype)
+        block_cls = (
+            nn.remat(TransformerBlock, static_argnums=(3,))
+            if cfg.remat
+            else TransformerBlock
+        )
         for i in range(cfg.n_layers):
-            x = TransformerBlock(cfg, name=f"layer_{i}")(x, mask, deterministic)
+            x = block_cls(cfg, name=f"layer_{i}")(x, mask, deterministic)
         return x
 
 
@@ -183,15 +191,15 @@ class DistilBertForSequenceClassification(nn.Module):
         return logits.astype(jnp.float32)
 
 
-def distilbert_base(num_labels: int = 2, dtype=jnp.float32) -> DistilBertForSequenceClassification:
+def distilbert_base(num_labels: int = 2, dtype=jnp.float32, remat: bool = False) -> DistilBertForSequenceClassification:
     """distilbert-base-uncased shape (the reference's checkpoint,
     ``ddp_powersgd_distillBERT_IMDb/ddp_init.py:150``)."""
     return DistilBertForSequenceClassification(
-        DistilBertConfig(num_labels=num_labels, dtype=dtype)
+        DistilBertConfig(num_labels=num_labels, dtype=dtype, remat=remat)
     )
 
 
-def distilbert_tiny(num_labels: int = 2, dtype=jnp.float32) -> DistilBertForSequenceClassification:
+def distilbert_tiny(num_labels: int = 2, dtype=jnp.float32, remat: bool = False) -> DistilBertForSequenceClassification:
     """Test-tier configuration (SURVEY §4: 'DistilBERT-shaped toy transformer')."""
     return DistilBertForSequenceClassification(
         DistilBertConfig(
@@ -203,5 +211,6 @@ def distilbert_tiny(num_labels: int = 2, dtype=jnp.float32) -> DistilBertForSequ
             hidden_dim=64,
             num_labels=num_labels,
             dtype=dtype,
+            remat=remat,
         )
     )
