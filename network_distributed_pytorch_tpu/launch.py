@@ -68,11 +68,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--reducer-rank", type=int, default=None)
     p.add_argument(
         "--accum-steps", type=int, default=None,
-        help="gradient-accumulation microbatches per step (cifar experiments)",
+        help="gradient-accumulation microbatches per step"
+             " (cifar and imdb experiments)",
     )
     p.add_argument(
         "--remat", action="store_true",
-        help="rematerialize transformer blocks in the backward pass (gpt_lm)",
+        help="rematerialize transformer blocks in the backward pass"
+             " (gpt_lm, powersgd_imdb)",
     )
     p.add_argument("--preset", choices=["small", "full"], default="small")
     p.add_argument("--data-dir", type=str, default="./data")
@@ -129,19 +131,8 @@ def main(argv=None) -> dict:
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
 
-    # multi-host rendezvous before any experiment touches devices
-    # (the reference's setup() does the same before run_task())
-    if args.num_processes > 1 and args.experiment != "bare_init":
-        initialize_distributed(
-            DistributedConfig(
-                process_id=cfg.process_id,
-                num_processes=cfg.num_processes,
-                coordinator_address=cfg.coordinator_address,
-                timeout_seconds=cfg.timeout_seconds,
-            )
-        )
-
-    # reject silently-ignored flags: each experiment supports a known subset
+    # reject silently-ignored flags BEFORE any rendezvous: a pure-CLI error
+    # must not burn a multi-host allocation on a doomed jax.distributed join
     _ACCUM_OK = ("exact_cifar10", "powersgd_cifar10", "powersgd_imdb", "imdb_baseline")
     _REMAT_OK = ("gpt_lm", "powersgd_imdb")
     if cfg.accum_steps > 1 and args.experiment not in _ACCUM_OK:
@@ -153,6 +144,18 @@ def main(argv=None) -> dict:
         raise ValueError(
             f"--remat is not supported by {args.experiment!r}"
             f" (supported: {', '.join(_REMAT_OK)})"
+        )
+
+    # multi-host rendezvous before any experiment touches devices
+    # (the reference's setup() does the same before run_task())
+    if args.num_processes > 1 and args.experiment != "bare_init":
+        initialize_distributed(
+            DistributedConfig(
+                process_id=cfg.process_id,
+                num_processes=cfg.num_processes,
+                coordinator_address=cfg.coordinator_address,
+                timeout_seconds=cfg.timeout_seconds,
+            )
         )
 
     fn = EXPERIMENTS[args.experiment]

@@ -20,8 +20,6 @@ from typing import Any, Optional
 import jax
 import orbax.checkpoint as ocp
 
-from ..parallel.trainer import TrainState
-
 
 def save_checkpoint(path: str, state: Any, step: Optional[int] = None) -> str:
     """Save a state pytree — a ``TrainState`` or any experiment carry —
@@ -45,8 +43,14 @@ def restore_checkpoint(path: str, template: Any) -> Any:
     # arrays instead — uncommitted inputs let jit place each leaf on the
     # step's own sharding, exactly like the freshly-initialized state.
     restored = jax.device_get(restored)
-    if isinstance(template, TrainState) and not isinstance(restored, TrainState):
-        return TrainState(*restored)
+    # orbax flattens NamedTuple carries (TrainState, DiLoCoState, ...) to
+    # plain tuples; rebuild the carry type the step function expects
+    if (
+        isinstance(template, tuple)
+        and hasattr(type(template), "_fields")
+        and not isinstance(restored, type(template))
+    ):
+        return type(template)(*restored)
     return restored
 
 
