@@ -8,6 +8,7 @@ from . import (  # noqa: F401
     gpt_lm,
     gpt_pp,
     gpt_sp,
+    gpt_tp,
     imdb_baseline,
     powersgd_cifar10,
     powersgd_imdb,

@@ -25,6 +25,7 @@ from .experiments import (
     gpt_lm,
     gpt_pp,
     gpt_sp,
+    gpt_tp,
     imdb_baseline,
     powersgd_cifar10,
     powersgd_imdb,
@@ -42,6 +43,7 @@ EXPERIMENTS = {
     "gpt_lm": gpt_lm.run,
     "gpt_pp": gpt_pp.run,
     "gpt_sp": gpt_sp.run,
+    "gpt_tp": gpt_tp.run,
 }
 
 
@@ -94,6 +96,15 @@ def build_parser() -> argparse.ArgumentParser:
         "--pp-reducer", choices=["exact", "powersgd"], default="exact",
         help="gpt_pp only: cross-shard gradient reduction when "
              "--data-shards > 1",
+    )
+    p.add_argument(
+        "--model-shards", type=int, default=4,
+        help="gpt_tp only: tensor-parallel shards (mesh ('data','model'))",
+    )
+    p.add_argument(
+        "--tp-reducer", choices=["exact", "powersgd"], default="exact",
+        help="gpt_tp only: data-axis gradient reduction when devices >"
+             " --model-shards",
     )
     p.add_argument(
         "--checkpoint-dir", type=str, default=None,
@@ -173,12 +184,14 @@ def main(argv=None) -> dict:
             kwargs.update(remat=args.remat)
     elif args.experiment == "bandwidth_study":
         kwargs.update(preset=args.preset)
-    elif args.experiment in ("gpt_lm", "gpt_pp", "gpt_sp"):
+    elif args.experiment in ("gpt_lm", "gpt_pp", "gpt_sp", "gpt_tp"):
         kwargs.update(preset=args.preset, max_steps_per_epoch=args.max_steps_per_epoch)
         if args.experiment == "gpt_lm":
             kwargs.update(remat=args.remat)
         if args.experiment == "gpt_pp":
             kwargs.update(data_shards=args.data_shards, reducer=args.pp_reducer)
+        if args.experiment == "gpt_tp":
+            kwargs.update(model_shards=args.model_shards, reducer=args.tp_reducer)
         if args.experiment in ("gpt_pp", "gpt_sp"):
             kwargs.update(checkpoint_dir=args.checkpoint_dir)
 

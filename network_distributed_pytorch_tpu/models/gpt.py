@@ -282,6 +282,108 @@ def gpt_head_apply(config: GPTConfig, final, embed, x):
     return logits.astype(jnp.float32)
 
 
+def tp_gpt_block_apply(config: GPTConfig, p, x, axis_name: str = "model"):
+    """One GPT block, Megatron tensor-parallel over ``axis_name`` — a pure
+    function on this device's parameter SHARDS (run under ``shard_map`` with
+    :func:`gpt_tp_param_specs`).
+
+    Head-sharded attention: q/k/v kernels hold this device's
+    ``n_heads/N`` head columns (column-parallel, no comm — heads are
+    contiguous ``head_dim`` column blocks, so a contiguous output-dim shard
+    IS a head group), attention runs on the local heads, and the out
+    projection is row-parallel — ONE ``psum`` restores the replicated
+    residual stream. The MLP is the canonical column→row pair (one more
+    psum). LayerNorms/residuals are computed redundantly on the replicated
+    stream. Backward needs no hand-written collectives: the replicated
+    activations/params are model-axis-invariant at differentiation time, so
+    jax's replication-tracking transpose inserts the Megatron-standard psum
+    that assembles their complete gradients across head/feature shards
+    automatically. Numerics match ``GPTBlock`` exactly, forward AND backward
+    (pinned by the single-device-equivalence test). Deterministic-only,
+    like the pipeline stage fns.
+    """
+    cfg = config
+    n_shards = jax.lax.axis_size(axis_name)
+    local_heads = cfg.n_heads // n_shards
+    head_dim = cfg.dim // cfg.n_heads
+    ln = lambda name, t: nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype).apply(
+        {"params": p[name]}, t
+    )
+
+    from ..parallel.tensor import column_parallel_dense, row_parallel_dense, tp_mlp
+
+    h = ln("ln_1", x)
+    attn_p = p["attn"]
+    proj = lambda name, t: column_parallel_dense(
+        t, attn_p[name]["kernel"], attn_p[name]["bias"]
+    )
+    q, k, v = proj("q_proj", h), proj("k_proj", h), proj("v_proj", h)
+    split = lambda t: t.reshape(t.shape[0], t.shape[1], local_heads, head_dim)
+    q, k, v = split(q), split(k), split(v)
+    t_len = x.shape[1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(head_dim).astype(
+        cfg.dtype
+    )
+    causal = jnp.tril(jnp.ones((t_len, t_len), bool))
+    scores = jnp.where(causal[None, None], scores, -jnp.inf)
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+    ctx = ctx.reshape(ctx.shape[0], ctx.shape[1], local_heads * head_dim)
+    x = x + row_parallel_dense(
+        ctx, attn_p["out_proj"]["kernel"], attn_p["out_proj"]["bias"], axis_name
+    )
+
+    h = ln("ln_2", x)
+    return x + tp_mlp(
+        h, p["mlp_fc"]["kernel"], p["mlp_fc"]["bias"],
+        p["mlp_proj"]["kernel"], p["mlp_proj"]["bias"], axis_name,
+        activation=lambda t: nn.gelu(t, approximate=True),
+    )
+
+
+def tp_gpt_forward(config: GPTConfig, params, input_ids, axis_name: str = "model"):
+    """Full TP decoder forward on a GPTLM param tree sharded per
+    :func:`gpt_tp_param_specs`: replicated embeddings → TP blocks (2 psums
+    each) → replicated final LN + weight-tied head. Deterministic-only."""
+    if config.dropout > 0:
+        raise ValueError(
+            "tensor-parallel apply runs deterministically; use dropout=0.0"
+        )
+    embed = {"wte": params["wte"], "wpe": params["wpe"]}
+    x = gpt_embed_apply(config, embed, input_ids)
+    for i in range(config.n_layers):
+        x = tp_gpt_block_apply(config, params[f"h_{i}"], x, axis_name)
+    return gpt_head_apply(config, {"ln_f": params["ln_f"]}, embed, x)
+
+
+def gpt_tp_param_specs(config: GPTConfig, axis_name: str = "model"):
+    """PartitionSpec tree for a GPTLM param tree under Megatron TP:
+    q/k/v and mlp_fc kernels column-sharded (output features = head groups),
+    out_proj/mlp_proj kernels row-sharded (input features), their output
+    biases replicated, everything else (LNs, embeddings, tied head)
+    replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    col = {"kernel": P(None, axis_name), "bias": P(axis_name)}
+    row = {"kernel": P(axis_name, None), "bias": P()}
+    ln = {"scale": P(), "bias": P()}
+    block = {
+        "ln_1": ln,
+        "attn": {"q_proj": col, "k_proj": col, "v_proj": col, "out_proj": row},
+        "ln_2": ln,
+        "mlp_fc": col,
+        "mlp_proj": row,
+    }
+    specs = {
+        "wte": {"embedding": P()},
+        "wpe": {"embedding": P()},
+        "ln_f": ln,
+    }
+    for i in range(config.n_layers):
+        specs[f"h_{i}"] = block
+    return specs
+
+
 def make_gpt_pipeline_train_fn(
     config: GPTConfig,
     layers_per_stage: int,
