@@ -20,8 +20,10 @@ from .hierarchical import HierarchicalReducer  # noqa: F401
 from .localsgd import (  # noqa: F401
     CompiledDiLoCo,
     CompiledLocalSGD,
+    CompiledStreamingDiLoCo,
     make_diloco_train_fn,
     make_local_sgd_train_fn,
+    make_streaming_diloco_train_fn,
 )
 from .reducers import ExactReducer, PowerSGDReducer  # noqa: F401
 from .compression import (  # noqa: F401
