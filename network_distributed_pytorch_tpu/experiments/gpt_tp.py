@@ -33,6 +33,7 @@ from ..models.gpt import (
     GPTLM,
     gpt_tp_param_specs,
     tp_gpt_forward,
+    vocab_parallel_next_token_loss,
 )
 from ..parallel.mesh import make_mesh
 from ..utils.config import ExperimentConfig
@@ -46,6 +47,7 @@ def run(
     mesh=None,
     model_shards: int = 4,
     reducer: str = "exact",
+    vocab_parallel: bool = False,
     seq_len: int = 32,
     steps_per_epoch: int = 15,
     max_steps_per_epoch: Optional[int] = None,
@@ -92,10 +94,15 @@ def run(
             f"model_shards={n_model} must divide n_heads={cfg.n_heads}"
             " (attention is head-sharded); pick a divisor of the head count"
         )
+    if vocab_parallel and vocab % n_model != 0:
+        raise ValueError(
+            f"vocab_parallel needs model_shards={n_model} to divide"
+            f" vocab_size={vocab}"
+        )
     model = GPTLM(cfg)
     ids = jnp.zeros((1, seq_len), jnp.int32)
     params = model.init(jax.random.PRNGKey(config.seed), ids)["params"]
-    specs = gpt_tp_param_specs(cfg)
+    specs = gpt_tp_param_specs(cfg, vocab_parallel=vocab_parallel)
 
     assert reducer in ("exact", "powersgd"), reducer
     if reducer == "powersgd" and n_data <= 1:
@@ -181,7 +188,11 @@ def run(
         )
 
         def loss_of(p):
-            return next_token_loss(tp_gpt_forward(cfg, p, x), y)
+            logits = tp_gpt_forward(cfg, p, x, vocab_parallel=vocab_parallel)
+            if vocab_parallel:
+                # vocab-sharded logits: CE without the full-vocab row
+                return vocab_parallel_next_token_loss(logits, y, "model")
+            return next_token_loss(logits, y)
 
         loss, grads = jax.value_and_grad(loss_of)(diff_params)
         if not run_reduction:
@@ -252,6 +263,7 @@ def run(
             "model_shards": n_model,
             "data_shards": n_data,
             "reducer": reducer,
+            "vocab_parallel": vocab_parallel,
             "vocab": vocab,
             "seq_len": seq_len,
             "hlo_collectives": audit["by_kind"],

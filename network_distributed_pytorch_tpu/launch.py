@@ -107,6 +107,11 @@ def build_parser() -> argparse.ArgumentParser:
              " --model-shards",
     )
     p.add_argument(
+        "--vocab-parallel", action="store_true",
+        help="gpt_tp only: shard the tied token table over vocab rows and"
+             " compute the CE without materializing full-vocab logits",
+    )
+    p.add_argument(
         "--checkpoint-dir", type=str, default=None,
         help="gpt_pp/gpt_sp: save the carry per epoch and resume the newest",
     )
@@ -191,7 +196,8 @@ def main(argv=None) -> dict:
         if args.experiment == "gpt_pp":
             kwargs.update(data_shards=args.data_shards, reducer=args.pp_reducer)
         if args.experiment == "gpt_tp":
-            kwargs.update(model_shards=args.model_shards, reducer=args.tp_reducer)
+            kwargs.update(model_shards=args.model_shards, reducer=args.tp_reducer,
+                          vocab_parallel=args.vocab_parallel)
         if args.experiment in ("gpt_pp", "gpt_sp"):
             kwargs.update(checkpoint_dir=args.checkpoint_dir)
 

@@ -254,6 +254,18 @@ def make_gpt_stage_fn(config: GPTConfig, layers_per_stage: int):
     return stage_fn
 
 
+def gpt_position_ids(config: GPTConfig, input_ids):
+    """Position ids for a (possibly sequence-sharded) token block: offset by
+    this device's ring position when ``seq_axis`` is set (matching
+    ``GPTLM.__call__``)."""
+    positions = jnp.arange(input_ids.shape[1])[None, :]
+    if config.seq_axis is not None:
+        positions = (
+            positions + jax.lax.axis_index(config.seq_axis) * input_ids.shape[1]
+        )
+    return positions
+
+
 def gpt_embed_apply(config: GPTConfig, embed, input_ids):
     """The (replicated) embedding front: tokens -> block-input activations.
     Deterministic (no dropout) — the pipeline path is an inference/training
@@ -262,14 +274,9 @@ def gpt_embed_apply(config: GPTConfig, embed, input_ids):
     x = nn.Embed(config.vocab_size, config.dim, dtype=config.dtype).apply(
         {"params": embed["wte"]}, input_ids
     )
-    positions = jnp.arange(input_ids.shape[1])[None, :]
-    if config.seq_axis is not None:
-        positions = (
-            positions + jax.lax.axis_index(config.seq_axis) * input_ids.shape[1]
-        )
     x = x + nn.Embed(
         config.max_position_embeddings, config.dim, dtype=config.dtype
-    ).apply({"params": embed["wpe"]}, positions)
+    ).apply({"params": embed["wpe"]}, gpt_position_ids(config, input_ids))
     return x
 
 
@@ -341,27 +348,113 @@ def tp_gpt_block_apply(config: GPTConfig, p, x, axis_name: str = "model"):
     )
 
 
-def tp_gpt_forward(config: GPTConfig, params, input_ids, axis_name: str = "model"):
+def vocab_parallel_embed(config: GPTConfig, wte_shard, input_ids, axis_name: str):
+    """Megatron VocabParallelEmbedding: the token table is sharded over
+    vocab ROWS; each rank looks up the ids that land in its row range
+    (others contribute zero) and ONE psum assembles the replicated
+    embedding."""
+    local_v = wte_shard.shape[0]
+    offset = jax.lax.axis_index(axis_name) * local_v
+    local_ids = input_ids - offset
+    in_range = (local_ids >= 0) & (local_ids < local_v)
+    # cast the table like nn.Embed(dtype=config.dtype) does, so both head
+    # modes compute the stream in the same precision
+    rows = wte_shard.astype(config.dtype)[jnp.clip(local_ids, 0, local_v - 1)]
+    rows = jnp.where(in_range[..., None], rows, jnp.zeros((), config.dtype))
+    return jax.lax.psum(rows, axis_name)
+
+
+def vocab_parallel_next_token_loss(
+    logits_shard: jax.Array, labels: jax.Array, axis_name: str
+) -> jax.Array:
+    """Mean next-token CE over VOCAB-SHARDED logits ``(..., V/N)`` without
+    ever materializing the full-vocab row: global max via ``pmax``, global
+    sum-exp and the target logit via ``psum`` — three scalar-ish
+    collectives instead of a (..., V) gather. Matches
+    :func:`next_token_loss` on the assembled logits (pinned by test)."""
+    logits_shard = logits_shard.astype(jnp.float32)
+    local_v = logits_shard.shape[-1]
+    offset = jax.lax.axis_index(axis_name) * local_v
+    # The max shift is numerical stabilization only — its contributions to
+    # the CE cancel exactly, so stop_gradient is mathematically exact. Two
+    # traps worth recording: (a) pmax has no differentiation rule, so the
+    # global max rides an all_gather; (b) the all_gather output is marked
+    # device-VARYING, and a varying term in the loss flips the implicit
+    # objective to a sum over ranks (jax's pvary-transpose-is-psum
+    # convention), scaling EVERY gradient by N — the pmean (an identity on
+    # the already-equal maxes) restores the invariant marking.
+    m = jax.lax.stop_gradient(
+        jax.lax.pmean(
+            jnp.max(
+                jax.lax.all_gather(jnp.max(logits_shard, axis=-1), axis_name),
+                axis=0,
+            ),
+            axis_name,
+        )
+    )
+    sumexp = jax.lax.psum(
+        jnp.sum(jnp.exp(logits_shard - m[..., None]), axis=-1), axis_name
+    )
+    local_labels = labels - offset
+    in_range = (local_labels >= 0) & (local_labels < local_v)
+    tgt_local = jnp.take_along_axis(
+        logits_shard, jnp.clip(local_labels, 0, local_v - 1)[..., None], axis=-1
+    )[..., 0]
+    tgt = jax.lax.psum(jnp.where(in_range, tgt_local, 0.0), axis_name)
+    return jnp.mean(m + jnp.log(sumexp) - tgt)
+
+
+def tp_gpt_forward(
+    config: GPTConfig,
+    params,
+    input_ids,
+    axis_name: str = "model",
+    vocab_parallel: bool = False,
+):
     """Full TP decoder forward on a GPTLM param tree sharded per
-    :func:`gpt_tp_param_specs`: replicated embeddings → TP blocks (2 psums
-    each) → replicated final LN + weight-tied head. Deterministic-only."""
+    :func:`gpt_tp_param_specs`: embeddings → TP blocks (2 psums each) →
+    final LN + weight-tied head. Deterministic-only.
+
+    ``vocab_parallel=True`` (pair with ``gpt_tp_param_specs(...,
+    vocab_parallel=True)``) additionally shards the tied token table over
+    vocab rows: the input lookup goes through
+    :func:`vocab_parallel_embed` and the head RETURNS VOCAB-SHARDED logits
+    ``(..., V/N)`` — feed them to :func:`vocab_parallel_next_token_loss`,
+    which never materializes the full-vocab row. This removes the largest
+    replicated matrix (and its model-axis gradient allreduce) from the TP
+    step."""
     if config.dropout > 0:
         raise ValueError(
             "tensor-parallel apply runs deterministically; use dropout=0.0"
         )
-    embed = {"wte": params["wte"], "wpe": params["wpe"]}
-    x = gpt_embed_apply(config, embed, input_ids)
+    if vocab_parallel:
+        wte_shard = params["wte"]["embedding"]
+        x = vocab_parallel_embed(config, wte_shard, input_ids, axis_name)
+        x = x + nn.Embed(
+            config.max_position_embeddings, config.dim, dtype=config.dtype
+        ).apply({"params": params["wpe"]}, gpt_position_ids(config, input_ids))
+    else:
+        embed = {"wte": params["wte"], "wpe": params["wpe"]}
+        x = gpt_embed_apply(config, embed, input_ids)
     for i in range(config.n_layers):
         x = tp_gpt_block_apply(config, params[f"h_{i}"], x, axis_name)
+    if vocab_parallel:
+        x = nn.LayerNorm(epsilon=1e-5, dtype=config.dtype).apply(
+            {"params": params["ln_f"]}, x
+        )
+        return (x @ wte_shard.T.astype(config.dtype)).astype(jnp.float32)
     return gpt_head_apply(config, {"ln_f": params["ln_f"]}, embed, x)
 
 
-def gpt_tp_param_specs(config: GPTConfig, axis_name: str = "model"):
+def gpt_tp_param_specs(
+    config: GPTConfig, axis_name: str = "model", vocab_parallel: bool = False
+):
     """PartitionSpec tree for a GPTLM param tree under Megatron TP:
     q/k/v and mlp_fc kernels column-sharded (output features = head groups),
     out_proj/mlp_proj kernels row-sharded (input features), their output
-    biases replicated, everything else (LNs, embeddings, tied head)
-    replicated."""
+    biases replicated, everything else (LNs, positions) replicated. The
+    tied token table is replicated by default, or vocab-row-sharded with
+    ``vocab_parallel=True`` (see :func:`tp_gpt_forward`)."""
     from jax.sharding import PartitionSpec as P
 
     col = {"kernel": P(None, axis_name), "bias": P(axis_name)}
@@ -375,7 +468,7 @@ def gpt_tp_param_specs(config: GPTConfig, axis_name: str = "model"):
         "mlp_proj": row,
     }
     specs = {
-        "wte": {"embedding": P()},
+        "wte": {"embedding": P(axis_name, None) if vocab_parallel else P()},
         "wpe": {"embedding": P()},
         "ln_f": ln,
     }

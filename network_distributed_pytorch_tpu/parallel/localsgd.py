@@ -427,6 +427,15 @@ def make_diloco_train_fn(
 # ---------------------------------------------------------------------------
 
 
+def _fragment_indices(n_leaves: int, num_fragments: int):
+    """Round-robin leaf→fragment assignment, the single source of truth for
+    both state initialization and the compiled phases."""
+    return [
+        [i for i in range(n_leaves) if i % num_fragments == k]
+        for k in range(num_fragments)
+    ]
+
+
 class StreamingDiLoCoState(NamedTuple):
     """Carry for :func:`make_streaming_diloco_train_fn`.
 
@@ -435,7 +444,9 @@ class StreamingDiLoCoState(NamedTuple):
     the merged global value); ``anchors`` holds each leaf's value at ITS
     last sync (the reference point the next outer gradient is measured
     from), and ``outer_momenta``/``reducer_states`` are replicated.
-    ``reducer_states`` is a K-tuple, one compression state per fragment."""
+    ``reducer_states`` is a K-tuple, one compression state per fragment.
+    ``phase`` counts completed phases — it lives IN the carry so a
+    checkpointed state resumes on the correct fragment schedule."""
 
     params: PyTree
     anchors: PyTree
@@ -444,6 +455,7 @@ class StreamingDiLoCoState(NamedTuple):
     memories: PyTree
     reducer_states: Tuple
     model_state: PyTree
+    phase: jax.Array
 
 
 class CompiledStreamingDiLoCo(NamedTuple):
@@ -452,7 +464,9 @@ class CompiledStreamingDiLoCo(NamedTuple):
     fragment is synced once per K phases, so the time-average wire cost
     matches plain DiLoCo at the same effective period while the PEAK bytes
     of any single sync drop K-fold (``peak_sync_bits`` vs a full-parameter
-    round). Call as ``state, losses = stream(state, batches, round_index)``."""
+    round). Call as ``state, losses = stream(state, batches)`` — the phase
+    counter rides in the carry (so checkpoint/resume keeps the fragment
+    schedule); an explicit ``round_index`` overrides it."""
 
     fns: Tuple
     bits_per_phase: Tuple
@@ -462,8 +476,11 @@ class CompiledStreamingDiLoCo(NamedTuple):
     axis_name: str
     reducer: Any
 
-    def __call__(self, state, batches, round_index: int):
-        return self.fns[round_index % self.num_fragments](state, batches)
+    def __call__(self, state, batches, round_index: Optional[int] = None):
+        k = (
+            int(state.phase) if round_index is None else round_index
+        ) % self.num_fragments
+        return self.fns[k](state, batches)
 
     @property
     def peak_sync_bits(self) -> int:
@@ -492,13 +509,14 @@ class CompiledStreamingDiLoCo(NamedTuple):
             model_state=tile_per_worker(
                 {} if model_state is None else model_state, n
             ),
+            phase=jnp.zeros((), jnp.int32),
         )
 
     def _fragment_templates(self, params: PyTree):
         leaves = jax.tree_util.tree_leaves(params)
         return [
-            [l for i, l in enumerate(leaves) if i % self.num_fragments == k]
-            for k in range(self.num_fragments)
+            [leaves[i] for i in idx]
+            for idx in _fragment_indices(len(leaves), self.num_fragments)
         ]
 
     def eval_params(self, state: StreamingDiLoCoState) -> PyTree:
@@ -552,11 +570,7 @@ def make_streaming_diloco_train_fn(
         reducer = ExactReducer()
 
     leaves_template, treedef = jax.tree_util.tree_flatten(params_template)
-    n_leaves = len(leaves_template)
-    frag_indices = [
-        [i for i in range(n_leaves) if i % num_fragments == k]
-        for k in range(num_fragments)
-    ]
+    frag_indices = _fragment_indices(len(leaves_template), num_fragments)
 
     inner_step = _make_inner_step(
         loss_fn, inner_algorithm, inner_learning_rate, inner_momentum, axis_name
@@ -615,6 +629,7 @@ def make_streaming_diloco_train_fn(
                     memories=pad_leading(unf(mem_leaves)),
                     reducer_states=new_states,
                     model_state=pad_leading(model_state),
+                    phase=state.phase + 1,
                 ),
                 losses,
             )
@@ -627,6 +642,7 @@ def make_streaming_diloco_train_fn(
             memories=PartitionSpec(axis_name),
             reducer_states=PartitionSpec(),
             model_state=PartitionSpec(axis_name),
+            phase=PartitionSpec(),
         )
         return jax.jit(
             jax.shard_map(
