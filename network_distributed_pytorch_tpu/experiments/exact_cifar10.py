@@ -78,6 +78,8 @@ def run(
 
         if config.accum_steps > 1:
             raise ValueError("accum_steps is not supported with strategy='fsdp'")
+        if config.max_grad_norm is not None:
+            raise ValueError("max_grad_norm is not supported with strategy='fsdp'")
         step = make_fsdp_train_step(
             loss_fn,
             params,
@@ -96,6 +98,7 @@ def run(
             algorithm="sgd",  # reference uses optim.SGD(lr, momentum=.9) — ddp_init.py:110
             mesh=mesh,
             accum_steps=config.accum_steps,
+            max_grad_norm=config.max_grad_norm,
         )
     state = step.init_state(params, model_state=model_state)
 

@@ -88,6 +88,7 @@ def run(
         mesh=None,
         optimizer=optimizer,
         accum_steps=config.accum_steps,
+        max_grad_norm=config.max_grad_norm,
     )
     state = step.init_state(params)
 

@@ -106,6 +106,7 @@ def run(
         algorithm="ef_momentum",
         mesh=mesh,
         accum_steps=config.accum_steps,
+        max_grad_norm=config.max_grad_norm,
     )
     state = step.init_state(params)
 

@@ -74,6 +74,11 @@ def build_parser() -> argparse.ArgumentParser:
              " (cifar and imdb experiments)",
     )
     p.add_argument(
+        "--max-grad-norm", type=float, default=None,
+        help="clip the reduced update to this global norm"
+             " (cifar/imdb experiments)",
+    )
+    p.add_argument(
         "--remat", action="store_true",
         help="rematerialize transformer blocks in the backward pass"
              " (gpt_lm, powersgd_imdb)",
@@ -140,6 +145,8 @@ def config_from_args(args) -> ExperimentConfig:
         cfg.reducer_rank = args.reducer_rank
     if args.accum_steps is not None:
         cfg.accum_steps = args.accum_steps
+    if args.max_grad_norm is not None:
+        cfg.max_grad_norm = args.max_grad_norm
     return cfg
 
 
@@ -154,6 +161,11 @@ def main(argv=None) -> dict:
     if cfg.accum_steps > 1 and args.experiment not in _ACCUM_OK:
         raise ValueError(
             f"--accum-steps is not supported by {args.experiment!r}"
+            f" (supported: {', '.join(_ACCUM_OK)})"
+        )
+    if cfg.max_grad_norm is not None and args.experiment not in _ACCUM_OK:
+        raise ValueError(
+            f"--max-grad-norm is not supported by {args.experiment!r}"
             f" (supported: {', '.join(_ACCUM_OK)})"
         )
     if args.remat and args.experiment not in _REMAT_OK:

@@ -266,6 +266,14 @@ def gpt_position_ids(config: GPTConfig, input_ids):
     return positions
 
 
+def gpt_position_embed(config: GPTConfig, wpe, input_ids):
+    """Positional-embedding lookup (``seq_axis``-aware) shared by the
+    replicated and vocab-parallel embedding fronts."""
+    return nn.Embed(
+        config.max_position_embeddings, config.dim, dtype=config.dtype
+    ).apply({"params": wpe}, gpt_position_ids(config, input_ids))
+
+
 def gpt_embed_apply(config: GPTConfig, embed, input_ids):
     """The (replicated) embedding front: tokens -> block-input activations.
     Deterministic (no dropout) — the pipeline path is an inference/training
@@ -274,19 +282,24 @@ def gpt_embed_apply(config: GPTConfig, embed, input_ids):
     x = nn.Embed(config.vocab_size, config.dim, dtype=config.dtype).apply(
         {"params": embed["wte"]}, input_ids
     )
-    x = x + nn.Embed(
-        config.max_position_embeddings, config.dim, dtype=config.dtype
-    ).apply({"params": embed["wpe"]}, gpt_position_ids(config, input_ids))
-    return x
+    return x + gpt_position_embed(config, embed["wpe"], input_ids)
+
+
+def gpt_head_matmul(config: GPTConfig, ln_f, wte_matrix, x):
+    """Final LN + weight-tied head matmul, the single source of truth for
+    both the replicated head and the vocab-parallel head (which passes its
+    vocab-row SHARD of the tied table and gets sharded logits back)."""
+    x = nn.LayerNorm(epsilon=1e-5, dtype=config.dtype).apply(
+        {"params": ln_f}, x
+    )
+    return (x @ wte_matrix.T.astype(config.dtype)).astype(jnp.float32)
 
 
 def gpt_head_apply(config: GPTConfig, final, embed, x):
     """The (replicated) head: final LN + weight-tied logits."""
-    x = nn.LayerNorm(epsilon=1e-5, dtype=config.dtype).apply(
-        {"params": final["ln_f"]}, x
+    return gpt_head_matmul(
+        config, final["ln_f"], embed["wte"]["embedding"], x
     )
-    logits = x @ embed["wte"]["embedding"].T.astype(config.dtype)
-    return logits.astype(jnp.float32)
 
 
 def tp_gpt_block_apply(config: GPTConfig, p, x, axis_name: str = "model"):
@@ -430,19 +443,14 @@ def tp_gpt_forward(
     if vocab_parallel:
         wte_shard = params["wte"]["embedding"]
         x = vocab_parallel_embed(config, wte_shard, input_ids, axis_name)
-        x = x + nn.Embed(
-            config.max_position_embeddings, config.dim, dtype=config.dtype
-        ).apply({"params": params["wpe"]}, gpt_position_ids(config, input_ids))
+        x = x + gpt_position_embed(config, params["wpe"], input_ids)
     else:
         embed = {"wte": params["wte"], "wpe": params["wpe"]}
         x = gpt_embed_apply(config, embed, input_ids)
     for i in range(config.n_layers):
         x = tp_gpt_block_apply(config, params[f"h_{i}"], x, axis_name)
     if vocab_parallel:
-        x = nn.LayerNorm(epsilon=1e-5, dtype=config.dtype).apply(
-            {"params": params["ln_f"]}, x
-        )
-        return (x @ wte_shard.T.astype(config.dtype)).astype(jnp.float32)
+        return gpt_head_matmul(config, params["ln_f"], wte_shard, x)
     return gpt_head_apply(config, {"ln_f": params["ln_f"]}, embed, x)
 
 

@@ -475,11 +475,21 @@ class CompiledStreamingDiLoCo(NamedTuple):
     mesh: Mesh
     axis_name: str
     reducer: Any
+    host_phase: dict = None  # mutable cell; seeded lazily from the carry
 
     def __call__(self, state, batches, round_index: Optional[int] = None):
-        k = (
-            int(state.phase) if round_index is None else round_index
-        ) % self.num_fragments
+        if round_index is None:
+            # keep a host-side shadow of the carried phase counter: reading
+            # int(state.phase) every call would block the host on the
+            # previous phase's device work. Seeded ONCE from the first
+            # state seen (covers checkpoint-resume, which restores before
+            # the first call); pass round_index explicitly to override.
+            if "phase" not in self.host_phase:
+                self.host_phase["phase"] = int(state.phase)
+            k = self.host_phase["phase"] % self.num_fragments
+            self.host_phase["phase"] += 1
+        else:
+            k = round_index % self.num_fragments
         return self.fns[k](state, batches)
 
     @property
@@ -665,5 +675,6 @@ def make_streaming_diloco_train_fn(
         for k in range(num_fragments)
     )
     return CompiledStreamingDiLoCo(
-        fns, bits_per_phase, num_fragments, sync_every, mesh, axis_name, reducer
+        fns, bits_per_phase, num_fragments, sync_every, mesh, axis_name,
+        reducer, {},
     )

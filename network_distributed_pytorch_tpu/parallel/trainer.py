@@ -186,6 +186,7 @@ def make_step_fn(
     axis_name: Optional[str] = DATA_AXIS,
     optimizer=None,
     accum_steps: int = 1,
+    max_grad_norm: Optional[float] = None,
 ) -> Callable[[TrainState, Any], Tuple[TrainState, jax.Array]]:
     """Build the per-device step body: ``(state, local_batch) -> (state, loss)``.
 
@@ -215,6 +216,21 @@ def make_step_fn(
     assert algorithm in ("ef_momentum", "sgd", "sgd_nesterov", "sgd_plain", "optax")
     assert (algorithm == "optax") == (optimizer is not None)
     assert accum_steps >= 1
+
+    def clip_by_global_norm(delta: PyTree) -> PyTree:
+        # torch.nn.utils.clip_grad_norm_ semantics, applied to the REDUCED
+        # update on every worker (identical values, so no extra collective);
+        # a beyond-reference extension — the reference never clips
+        if max_grad_norm is None:
+            return delta
+        leaves = jax.tree_util.tree_leaves(delta)
+        norm = jnp.sqrt(
+            sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+        )
+        scale = jnp.minimum(1.0, max_grad_norm / (norm + 1e-6))
+        return jax.tree_util.tree_map(
+            lambda l: (l * scale).astype(l.dtype), delta
+        )
 
     def grads_of(diff_params, model_state, batch):
         if accum_steps == 1:
@@ -272,6 +288,7 @@ def make_step_fn(
             reducer_state, delta, memories, _ = reducer.reduce(
                 state.reducer_state, send, axis_name
             )
+            delta = clip_by_global_norm(delta)
             # (Algo 2 lines 12-13)
             params, momenta = ef_momentum_update(
                 state.params, state.momenta, delta, learning_rate, momentum
@@ -280,6 +297,7 @@ def make_step_fn(
             reducer_state, delta, memories, _ = reducer.reduce(
                 state.reducer_state, grads, axis_name
             )
+            delta = clip_by_global_norm(delta)
             import optax
 
             updates, momenta = optimizer.update(delta, state.momenta, state.params)
@@ -289,6 +307,7 @@ def make_step_fn(
             reducer_state, delta, memories, _ = reducer.reduce(
                 state.reducer_state, grads, axis_name
             )
+            delta = clip_by_global_norm(delta)
             if algorithm == "sgd":
                 params, momenta = sgd_momentum_update(
                     state.params, state.momenta, delta, learning_rate, momentum
@@ -362,6 +381,7 @@ def make_scanned_train_fn(
     donate_state: bool = True,
     optimizer=None,
     accum_steps: int = 1,
+    max_grad_norm: Optional[float] = None,
 ) -> "CompiledStep":
     """Multi-step variant: ``fn(state, stacked_batches) -> (state, losses)``
     where each batch leaf has a leading ``num_steps`` axis and the step loop
@@ -377,7 +397,7 @@ def make_scanned_train_fn(
     body = make_step_fn(
         loss_fn, reducer, learning_rate, momentum, algorithm,
         axis_name=axis_name if mesh is not None else None, optimizer=optimizer,
-        accum_steps=accum_steps,
+        accum_steps=accum_steps, max_grad_norm=max_grad_norm,
     )
 
     def scan_steps(state: TrainState, batches):
@@ -460,6 +480,7 @@ def make_train_step(
     donate_state: bool = True,
     optimizer=None,
     accum_steps: int = 1,
+    max_grad_norm: Optional[float] = None,
 ) -> CompiledStep:
     """Compile the full distributed training step.
 
@@ -478,6 +499,7 @@ def make_train_step(
         body = make_step_fn(
             loss_fn, reducer, learning_rate, momentum, algorithm,
             axis_name=None, optimizer=optimizer, accum_steps=accum_steps,
+            max_grad_norm=max_grad_norm,
         )
         fn = jax.jit(body, donate_argnums=(0,) if donate_state else ())
         return CompiledStep(
@@ -487,6 +509,7 @@ def make_train_step(
     body = make_step_fn(
         loss_fn, reducer, learning_rate, momentum, algorithm,
         axis_name=axis_name, optimizer=optimizer, accum_steps=accum_steps,
+        max_grad_norm=max_grad_norm,
     )
 
     def sharded_body(state: TrainState, batch):

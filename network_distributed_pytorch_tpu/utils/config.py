@@ -39,3 +39,4 @@ class ExperimentConfig:
     compute_dtype: str = "float32"  # "bfloat16" for MXU mixed precision
     log_every: int = 10
     accum_steps: int = 1  # gradient accumulation microbatches per step
+    max_grad_norm: Optional[float] = None  # global-norm gradient clipping

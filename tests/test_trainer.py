@@ -162,3 +162,47 @@ def test_scanned_epoch_equals_stepwise(devices):
     np.testing.assert_allclose(np.asarray(losses2), losses1, rtol=1e-5)
     for a, b in zip(jax.tree_util.tree_leaves(s1.params), jax.tree_util.tree_leaves(s2.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_max_grad_norm_clips_like_torch(devices):
+    """max_grad_norm applies torch clip_grad_norm_ semantics to the reduced
+    delta: the distributed clipped step equals a manually-clipped
+    single-device step, and None leaves the trajectory unchanged."""
+    import numpy as np
+
+    from network_distributed_pytorch_tpu.parallel import ExactReducer, make_mesh
+
+    rng = np.random.RandomState(0)
+    w_true = 50.0 * rng.randn(16, 4).astype(np.float32)  # big grads
+    x = rng.randn(64, 16).astype(np.float32)
+    y = x @ w_true
+    params = {"w": jnp.zeros((16, 4)), "b": jnp.zeros((4,))}
+    loss_fn = stateless_loss(
+        lambda p, b: jnp.mean((b[0] @ p["w"] + p["b"] - b[1]) ** 2)
+    )
+    batch = (jnp.asarray(x), jnp.asarray(y))
+    mesh = make_mesh()
+    max_norm = 1.0
+    step = make_train_step(
+        loss_fn, ExactReducer(), params, 0.05, algorithm="sgd_plain",
+        mesh=mesh, donate_state=False, max_grad_norm=max_norm,
+    )
+    state = step.init_state(params)
+    state, _ = step(state, batch)
+
+    # manual replica: global-batch gradient, clipped, one plain-SGD step
+    g = jax.grad(lambda p: loss_fn(p, {}, batch)[0])(params)
+    norm = float(
+        jnp.sqrt(sum(jnp.sum(l ** 2) for l in jax.tree_util.tree_leaves(g)))
+    )
+    assert norm > max_norm  # the clip must actually engage
+    scale = max_norm / (norm + 1e-6)
+    ref_w = np.asarray(params["w"]) - 0.05 * scale * np.asarray(g["w"])
+    np.testing.assert_allclose(
+        np.asarray(state.params["w"]), ref_w, rtol=1e-5, atol=1e-7
+    )
+    # update norm is capped at lr * max_norm
+    upd = np.asarray(state.params["w"]).ravel().tolist() + np.asarray(
+        state.params["b"]
+    ).ravel().tolist()
+    assert np.linalg.norm(np.asarray(upd)) <= 0.05 * max_norm * 1.001
