@@ -6,6 +6,7 @@ from . import (  # noqa: F401
     bare_init,
     exact_cifar10,
     gpt_lm,
+    gpt_moe,
     gpt_pp,
     gpt_sp,
     gpt_tp,

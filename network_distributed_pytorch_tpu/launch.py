@@ -23,6 +23,7 @@ from .experiments import (
     bare_init,
     exact_cifar10,
     gpt_lm,
+    gpt_moe,
     gpt_pp,
     gpt_sp,
     gpt_tp,
@@ -44,6 +45,7 @@ EXPERIMENTS = {
     "gpt_pp": gpt_pp.run,
     "gpt_sp": gpt_sp.run,
     "gpt_tp": gpt_tp.run,
+    "gpt_moe": gpt_moe.run,
 }
 
 
@@ -110,6 +112,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--tp-reducer", choices=["exact", "powersgd"], default="exact",
         help="gpt_tp only: data-axis gradient reduction when devices >"
              " --model-shards",
+    )
+    p.add_argument(
+        "--experts-per-device", type=int, default=1,
+        help="gpt_moe only: local experts per device (total = devices x this)",
+    )
+    p.add_argument(
+        "--moe-reducer", choices=["exact", "powersgd"], default="exact",
+        help="gpt_moe only: reduction for the replicated (non-expert) params",
     )
     p.add_argument(
         "--vocab-parallel", action="store_true",
@@ -201,7 +211,7 @@ def main(argv=None) -> dict:
             kwargs.update(remat=args.remat)
     elif args.experiment == "bandwidth_study":
         kwargs.update(preset=args.preset)
-    elif args.experiment in ("gpt_lm", "gpt_pp", "gpt_sp", "gpt_tp"):
+    elif args.experiment in ("gpt_lm", "gpt_pp", "gpt_sp", "gpt_tp", "gpt_moe"):
         kwargs.update(preset=args.preset, max_steps_per_epoch=args.max_steps_per_epoch)
         if args.experiment == "gpt_lm":
             kwargs.update(remat=args.remat)
@@ -210,6 +220,9 @@ def main(argv=None) -> dict:
         if args.experiment == "gpt_tp":
             kwargs.update(model_shards=args.model_shards, reducer=args.tp_reducer,
                           vocab_parallel=args.vocab_parallel)
+        if args.experiment == "gpt_moe":
+            kwargs.update(experts_per_device=args.experts_per_device,
+                          reducer=args.moe_reducer)
         if args.experiment in ("gpt_pp", "gpt_sp"):
             kwargs.update(checkpoint_dir=args.checkpoint_dir)
 
