@@ -112,6 +112,43 @@ def main() -> int:
         w0 = float(np.asarray(jax.device_get(state.params["w"]))[0, 0])
         results[name] = (losses, w0)
 
+    # DiLoCo round across REAL process boundaries: per-worker inner state,
+    # PowerSGD-compressed outer deltas, one compiled shard_map round
+    from network_distributed_pytorch_tpu.parallel import make_diloco_train_fn
+    from network_distributed_pytorch_tpu.parallel.localsgd import DiLoCoState
+
+    diloco = make_diloco_train_fn(
+        stateless_loss(loss), params, inner_learning_rate=0.05,
+        sync_every=2, inner_algorithm="sgd_plain", mesh=mesh,
+        donate_state=False,
+        reducer=PowerSGDReducer(
+            random_seed=1234, compression_rank=2, matricize="last"
+        ),
+    )
+    dstate = global_state_from_host(
+        diloco.init_state(params),
+        DiLoCoState(
+            params=P(), outer_momenta=P(), inner_opt=P("data"),
+            memories=P("data"), reducer_state=P(), model_state=P("data"),
+        ),
+        mesh,
+    )
+    # two DISTINCT inner-step batches (reversed rows for step 2) so the
+    # sync_every scan is falsifiable — identical steps would mask a batch-
+    # threading regression
+    stacked = tuple(
+        np.stack([a, a[::-1]]) for a in (x, y)
+    )
+    dbatches = global_state_from_host(
+        stacked, (P(None, "data"), P(None, "data")), mesh
+    )
+    dlosses = []
+    for _ in range(2):
+        dstate, dl = diloco(dstate, dbatches)
+        dlosses.extend(float(v) for v in np.asarray(jax.device_get(dl)))
+    dw0 = float(np.asarray(jax.device_get(dstate.params["w"]))[0, 0])
+    results["diloco"] = (dlosses, dw0)
+
     for name, (losses, w0) in results.items():
         print(
             f"RESULT kind={name} pid={pid} "

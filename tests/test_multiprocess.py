@@ -49,6 +49,29 @@ def _single_process_reference(nproc: int, kind: str = "exact"):
         xb, yb = batch
         return jnp.mean((xb @ p["w"] + p["b"] - yb) ** 2)
 
+    if kind == "diloco":
+        from network_distributed_pytorch_tpu.parallel import (
+            make_diloco_train_fn,
+        )
+
+        diloco = make_diloco_train_fn(
+            stateless_loss(loss), params, inner_learning_rate=0.05,
+            sync_every=2, inner_algorithm="sgd_plain",
+            mesh=make_mesh(devices=jax.devices()[:nproc]), donate_state=False,
+            reducer=PowerSGDReducer(
+                random_seed=1234, compression_rank=2, matricize="last"
+            ),
+        )
+        dstate = diloco.init_state(params)
+        stacked = tuple(
+            jnp.stack([jnp.asarray(a), jnp.asarray(a[::-1].copy())])
+            for a in (x, y)
+        )
+        losses = []
+        for _ in range(2):
+            dstate, dl = diloco(dstate, stacked)
+            losses.extend(float(v) for v in np.asarray(dl))
+        return losses, float(np.asarray(diloco.eval_params(dstate)["w"])[0, 0])
     if kind == "powersgd":
         reducer, algo = PowerSGDReducer(
             random_seed=1234, compression_rank=2, matricize="last"
@@ -106,7 +129,7 @@ def test_two_process_rendezvous_matches_single_process(devices):
                 [float(v) for v in fields["losses"].split(",")],
                 float(fields["w00"]),
             )
-    for kind in ("exact", "powersgd"):
+    for kind in ("exact", "powersgd", "diloco"):
         assert (kind, 0) in results and (kind, 1) in results, results.keys()
         # both ranks report the same (pmean'd) losses and identical params
         assert results[(kind, 0)] == results[(kind, 1)]
