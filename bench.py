@@ -336,9 +336,15 @@ def _overlap_evidence(results: dict, make_model, mesh) -> None:
         rep["workload"] = "powersgd_r4_" + ("resnet18" if "small" == results.get("preset") else "resnet50")
         rep["compiled_for"] = topology_note
         rep["device"] = results.get("device", "?")
+        # only the real-chip run owns OVERLAP.json — a CPU smoke run must
+        # not clobber the committed TPU artifact (it once did)
+        name = (
+            "OVERLAP.json"
+            if jax.devices()[0].platform == "tpu"
+            else "OVERLAP_smoke.json"
+        )
         with open(
-            os.path.join(os.path.dirname(os.path.abspath(__file__)), "OVERLAP.json"),
-            "w",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), name), "w"
         ) as f:
             json.dump(rep, f, indent=1)
         results["overlap"] = {
