@@ -4,6 +4,7 @@ bandwidth study they were all built for), as library entry points."""
 from . import (  # noqa: F401
     bandwidth_study,
     bare_init,
+    diloco_cifar10,
     exact_cifar10,
     gpt_lm,
     gpt_moe,

@@ -1,0 +1,164 @@
+"""DiLoCo / local SGD on the reference's CIFAR workload (beyond parity):
+the modern communication-AVOIDANCE answer to the slow-network problem the
+reference attacks with compression, as a launcher entry point.
+
+Same model/data scaffolding as ``powersgd_cifar10`` (ResNet on CIFAR-10,
+synthetic fallback), but trained in sync rounds: each worker takes
+``sync_every`` local SGD steps, then the round's parameter delta is
+averaged and applied through an outer Nesterov step
+(``parallel.localsgd.make_diloco_train_fn``). ``reducer="powersgd"``
+compresses the outer delta under error feedback — avoidance × compression;
+``fragments > 1`` switches to streaming DiLoCo (round-robin fragment sync,
+K-fold lower peak bytes). Wire cost per round is the reducer pass over a
+parameter-shaped tree instead of one gradient allreduce per step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..data import load_cifar10_or_synthetic
+from ..parallel import (
+    ExactReducer,
+    PowerSGDReducer,
+    make_diloco_train_fn,
+    make_mesh,
+    make_streaming_diloco_train_fn,
+)
+from ..utils.config import ExperimentConfig
+from ..utils.metrics import MetricsLogger
+from .common import image_classifier_loss, summarize
+from .powersgd_cifar10 import build_model
+
+
+def run(
+    config: Optional[ExperimentConfig] = None,
+    preset: str = "small",
+    data_dir: str = "./data",
+    mesh=None,
+    sync_every: int = 8,
+    reducer: str = "exact",
+    fragments: int = 1,
+    inner_learning_rate: float = 0.05,
+    outer_learning_rate: float = 0.7,
+    outer_momentum: float = 0.9,
+    max_steps_per_epoch: Optional[int] = None,
+    eval_after: bool = False,
+) -> Dict:
+    """``inner_learning_rate`` is its own parameter (CLI ``--lr`` maps to
+    it): local SGD needs a far hotter inner rate than the reference's DDP
+    default lr, and ``config.learning_rate`` defaults to the latter."""
+    config = config or ExperimentConfig(
+        training_epochs=1, global_batch_size=512, reducer_rank=4,
+    )
+    mesh = mesh or make_mesh()
+    assert reducer in ("exact", "powersgd"), reducer
+
+    images, labels, is_real = load_cifar10_or_synthetic(data_dir, train=True)
+    model = build_model(preset, dtype=jnp.dtype(config.compute_dtype))
+    variables = model.init(
+        jax.random.PRNGKey(config.seed), jnp.zeros((1, 32, 32, 3)), train=True
+    )
+    loss_fn = image_classifier_loss(model, has_batch_stats=True)
+    red = (
+        PowerSGDReducer(
+            random_seed=config.seed, compression_rank=config.reducer_rank,
+            matricize="last",
+        )
+        if reducer == "powersgd"
+        else ExactReducer()
+    )
+    common = dict(
+        inner_learning_rate=inner_learning_rate,
+        outer_learning_rate=outer_learning_rate,
+        outer_momentum=outer_momentum,
+        inner_momentum=config.momentum,
+        sync_every=sync_every,
+        reducer=red,
+        mesh=mesh,
+        donate_state=False,
+    )
+    if fragments > 1:
+        diloco = make_streaming_diloco_train_fn(
+            loss_fn, variables["params"], num_fragments=fragments, **common
+        )
+    else:
+        diloco = make_diloco_train_fn(loss_fn, variables["params"], **common)
+    state = diloco.init_state(
+        variables["params"], model_state={"batch_stats": variables["batch_stats"]}
+    )
+
+    # rounds consume sync_every consecutive batches, stacked on a leading
+    # axis — one compiled dispatch per round
+    from ..data import iterate_batches
+
+    # one logged "step" per ROUND, so the logger's per-step increment is the
+    # round's wire cost — derived from the uniform bits_per_step property
+    # (for streaming this is the mean over phases, the right cumulative rate)
+    round_bits = diloco.bits_per_step * sync_every
+    logger = MetricsLogger(bits_per_step=round_bits, log_every=config.log_every)
+    import numpy as np
+
+    # inner-step cap honored exactly: only whole rounds run, so the cap
+    # floors to full rounds (never overshoots it)
+    max_rounds = (
+        None if max_steps_per_epoch is None else max_steps_per_epoch // sync_every
+    )
+    for epoch in range(config.training_epochs):
+        it = iterate_batches(
+            [images, labels], config.global_batch_size, seed=config.seed,
+            epoch=epoch,
+        )
+        pending = []
+        rounds_done = 0
+        for bx, by in it:
+            if max_rounds is not None and rounds_done >= max_rounds:
+                pending = []
+                break
+            pending.append((bx, by))
+            if len(pending) < sync_every:
+                continue
+            batches = tuple(
+                jnp.asarray(np.stack([b[i] for b in pending]))
+                for i in range(2)
+            )
+            pending = []
+            logger.start_step()
+            state, losses = diloco(state, batches)
+            losses = np.asarray(jax.device_get(losses))
+            # one logged "step" per ROUND; loss = round mean (the per-step
+            # series is inside `losses` and the wire cost amortized)
+            logger.end_step(epoch, float(losses.mean()))
+            rounds_done += 1
+        if pending and config.log_every:
+            # same convention as the static-shape loader's ragged-batch drop,
+            # but said out loud: a partial round cannot sync
+            print(
+                f"note: dropping {len(pending)} trailing batches"
+                f" (< sync_every={sync_every}) at epoch {epoch} end",
+                flush=True,
+            )
+        logger.end_epoch(epoch, rank=config.process_id)
+
+    extra = {
+        "preset": preset,
+        "real_data": is_real,
+        "num_devices": mesh.size,
+        "sync_every": sync_every,
+        "fragments": fragments,
+        "reducer": reducer,
+        "bits_per_round": round_bits,
+    }
+    if eval_after:
+        from .common import evaluate_image_classifier
+
+        test_x, test_y, _ = load_cifar10_or_synthetic(data_dir, train=False)
+        params = diloco.eval_params(state)
+        extra["eval_accuracy"] = evaluate_image_classifier(
+            model, params,
+            diloco.eval_model_state(state)["batch_stats"], test_x, test_y,
+        )
+    return summarize("diloco_cifar10", logger, extra)

@@ -21,6 +21,7 @@ import json
 from .experiments import (
     bandwidth_study,
     bare_init,
+    diloco_cifar10,
     exact_cifar10,
     gpt_lm,
     gpt_moe,
@@ -37,6 +38,7 @@ from .utils.config import ExperimentConfig
 EXPERIMENTS = {
     "bare_init": bare_init.run,
     "exact_cifar10": exact_cifar10.run,
+    "diloco_cifar10": diloco_cifar10.run,
     "powersgd_cifar10": powersgd_cifar10.run,
     "powersgd_imdb": powersgd_imdb.run,
     "imdb_baseline": imdb_baseline.run,
@@ -112,6 +114,19 @@ def build_parser() -> argparse.ArgumentParser:
         "--tp-reducer", choices=["exact", "powersgd"], default="exact",
         help="gpt_tp only: data-axis gradient reduction when devices >"
              " --model-shards",
+    )
+    p.add_argument(
+        "--sync-every", type=int, default=8,
+        help="diloco_cifar10 only: local steps per outer sync round",
+    )
+    p.add_argument(
+        "--fragments", type=int, default=1,
+        help="diloco_cifar10 only: >1 switches to streaming DiLoCo"
+             " (round-robin fragment sync)",
+    )
+    p.add_argument(
+        "--diloco-reducer", choices=["exact", "powersgd"], default="exact",
+        help="diloco_cifar10 only: compression of the outer parameter delta",
     )
     p.add_argument(
         "--experts-per-device", type=int, default=1,
@@ -198,7 +213,15 @@ def main(argv=None) -> dict:
 
     fn = EXPERIMENTS[args.experiment]
     kwargs = {"config": cfg}
-    if args.experiment in ("exact_cifar10", "powersgd_cifar10"):
+    if args.experiment == "diloco_cifar10":
+        kwargs.update(preset=args.preset, data_dir=args.data_dir,
+                      max_steps_per_epoch=args.max_steps_per_epoch,
+                      sync_every=args.sync_every, fragments=args.fragments,
+                      reducer=args.diloco_reducer)
+        if args.lr is not None:
+            # --lr names the INNER rate here (see diloco_cifar10.run)
+            kwargs.update(inner_learning_rate=args.lr)
+    elif args.experiment in ("exact_cifar10", "powersgd_cifar10"):
         kwargs.update(preset=args.preset, data_dir=args.data_dir,
                       max_steps_per_epoch=args.max_steps_per_epoch)
         if args.experiment == "exact_cifar10":
