@@ -56,6 +56,11 @@ def run(
     )
     mesh = mesh or make_mesh()
     assert reducer in ("exact", "powersgd"), reducer
+    if max_steps_per_epoch is not None and max_steps_per_epoch < sync_every:
+        raise ValueError(
+            f"max_steps_per_epoch={max_steps_per_epoch} < sync_every="
+            f"{sync_every}: not even one sync round would run"
+        )
 
     images, labels, is_real = load_cifar10_or_synthetic(data_dir, train=True)
     model = build_model(preset, dtype=jnp.dtype(config.compute_dtype))
@@ -95,11 +100,16 @@ def run(
     # axis — one compiled dispatch per round
     from ..data import iterate_batches
 
-    # one logged "step" per ROUND, so the logger's per-step increment is the
-    # round's wire cost — derived from the uniform bits_per_step property
-    # (for streaming this is the mean over phases, the right cumulative rate)
-    round_bits = diloco.bits_per_step * sync_every
-    logger = MetricsLogger(bits_per_step=round_bits, log_every=config.log_every)
+    # one logged "step" per ROUND. Plain DiLoCo has one fixed round cost;
+    # streaming phases differ, so each round is charged ITS phase's exact
+    # integer bits (keeping the logger's exact-tally contract)
+    if fragments > 1:
+        phase_bits = list(diloco.bits_per_phase)
+        round_bits = max(phase_bits)  # reported peak; tally uses per-phase
+    else:
+        phase_bits = [diloco.bits_per_round]
+        round_bits = diloco.bits_per_round
+    logger = MetricsLogger(log_every=config.log_every)
     import numpy as np
 
     # inner-step cap honored exactly: only whole rounds run, so the cap
@@ -107,6 +117,7 @@ def run(
     max_rounds = (
         None if max_steps_per_epoch is None else max_steps_per_epoch // sync_every
     )
+    total_rounds = 0
     for epoch in range(config.training_epochs):
         it = iterate_batches(
             [images, labels], config.global_batch_size, seed=config.seed,
@@ -130,9 +141,14 @@ def run(
             state, losses = diloco(state, batches)
             losses = np.asarray(jax.device_get(losses))
             # one logged "step" per ROUND; loss = round mean (the per-step
-            # series is inside `losses` and the wire cost amortized)
-            logger.end_step(epoch, float(losses.mean()))
+            # series is inside `losses`); the round is charged its phase's
+            # exact wire bits
+            logger.end_step(
+                epoch, float(losses.mean()),
+                bits=phase_bits[total_rounds % len(phase_bits)],
+            )
             rounds_done += 1
+            total_rounds += 1
         if pending and config.log_every:
             # same convention as the static-shape loader's ragged-batch drop,
             # but said out loud: a partial round cannot sync
@@ -150,7 +166,7 @@ def run(
         "sync_every": sync_every,
         "fragments": fragments,
         "reducer": reducer,
-        "bits_per_round": round_bits,
+        "bits_per_round": round_bits,  # peak phase bits for streaming
     }
     if eval_after:
         from .common import evaluate_image_classifier

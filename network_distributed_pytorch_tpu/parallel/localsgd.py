@@ -427,13 +427,21 @@ def make_diloco_train_fn(
 # ---------------------------------------------------------------------------
 
 
-def _fragment_indices(n_leaves: int, num_fragments: int):
-    """Round-robin leaf→fragment assignment, the single source of truth for
-    both state initialization and the compiled phases."""
-    return [
-        [i for i in range(n_leaves) if i % num_fragments == k]
-        for k in range(num_fragments)
-    ]
+def _fragment_indices(leaf_sizes, num_fragments: int):
+    """Greedy size-balanced leaf→fragment assignment (largest leaf first
+    into the lightest bin), the single source of truth for both state
+    initialization and the compiled phases. Deterministic; ties broken by
+    leaf index. Balancing matters because the streaming claim is about the
+    PEAK sync bytes — a round-robin split can put the embedding-sized leaf
+    and nothing else into one fragment and leave the peak untouched."""
+    bins = [[] for _ in range(num_fragments)]
+    loads = [0] * num_fragments
+    order = sorted(range(len(leaf_sizes)), key=lambda i: (-leaf_sizes[i], i))
+    for i in order:
+        k = min(range(num_fragments), key=lambda j: (loads[j], j))
+        bins[k].append(i)
+        loads[k] += leaf_sizes[i]
+    return [sorted(b) for b in bins]
 
 
 class StreamingDiLoCoState(NamedTuple):
@@ -526,7 +534,9 @@ class CompiledStreamingDiLoCo(NamedTuple):
         leaves = jax.tree_util.tree_leaves(params)
         return [
             [leaves[i] for i in idx]
-            for idx in _fragment_indices(len(leaves), self.num_fragments)
+            for idx in _fragment_indices(
+                [int(l.size) for l in leaves], self.num_fragments
+            )
         ]
 
     def eval_params(self, state: StreamingDiLoCoState) -> PyTree:
@@ -563,9 +573,10 @@ def make_streaming_diloco_train_fn(
     takes ``sync_every`` local steps and syncs only fragment ``r % K``, so
     each fragment's outer gradient spans ``K·sync_every`` local steps and
     the PEAK bytes of any sync drop K-fold (the slow-network pain point is
-    the burst, not the average). Fragments are leaves assigned round-robin
-    by index; each fragment carries its own outer-momentum slice, EF
-    memories, and reducer (e.g. PowerSGD) state, so compression composes
+    the burst, not the average). Fragments are greedy SIZE-BALANCED leaf
+    bins (largest leaf first into the lightest bin, deterministic — see
+    :func:`_fragment_indices`); each fragment carries its own
+    outer-momentum slice, EF memories, and reducer (e.g. PowerSGD) state, so compression composes
     per fragment exactly as in :func:`make_diloco_train_fn`. With
     ``num_fragments=1`` this IS plain DiLoCo (pinned by test)."""
     from .reducers import ExactReducer
@@ -580,7 +591,9 @@ def make_streaming_diloco_train_fn(
         reducer = ExactReducer()
 
     leaves_template, treedef = jax.tree_util.tree_flatten(params_template)
-    frag_indices = _fragment_indices(len(leaves_template), num_fragments)
+    frag_indices = _fragment_indices(
+        [int(l.size) for l in leaves_template], num_fragments
+    )
 
     inner_step = _make_inner_step(
         loss_fn, inner_algorithm, inner_learning_rate, inner_momentum, axis_name

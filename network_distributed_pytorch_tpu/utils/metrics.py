@@ -42,9 +42,11 @@ class MetricsLogger:
     def start_step(self) -> None:
         self._t_last = time.perf_counter()
 
-    def end_step(self, epoch: int, loss: float) -> StepRecord:
+    def end_step(self, epoch: int, loss: float, bits: int = None) -> StepRecord:
         dt = time.perf_counter() - (self._t_last or time.perf_counter())
-        self._bits += self.bits_per_step
+        # `bits` overrides the static per-step cost for callers whose steps
+        # have varying wire cost (e.g. streaming DiLoCo's per-fragment phases)
+        self._bits += self.bits_per_step if bits is None else bits
         rec = StepRecord(self._step, epoch, float(loss), dt, self._bits)
         self.records.append(rec)
         self._epoch_losses.append(float(loss))

@@ -145,3 +145,33 @@ def test_phase_wire_audit(devices):
         audit = collective_summary(hlo)
         audited = 8 * audit["total_payload_bytes"] + (h - 1) * LOSS_SYNC_BITS
         assert audited == stream.bits_per_phase[k], (k, audit)
+
+
+def test_fragments_are_size_balanced(devices):
+    """Greedy assignment keeps the PEAK phase bytes near total/K even with
+    one dominant leaf — a round-robin split would leave the peak at the
+    dominant leaf's full size plus whatever shared its bin."""
+    big = {
+        "emb": jnp.zeros((128, 16)),   # dominant
+        "a": jnp.zeros((16, 16)), "b": jnp.zeros((16, 16)),
+        "c": jnp.zeros((16, 16)), "d": jnp.zeros((16, 16)),
+        "e": jnp.zeros((16, 16)), "f": jnp.zeros((16, 16)),
+        "g": jnp.zeros((16, 16)), "h": jnp.zeros((16, 16)),
+    }
+    loss = stateless_loss(
+        lambda p, batch: sum(
+            jnp.sum(l ** 2) for l in jax.tree_util.tree_leaves(p)
+        )
+        + 0.0 * jnp.sum(batch[0])
+    )
+    stream = make_streaming_diloco_train_fn(
+        loss, big, inner_learning_rate=0.01, num_fragments=2,
+        sync_every=2, mesh=make_mesh(),
+    )
+    total = sum(stream.bits_per_phase)
+    # dominant leaf (2048 elems) + balance of small leaves: peak should sit
+    # well under 75% of total (round-robin with emb first would give ~64%+
+    # of the PARAM bytes to one phase; greedy gives ~54%)
+    assert stream.peak_sync_bits < 0.6 * total, (
+        stream.bits_per_phase, total
+    )
