@@ -6,6 +6,7 @@ from . import (  # noqa: F401
     bare_init,
     diloco_cifar10,
     exact_cifar10,
+    gpt_generate,
     gpt_lm,
     gpt_moe,
     gpt_pp,

@@ -23,6 +23,7 @@ from .experiments import (
     bare_init,
     diloco_cifar10,
     exact_cifar10,
+    gpt_generate,
     gpt_lm,
     gpt_moe,
     gpt_pp,
@@ -48,6 +49,7 @@ EXPERIMENTS = {
     "gpt_sp": gpt_sp.run,
     "gpt_tp": gpt_tp.run,
     "gpt_moe": gpt_moe.run,
+    "gpt_generate": gpt_generate.run,
 }
 
 
@@ -145,6 +147,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--checkpoint-dir", type=str, default=None,
         help="gpt_pp/gpt_sp: save the carry per epoch and resume the newest",
     )
+    p.add_argument(
+        "--max-new-tokens", type=int, default=64,
+        help="gpt_generate only: decode length",
+    )
+    p.add_argument(
+        "--temperature", type=float, default=0.0,
+        help="gpt_generate only: 0 = greedy",
+    )
     p.add_argument("--json", action="store_true", help="print the summary as JSON")
     return p
 
@@ -232,6 +242,9 @@ def main(argv=None) -> dict:
                       max_steps_per_epoch=args.max_steps_per_epoch)
         if args.experiment == "powersgd_imdb":
             kwargs.update(remat=args.remat)
+    elif args.experiment == "gpt_generate":
+        kwargs.update(preset=args.preset, max_new_tokens=args.max_new_tokens,
+                      temperature=args.temperature)
     elif args.experiment == "bandwidth_study":
         kwargs.update(preset=args.preset)
     elif args.experiment in ("gpt_lm", "gpt_pp", "gpt_sp", "gpt_tp", "gpt_moe"):

@@ -1,0 +1,19 @@
+"""The gpt_generate launcher entry point: KV-cache decode throughput with
+greedy determinism."""
+
+
+def test_gpt_generate_entry_point(devices):
+    from network_distributed_pytorch_tpu.launch import main
+
+    out = main(
+        ["gpt_generate", "--preset", "small", "--max-new-tokens", "16"]
+    )
+    assert out["experiment"] == "gpt_generate"
+    assert out["generate_tokens_per_sec"] > 0
+    assert out["decode_ms_per_token"] > 0 and out["prefill_ms"] > 0
+    assert len(out["sample_head"]) == 8
+    # greedy decode is deterministic
+    out2 = main(
+        ["gpt_generate", "--preset", "small", "--max-new-tokens", "16"]
+    )
+    assert out["sample_head"] == out2["sample_head"]
