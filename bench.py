@@ -206,11 +206,14 @@ def _measure(results: dict) -> dict:
         variables["params"], model_state={"batch_stats": variables["batch_stats"]}
     )
     state, loss = step(state, batch)  # compile + warmup
-    jax.block_until_ready(loss)
+    jax.device_get(loss)
     t0 = time.perf_counter()
     for _ in range(CHUNK):
         state, loss = step(state, batch)
-    jax.block_until_ready(loss)
+    # fetch, don't just block: on the experimental remote TPU platform
+    # block_until_ready returns before execution completes — only a
+    # device_get observes the finished step (scalar, negligible transfer)
+    jax.device_get(loss)
     results["baseline_imgs_per_sec"] = batch_size * CHUNK / (time.perf_counter() - t0)
 
     # --- flagship: bf16 MXU compute + scanned epoch runner ----------------
@@ -239,10 +242,10 @@ def _measure(results: dict) -> dict:
     except Exception:  # cost analysis is best-effort; MFU just goes unreported
         pass
     state, losses = compiled(state, chunk_batch)  # warmup
-    jax.block_until_ready(losses)
+    jax.device_get(losses)
     t0 = time.perf_counter()
     state, losses = compiled(state, chunk_batch)
-    jax.block_until_ready(losses)
+    jax.device_get(losses)  # see baseline note: fetch to observe completion
     dt = time.perf_counter() - t0
     results["flagship_imgs_per_sec"] = batch_size * CHUNK / dt
     results["step_time_ms"] = 1000.0 * dt / CHUNK

@@ -32,11 +32,13 @@ from .common import image_classifier_loss
 
 def _measure_step_time(step, state, batch, steps: int = 5) -> float:
     state, loss = step(state, batch)  # compile + warmup
-    jax.block_until_ready(loss)
+    jax.device_get(loss)
     t0 = time.perf_counter()
     for _ in range(steps):
         state, loss = step(state, batch)
-    jax.block_until_ready(loss)
+    # fetch, not just block: on the experimental remote TPU platform
+    # block_until_ready returns before execution completes
+    jax.device_get(loss)
     return (time.perf_counter() - t0) / steps
 
 
@@ -131,11 +133,13 @@ def run(
         )
         compiled = round_.fn.lower(state, lbatches).compile()
         state, losses = compiled(state, lbatches)  # warmup
-        jax.block_until_ready(losses)
+        jax.device_get(losses)
         t0 = time.perf_counter()
         for _ in range(3):
             state, losses = compiled(state, lbatches)
-        jax.block_until_ready(losses)
+        # fetch, not just block: on the experimental remote TPU platform
+        # block_until_ready returns before execution completes
+        jax.device_get(losses)
         step_s = (time.perf_counter() - t0) / (3 * sync_every)
         audit = collective_summary(hlo_text_of_compiled(compiled))
         scan_extra = sync_every - 1  # loss pmean executions beyond the audited 1

@@ -52,11 +52,12 @@ def run(
         )
     )
     key = jax.random.PRNGKey(config.seed + 2)
-    out = gen(params, prompt, key)  # compile + warmup
-    jax.block_until_ready(out)
+    out = jax.device_get(gen(params, prompt, key))  # compile + warmup
     t0 = time.perf_counter()
-    out = gen(params, prompt, key)
-    jax.block_until_ready(out)
+    # fetch, don't just block: on the experimental remote TPU platform
+    # block_until_ready returns before execution completes — only the
+    # device_get observes the finished decode (a (B, new) int32 fetch)
+    out = jax.device_get(gen(params, prompt, key))
     dt = time.perf_counter() - t0
     assert out.shape == (batch, max_new_tokens), out.shape
 
@@ -68,9 +69,9 @@ def run(
             model.config, p, ids, prompt_len + max_new_tokens
         )[0]
     )
-    jax.block_until_ready(prefill(params, prompt))  # compile + warmup
+    jax.device_get(prefill(params, prompt))  # compile + warmup
     t0 = time.perf_counter()
-    jax.block_until_ready(prefill(params, prompt))
+    jax.device_get(prefill(params, prompt))
     prefill_s = time.perf_counter() - t0
     decode_s = max(dt - prefill_s, 1e-9)
     return {

@@ -186,12 +186,12 @@ def main() -> int:
         x, y = synthetic_cifar10(256, seed=0)
         batch = (jnp.asarray(x), jnp.asarray(y))
         state, loss = step(state, batch)  # compile + warmup
-        jax.block_until_ready(loss)
+        jax.device_get(loss)
         trace_dir = os.path.join(ARTIFACTS, "tpu_trace")
         with jax.profiler.trace(trace_dir):
             for _ in range(3):
                 state, loss = step(state, batch)
-            jax.block_until_ready(loss)
+            jax.device_get(loss)
         files = []
         for root, _dirs, names in os.walk(trace_dir):
             files += [os.path.join(os.path.relpath(root, ARTIFACTS), n) for n in names]
