@@ -205,15 +205,14 @@ def _measure(results: dict) -> dict:
     state = step.init_state(
         variables["params"], model_state={"batch_stats": variables["batch_stats"]}
     )
+    from network_distributed_pytorch_tpu.utils.timing import wait_result
+
     state, loss = step(state, batch)  # compile + warmup
-    jax.device_get(loss)
+    wait_result(loss)
     t0 = time.perf_counter()
     for _ in range(CHUNK):
         state, loss = step(state, batch)
-    # fetch, don't just block: on the experimental remote TPU platform
-    # block_until_ready returns before execution completes — only a
-    # device_get observes the finished step (scalar, negligible transfer)
-    jax.device_get(loss)
+    wait_result(loss)  # fetch-to-observe-completion, utils.timing
     results["baseline_imgs_per_sec"] = batch_size * CHUNK / (time.perf_counter() - t0)
 
     # --- flagship: bf16 MXU compute + scanned epoch runner ----------------
@@ -242,10 +241,10 @@ def _measure(results: dict) -> dict:
     except Exception:  # cost analysis is best-effort; MFU just goes unreported
         pass
     state, losses = compiled(state, chunk_batch)  # warmup
-    jax.device_get(losses)
+    wait_result(losses)
     t0 = time.perf_counter()
     state, losses = compiled(state, chunk_batch)
-    jax.device_get(losses)  # see baseline note: fetch to observe completion
+    wait_result(losses)
     dt = time.perf_counter() - t0
     results["flagship_imgs_per_sec"] = batch_size * CHUNK / dt
     results["step_time_ms"] = 1000.0 * dt / CHUNK

@@ -31,14 +31,14 @@ from .common import image_classifier_loss
 
 
 def _measure_step_time(step, state, batch, steps: int = 5) -> float:
+    from ..utils.timing import wait_result
+
     state, loss = step(state, batch)  # compile + warmup
-    jax.device_get(loss)
+    wait_result(loss)
     t0 = time.perf_counter()
     for _ in range(steps):
         state, loss = step(state, batch)
-    # fetch, not just block: on the experimental remote TPU platform
-    # block_until_ready returns before execution completes
-    jax.device_get(loss)
+    wait_result(loss)  # fetch-to-observe-completion, utils.timing
     return (time.perf_counter() - t0) / steps
 
 
@@ -131,15 +131,15 @@ def run(
             variables["params"],
             model_state={"batch_stats": variables["batch_stats"]},
         )
+        from ..utils.timing import wait_result
+
         compiled = round_.fn.lower(state, lbatches).compile()
         state, losses = compiled(state, lbatches)  # warmup
-        jax.device_get(losses)
+        wait_result(losses)
         t0 = time.perf_counter()
         for _ in range(3):
             state, losses = compiled(state, lbatches)
-        # fetch, not just block: on the experimental remote TPU platform
-        # block_until_ready returns before execution completes
-        jax.device_get(losses)
+        wait_result(losses)  # fetch-to-observe-completion, utils.timing
         step_s = (time.perf_counter() - t0) / (3 * sync_every)
         audit = collective_summary(hlo_text_of_compiled(compiled))
         scan_extra = sync_every - 1  # loss pmean executions beyond the audited 1

@@ -51,15 +51,14 @@ def run(
             temperature=temperature, key=key,
         )
     )
+    from ..utils.timing import time_amortized, wait_result
+
     key = jax.random.PRNGKey(config.seed + 2)
-    out = jax.device_get(gen(params, prompt, key))  # compile + warmup
-    t0 = time.perf_counter()
-    # fetch, don't just block: on the experimental remote TPU platform
-    # block_until_ready returns before execution completes — only the
-    # device_get observes the finished decode (a (B, new) int32 fetch)
-    out = jax.device_get(gen(params, prompt, key))
-    dt = time.perf_counter() - t0
+    out = wait_result(gen(params, prompt, key))  # compile + warmup
     assert out.shape == (batch, max_new_tokens), out.shape
+    # amortize over repeats so a single host round-trip isn't billed to the
+    # generation (utils.timing)
+    dt = time_amortized(lambda: gen(params, prompt, key))
 
     # separate the prefill cost so the per-token decode latency is honest
     # (generate() = one prefill forward + the decode scan; for short decode
@@ -69,10 +68,8 @@ def run(
             model.config, p, ids, prompt_len + max_new_tokens
         )[0]
     )
-    jax.device_get(prefill(params, prompt))  # compile + warmup
-    t0 = time.perf_counter()
-    jax.device_get(prefill(params, prompt))
-    prefill_s = time.perf_counter() - t0
+    wait_result(prefill(params, prompt))  # compile + warmup
+    prefill_s = time_amortized(lambda: prefill(params, prompt))
     decode_s = max(dt - prefill_s, 1e-9)
     return {
         "experiment": "gpt_generate",

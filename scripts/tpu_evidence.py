@@ -185,13 +185,15 @@ def main() -> int:
         )
         x, y = synthetic_cifar10(256, seed=0)
         batch = (jnp.asarray(x), jnp.asarray(y))
+        from network_distributed_pytorch_tpu.utils.timing import wait_result
+
         state, loss = step(state, batch)  # compile + warmup
-        jax.device_get(loss)
+        wait_result(loss)
         trace_dir = os.path.join(ARTIFACTS, "tpu_trace")
         with jax.profiler.trace(trace_dir):
             for _ in range(3):
                 state, loss = step(state, batch)
-            jax.device_get(loss)
+            wait_result(loss)  # fetch-to-observe-completion, utils.timing
         files = []
         for root, _dirs, names in os.walk(trace_dir):
             files += [os.path.join(os.path.relpath(root, ARTIFACTS), n) for n in names]
