@@ -27,12 +27,11 @@ from ..parallel import ExactReducer, PowerSGDReducer, make_mesh
 from ..parallel.trainer import make_train_step
 from ..utils.bandwidth import bandwidth_table, format_table
 from ..utils.config import ExperimentConfig
+from ..utils.timing import wait_result
 from .common import image_classifier_loss
 
 
 def _measure_step_time(step, state, batch, steps: int = 5) -> float:
-    from ..utils.timing import wait_result
-
     state, loss = step(state, batch)  # compile + warmup
     wait_result(loss)
     t0 = time.perf_counter()
@@ -131,8 +130,6 @@ def run(
             variables["params"],
             model_state={"batch_stats": variables["batch_stats"]},
         )
-        from ..utils.timing import wait_result
-
         compiled = round_.fn.lower(state, lbatches).compile()
         state, losses = compiled(state, lbatches)  # warmup
         wait_result(losses)

@@ -49,7 +49,8 @@ def _expert_mlp(p, t):
 
 
 def moe_gpt_forward(cfg: GPTConfig, params, experts, routers, input_ids,
-                    capacity: int, axis_name: Optional[str] = AXIS):
+                    capacity: int, axis_name: Optional[str] = AXIS,
+                    top_k: int = 1):
     """Decoder forward with MoE MLPs: ``params`` is a GPTLM tree WITHOUT the
     dense MLP leaves (attention/LNs/embeddings, replicated), ``experts`` the
     per-device slice of the stacked expert MLPs, ``routers`` one replicated
@@ -74,7 +75,7 @@ def moe_gpt_forward(cfg: GPTConfig, params, experts, routers, input_ids,
         h = ln(bp["ln_2"], x)
         moe = switch_moe(
             h.reshape(-1, cfg.dim), routers[f"h_{i}"], experts[f"h_{i}"],
-            _expert_mlp, axis_name, capacity=capacity,
+            _expert_mlp, axis_name, capacity=capacity, top_k=top_k,
         )
         x = x + moe.out.reshape(x.shape)
         aux = aux + moe.aux_loss
@@ -92,6 +93,7 @@ def run(
     mesh=None,
     experts_per_device: int = 1,
     reducer: str = "exact",
+    top_k: int = 1,
     aux_coef: float = 0.01,
     capacity_factor: float = 2.0,
     seq_len: int = 32,
@@ -161,7 +163,10 @@ def run(
     }
 
     local_tokens = config.global_batch_size // n_dev * seq_len
-    capacity = max(1, int(capacity_factor * local_tokens / n_experts))
+    # GShard sizing: top_k assignments per token share the per-expert
+    # buffers, so capacity scales with k (otherwise --moe-top-k 2 would
+    # silently halve the effective capacity factor)
+    capacity = max(1, int(capacity_factor * top_k * local_tokens / n_experts))
 
     from jax.sharding import PartitionSpec as P
 
@@ -205,7 +210,7 @@ def run(
         def loss_of(base, experts_):
             p, r = base
             logits, aux_, dropped_ = moe_gpt_forward(
-                cfg, p, experts_, r, x, capacity
+                cfg, p, experts_, r, x, capacity, top_k=top_k
             )
             return (
                 next_token_loss(logits, y) + aux_coef * aux_,
@@ -285,7 +290,9 @@ def run(
     diag_x, diag_y = next(iter(batches(config.training_epochs)))
 
     def diag_fn(p, r, e, x, y):
-        logits, aux_, dropped_ = moe_gpt_forward(cfg, p, e, r, x, capacity)
+        logits, aux_, dropped_ = moe_gpt_forward(
+            cfg, p, e, r, x, capacity, top_k=top_k
+        )
         ce = next_token_loss(logits, y)
         return tuple(jax.lax.pmean(m, AXIS) for m in (ce, aux_, dropped_))
 
@@ -307,6 +314,7 @@ def run(
         {
             "n_experts": n_experts,
             "experts_per_device": experts_per_device,
+            "top_k": top_k,
             "capacity": capacity,
             # pure-CE perplexity: the logged loss includes aux_coef * aux,
             # so exp(final_loss) would NOT be comparable to gpt_lm/gpt_tp

@@ -139,6 +139,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="gpt_moe only: reduction for the replicated (non-expert) params",
     )
     p.add_argument(
+        "--moe-top-k", type=int, default=1,
+        help="gpt_moe only: experts per token (1=Switch, 2=GShard-style)",
+    )
+    p.add_argument(
         "--vocab-parallel", action="store_true",
         help="gpt_tp only: shard the tied token table over vocab rows and"
              " compute the CE without materializing full-vocab logits",
@@ -258,7 +262,7 @@ def main(argv=None) -> dict:
                           vocab_parallel=args.vocab_parallel)
         if args.experiment == "gpt_moe":
             kwargs.update(experts_per_device=args.experts_per_device,
-                          reducer=args.moe_reducer)
+                          reducer=args.moe_reducer, top_k=args.moe_top_k)
         if args.experiment in ("gpt_pp", "gpt_sp"):
             kwargs.update(checkpoint_dir=args.checkpoint_dir)
 

@@ -46,6 +46,7 @@ def switch_moe(
     expert_fn: Callable[[PyTree, jax.Array], jax.Array],
     axis_name: Optional[str],
     capacity: int,
+    top_k: int = 1,
 ) -> MoEOutput:
     """Top-1 routed mixture-of-experts layer.
 
@@ -59,6 +60,13 @@ def switch_moe(
 
     ``axis_name=None`` is the single-process fallback (all experts local, no
     all-to-all) — the framework-wide convention (reference ``reducer.py:13-18``).
+
+    ``top_k > 1`` switches to GShard-style multi-choice routing: each token
+    is dispatched to its ``top_k`` experts, gates renormalized over the
+    chosen experts, with PRIORITY dispatch — choice 0 claims capacity slots
+    first, then choice 1 takes what remains (an over-capacity secondary
+    choice drops while primaries survive). ``top_k=1`` is exactly the
+    Switch behavior above (same gates, same aux loss, same drops).
     """
     t, d = x.shape
     n = 1 if axis_name is None else lax.axis_size(axis_name)
@@ -69,30 +77,51 @@ def switch_moe(
         f" holds {e} ({n} devices x {e_local} local)"
     )
 
+    assert 1 <= top_k <= e, (top_k, e)
     # --- routing (fp32 for a stable softmax) ------------------------------
     logits = x.astype(jnp.float32) @ router_kernel.astype(jnp.float32)  # (T, E)
     probs = jax.nn.softmax(logits, axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)               # (T,)
-    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+    topk_probs, topk_idx = jax.lax.top_k(probs, top_k)     # (T, K)
+    gates = (
+        topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
+        if top_k > 1  # GShard renormalization over the chosen experts
+        else topk_probs
+    )
 
-    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (T, E)
-    # position of each token within its expert's capacity buffer
-    pos = jnp.cumsum(onehot, axis=0) - onehot                  # (T, E)
-    pos = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)     # (T,)
-    keep = pos < capacity
-    dropped_fraction = 1.0 - jnp.mean(keep.astype(jnp.float32))
+    # priority dispatch: a static unroll over choices (K is tiny); choice 0
+    # claims capacity slots first via the running per-expert counts
+    counts = jnp.zeros((e,), jnp.float32)
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    kept = 0.0
+    primary_onehot = None
+    for k in range(top_k):
+        oh = jax.nn.one_hot(topk_idx[:, k], e, dtype=jnp.float32)  # (T, E)
+        if k == 0:
+            primary_onehot = oh
+        # position of each token within its expert's capacity buffer,
+        # offset by the slots earlier choices already claimed
+        pos = counts[None, :] + jnp.cumsum(oh, axis=0) - oh        # (T, E)
+        pos_tok = jnp.sum(pos * oh, axis=-1)                       # (T,)
+        keep_k = pos_tok < capacity
+        d_k = (
+            oh[:, :, None]
+            * jax.nn.one_hot(
+                pos_tok.astype(jnp.int32), capacity, dtype=jnp.float32
+            )[:, None, :]
+            * keep_k[:, None, None]
+        )
+        dispatch = dispatch + d_k
+        combine = combine + d_k * gates[:, k][:, None, None]
+        counts = counts + jnp.sum(oh * keep_k[:, None].astype(jnp.float32), axis=0)
+        kept = kept + jnp.sum(keep_k.astype(jnp.float32))
+    dropped_fraction = 1.0 - kept / (t * top_k)
 
-    # load-balance aux loss BEFORE capacity drops (Switch eq. 4)
-    fraction = jnp.mean(onehot, axis=0)
+    # load-balance aux loss BEFORE capacity drops, on the PRIMARY
+    # assignment (Switch eq. 4; unchanged for top_k=1)
+    fraction = jnp.mean(primary_onehot, axis=0)
     prob_mean = jnp.mean(probs, axis=0)
     aux_loss = e * jnp.sum(fraction * prob_mean)
-
-    # (T, E, C) one-hot dispatch mask
-    dispatch = (
-        onehot[:, :, None]
-        * jax.nn.one_hot(pos, capacity, dtype=jnp.float32)[:, None, :]
-        * keep[:, None, None]
-    )
     # (E, C, D) expert-major send buffer
     sent = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
 
@@ -119,7 +148,6 @@ def switch_moe(
             processed, axis_name, split_axis=1, concat_axis=0, tiled=True
         )
 
-    combine = dispatch * gate[:, None, None]
     out = jnp.einsum("tec,ecd->td", combine, returned).astype(x.dtype)
     return MoEOutput(out, aux_loss, dropped_fraction)
 

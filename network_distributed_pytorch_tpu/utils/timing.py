@@ -25,13 +25,15 @@ def wait_result(x):
 
 
 def time_amortized(fn: Callable[[], object], repeats: int = 3) -> float:
-    """Mean seconds per call of ``fn`` over ``repeats`` timed calls, each
-    completed via :func:`wait_result` — amortizes the per-call host
-    round-trip that a single timed call would count in full. The caller
-    warms up (compiles) before handing ``fn`` over."""
+    """Mean seconds per call of ``fn`` over ``repeats`` calls, EACH fetched
+    via :func:`wait_result` before the next dispatch. Fetch-per-call is
+    deliberate: the calls are data-independent, so fetching only the last
+    one would let earlier executions overlap and understate per-call time.
+    The cost is that each call's figure includes one host round-trip —
+    biased high, never low (averaging over ``repeats`` smooths jitter).
+    The caller warms up (compiles) before handing ``fn`` over."""
     wait_result(fn())  # settle any pending work outside the timed region
     t0 = time.perf_counter()
     for _ in range(repeats):
-        out = fn()
-    wait_result(out)
+        wait_result(fn())
     return (time.perf_counter() - t0) / repeats

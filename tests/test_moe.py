@@ -162,3 +162,76 @@ def test_moe_trains(devices):
         params, l = step(params, x, y)
         losses.append(float(l))
     assert losses[-1] < 0.3 * losses[0], losses[::20]
+
+
+def _reference_topk(x, router_kernel, experts, k):
+    """Per-token dense top-k routing with GShard gate renormalization."""
+    logits = np.asarray(x, np.float64) @ np.asarray(router_kernel, np.float64)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    out = np.zeros_like(np.asarray(x))
+    for t in range(x.shape[0]):
+        idx = np.argsort(-probs[t])[:k]
+        gates = probs[t, idx] / probs[t, idx].sum()
+        for g, e_i in zip(gates, idx):
+            y = _expert_fn(experts[e_i], x[t][None])[0]
+            out[t] += g * np.asarray(y)
+    return out
+
+
+def test_moe_top2_single_process_matches_reference():
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(24, D), jnp.float32)
+    router = jnp.asarray(rng.randn(D, E) * 0.5, jnp.float32)
+    experts = _experts(4)
+    got = switch_moe(
+        x, router, stacked_expert_params(experts), _expert_fn,
+        axis_name=None, capacity=64, top_k=2,
+    )
+    ref = _reference_topk(x, router, experts, 2)
+    np.testing.assert_allclose(np.asarray(got.out), ref, rtol=2e-4, atol=2e-5)
+    assert float(got.dropped_fraction) == 0.0
+
+
+def test_moe_top2_multidevice_matches_single_process(devices):
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(32, D), jnp.float32)
+    router = jnp.asarray(rng.randn(D, E) * 0.5, jnp.float32)
+    experts = stacked_expert_params(_experts(6))
+    local = switch_moe(x, router, experts, _expert_fn, None, capacity=64, top_k=2)
+    mesh = make_mesh(axis_sizes=(8,), axis_names=("expert",), devices=devices)
+    dist = jax.jit(
+        jax.shard_map(
+            lambda x_, r_, e_: switch_moe(
+                x_, r_, e_, _expert_fn, "expert", capacity=64, top_k=2
+            ).out,
+            mesh=mesh,
+            in_specs=(P("expert"), P(), P("expert")),
+            out_specs=P("expert"),
+        )
+    )(x, router, experts)
+    np.testing.assert_allclose(
+        np.asarray(dist), np.asarray(local.out), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_moe_top2_priority_dispatch_drops_secondary_first():
+    """With capacity 1 and colliding choices, the primary (top-1) assignment
+    claims the slot and the secondary drops — not the other way around."""
+    rng = np.random.RandomState(7)
+    experts = _experts(8)
+    # steer ALL tokens to the same top-1 expert 0 and top-2 expert 1
+    router = np.zeros((D, E), np.float32)
+    router[:, 0] = 1.0
+    router[:, 1] = 0.5
+    x = jnp.asarray(np.abs(rng.randn(4, D)), jnp.float32)
+    got = switch_moe(
+        x, jnp.asarray(router), stacked_expert_params(experts), _expert_fn,
+        axis_name=None, capacity=1, top_k=2,
+    )
+    # token 0 keeps both assignments; tokens 1-3 drop both (slots taken):
+    # 2 kept of 8 assignments
+    np.testing.assert_allclose(float(got.dropped_fraction), 6 / 8, rtol=1e-6)
+    # token 0's output mixes experts 0 and 1; later tokens fall through to 0
+    assert float(jnp.max(jnp.abs(got.out[1:]))) == 0.0
+    assert float(jnp.max(jnp.abs(got.out[0]))) > 0.0
