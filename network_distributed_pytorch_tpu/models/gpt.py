@@ -485,12 +485,37 @@ def gpt_tp_param_specs(
     return specs
 
 
+def make_gpt_tp_stage_fn(
+    config: GPTConfig, layers_per_stage: int, model_axis: str = "model"
+):
+    """Tensor-parallel pipeline stage: each of the stage's blocks applied
+    via :func:`tp_gpt_block_apply` on this device's head/feature SHARDS —
+    the stage function for a 3-D ``(data, pipe, model)`` composition.
+    Stage params carry the ``(layers_per_stage, ...)`` leading axis of
+    :func:`make_gpt_stage_fn` with the block dims additionally sharded per
+    :func:`gpt_tp_param_specs`. Deterministic-only, like the dense stage."""
+    if config.dropout > 0:
+        raise ValueError(
+            "pipeline stages run deterministically (no dropout rng plumbing);"
+            " use a config with dropout=0.0"
+        )
+
+    def stage_fn(p, x):
+        for j in range(layers_per_stage):
+            bp = jax.tree_util.tree_map(lambda t: t[j], p["layers"])
+            x = tp_gpt_block_apply(config, bp, x, model_axis)
+        return x
+
+    return stage_fn
+
+
 def make_gpt_pipeline_train_fn(
     config: GPTConfig,
     layers_per_stage: int,
     num_microbatches: int,
     axis_name: str = "pipe",
     params_varying_over: tuple = (),
+    stage_fn=None,
 ):
     """FULL-model 1F1B pipeline training: every parameter gets a gradient.
 
@@ -513,8 +538,13 @@ def make_gpt_pipeline_train_fn(
     ``out_specs=(P(), (P(), P(axis_name), P()))``. When composing with a
     data axis, list it in ``params_varying_over`` (grads come back LOCAL to
     each data shard for pluggable reduction, as in ``trainer.make_step_fn``).
+    Pass ``stage_fn=make_gpt_tp_stage_fn(...)`` (with the stage specs'
+    block dims sharded per :func:`gpt_tp_param_specs`) to additionally
+    tensor-shard each stage over a ``model`` axis — the full 3-D
+    ``data × pipe × model`` composition (``tests/test_3d_gpt.py``).
     """
-    stage_fn = make_gpt_stage_fn(config, layers_per_stage)
+    if stage_fn is None:
+        stage_fn = make_gpt_stage_fn(config, layers_per_stage)
     from ..parallel.pipeline import make_pipeline_train_fn
 
     # loss_params carry ONLY what the head reads — final LN + the tied wte

@@ -36,7 +36,10 @@ PyTree = Any
 class MoEOutput(NamedTuple):
     out: jax.Array          # (T, D) combined expert outputs (0 for dropped)
     aux_loss: jax.Array     # scalar load-balance loss (Switch eq. 4)
-    dropped_fraction: jax.Array  # scalar: tokens over capacity
+    dropped_fraction: jax.Array  # scalar: fraction of the t*top_k
+    # (token, choice) ASSIGNMENTS over capacity — per-assignment, not
+    # per-token, when top_k > 1 (a surviving primary + dropped secondary
+    # contributes 1/2)
 
 
 def switch_moe(
