@@ -27,11 +27,13 @@ def run(
     prompt_len: int = 16,
     max_new_tokens: int = 64,
     temperature: float = 0.0,
+    vocab: Optional[int] = None,
 ) -> Dict:
     config = config or ExperimentConfig()
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
-    vocab = 64 if preset == "small" else 1024
+    if vocab is None:
+        vocab = 64 if preset == "small" else 1024
     total = prompt_len + max_new_tokens
     make = gpt_tiny if preset == "small" else gpt_small
     model = make(
@@ -70,7 +72,10 @@ def run(
     )
     wait_result(prefill(params, prompt))  # compile + warmup
     prefill_s = time_amortized(lambda: prefill(params, prompt))
-    decode_s = max(dt - prefill_s, 1e-9)
+    # prefill is timed separately, so dispatch jitter can push it past the
+    # end-to-end time; report null rather than an absurd ~0 decode latency
+    decode_s = dt - prefill_s
+    decode_unreliable = decode_s <= 0.0
     return {
         "experiment": "gpt_generate",
         "preset": preset,
@@ -80,7 +85,10 @@ def run(
         "temperature": temperature,
         "generate_tokens_per_sec": batch * max_new_tokens / dt,  # end-to-end
         "prefill_ms": 1000.0 * prefill_s,
-        "decode_ms_per_token": 1000.0 * decode_s / max_new_tokens,
+        "decode_ms_per_token": (
+            None if decode_unreliable else 1000.0 * decode_s / max_new_tokens
+        ),
+        "decode_time_unreliable": decode_unreliable,
         "sample_head": [int(t) for t in out[0, :8]],
         "device": getattr(
             jax.devices()[0], "device_kind", jax.devices()[0].platform

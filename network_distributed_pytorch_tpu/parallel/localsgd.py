@@ -498,6 +498,9 @@ class CompiledStreamingDiLoCo(NamedTuple):
             self.host_phase["phase"] += 1
         else:
             k = round_index % self.num_fragments
+            # an explicit call also advances the shadow so a later implicit
+            # call continues from round_index + 1 instead of a stale count
+            self.host_phase["phase"] = round_index + 1
         return self.fns[k](state, batches)
 
     @property

@@ -42,7 +42,9 @@ class MetricsLogger:
     def start_step(self) -> None:
         self._t_last = time.perf_counter()
 
-    def end_step(self, epoch: int, loss: float, bits: int = None) -> StepRecord:
+    def end_step(
+        self, epoch: int, loss: float, bits: Optional[int] = None
+    ) -> StepRecord:
         dt = time.perf_counter() - (self._t_last or time.perf_counter())
         # `bits` overrides the static per-step cost for callers whose steps
         # have varying wire cost (e.g. streaming DiLoCo's per-fragment phases)
