@@ -5,5 +5,6 @@ from .partition import Partition, DataPartitioner, partition_dataset  # noqa: F4
 from .loader import device_prefetch, epoch_order, iterate_batches, steps_per_epoch  # noqa: F401
 from .cifar10 import load_cifar10, load_cifar10_or_synthetic, synthetic_cifar10  # noqa: F401
 from .imdb import HashTokenizer, prepare_imdb, read_imdb_split, synthetic_imdb  # noqa: F401
+from .wordpiece import WordPieceTokenizer, load_vocab  # noqa: F401
 from .multihost import global_batch_from_local, global_state_from_host  # noqa: F401
 from ..native import NativeBatchLoader  # noqa: F401  (C++ prefetch runtime)

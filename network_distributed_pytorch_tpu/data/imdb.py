@@ -128,6 +128,14 @@ def prepare_imdb(
     """The ``prepare_IMDb`` equivalent (``ddp_init.py:68-83``): returns
     (train, val, is_real) where each split is
     ``{'input_ids', 'attention_mask', 'labels'}`` as fixed-shape numpy arrays.
+
+    Default tokenizer resolution when none is passed: a ``vocab.txt`` next to
+    the dataset (``{data_dir}/vocab.txt``) selects the first-party
+    :class:`~.wordpiece.WordPieceTokenizer` — drop the file
+    ``distilbert-base-uncased`` ships and tokenization matches
+    ``DistilBertTokenizerFast`` token-for-token with no HF runtime
+    (``tests/test_wordpiece.py``); otherwise the deterministic
+    :class:`HashTokenizer` stands in (no-files-on-disk fallback).
     """
     if data_dir is not None and os.path.isdir(os.path.join(data_dir, "train")):
         texts, labels = read_imdb_split(os.path.join(data_dir, "train"))
@@ -139,7 +147,29 @@ def prepare_imdb(
         texts, labels, test_size=0.2, seed=seed
     )
     if tokenizer is None:
-        tokenizer = HashTokenizer(vocab_size=vocab_size, max_len=max_len)
+        vocab_file = (
+            os.path.join(data_dir, "vocab.txt") if data_dir is not None else ""
+        )
+        if vocab_file and os.path.isfile(vocab_file):
+            from .wordpiece import WordPieceTokenizer
+
+            tokenizer = WordPieceTokenizer(vocab_file, max_len=max_len)
+            # max id + 1, not len(): blank/duplicate vocab lines make ids
+            # sparse (load_vocab assigns by line number)
+            vocab_span = max(tokenizer.vocab.values()) + 1
+            if vocab_span > vocab_size:
+                # ids past the embedding table would be silently clamped by
+                # nn.Embed's take under jit (garbage inputs, no error) —
+                # fail loudly instead: the model must be built with the
+                # on-disk vocab's size
+                raise ValueError(
+                    f"{vocab_file} spans token ids up to {vocab_span - 1} but "
+                    f"the model vocab_size is {vocab_size}; pass vocab_size="
+                    f"{vocab_span} (and size the model to match) or pass an "
+                    "explicit tokenizer"
+                )
+        else:
+            tokenizer = HashTokenizer(vocab_size=vocab_size, max_len=max_len)
 
     def encode(ts, ls):
         enc = tokenizer(ts)

@@ -1,0 +1,224 @@
+"""First-party offline WordPiece tokenizer (BERT/DistilBERT scheme).
+
+The reference tokenizes IMDb with ``DistilBertTokenizerFast(truncation=True,
+padding=True)`` (``ddp_powersgd_distillBERT_IMDb/ddp_init.py:74-77``), which
+needs the HF runtime + a downloaded tokenizer cache. This module removes the
+runtime dependency: given only a ``vocab.txt`` on disk (the single file that
+defines ``distilbert-base-uncased``'s tokenizer), it reproduces the full
+pipeline first-party — clean/whitespace normalization, lowercase +
+accent-stripping, punctuation splitting, CJK spacing, then greedy
+longest-match WordPiece — token-for-token against the HF fast tokenizer
+(asserted in ``tests/test_wordpiece.py``).
+
+TPU-first detail kept from :class:`~.imdb.HashTokenizer`: output is padded to
+a FIXED ``max_len`` (static shapes — the reference pads to the longest
+sequence in the batch, which would recompile per length on TPU).
+"""
+
+from __future__ import annotations
+
+import unicodedata
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+_MAX_WORD_CHARS = 100  # words longer than this become [UNK] (BERT behavior)
+
+
+def _is_whitespace(ch: str) -> bool:
+    if ch in (" ", "\t", "\n", "\r"):
+        return True
+    return unicodedata.category(ch) == "Zs"
+
+
+def _is_control(ch: str) -> bool:
+    if ch in ("\t", "\n", "\r"):
+        return False  # treated as whitespace, not control
+    return unicodedata.category(ch).startswith("C")
+
+
+def _is_punctuation(ch: str) -> bool:
+    cp = ord(ch)
+    # ASCII ranges treated as punctuation even where Unicode disagrees
+    # (e.g. ``$``, ``^``, ``` ` ```), matching the BERT basic tokenizer
+    if 33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96 or 123 <= cp <= 126:
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_cjk(cp: int) -> bool:
+    return (
+        0x4E00 <= cp <= 0x9FFF
+        or 0x3400 <= cp <= 0x4DBF
+        or 0x20000 <= cp <= 0x2A6DF
+        or 0x2A700 <= cp <= 0x2B73F
+        or 0x2B740 <= cp <= 0x2B81F
+        or 0x2B820 <= cp <= 0x2CEAF
+        or 0xF900 <= cp <= 0xFAFF
+        or 0x2F800 <= cp <= 0x2FA1F
+    )
+
+
+def load_vocab(vocab_file: str) -> Dict[str, int]:
+    """``vocab.txt`` → {token: id}, ids = line numbers (the HF convention)."""
+    vocab: Dict[str, int] = {}
+    with open(vocab_file, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            tok = line.rstrip("\n")
+            if tok:
+                vocab[tok] = i
+    return vocab
+
+
+class WordPieceTokenizer:
+    """Greedy longest-match WordPiece over an on-disk ``vocab.txt``, with the
+    ``distilbert-base-uncased`` text normalization (lowercase + NFD
+    accent-stripping + punctuation splitting + CJK spacing).
+
+    HF-style callable: ``tok(texts) -> {'input_ids', 'attention_mask'}`` as
+    fixed-shape int32 arrays — a drop-in for :class:`~.imdb.HashTokenizer`
+    where ``prepare_imdb`` constructs the default tokenizer.
+    """
+
+    def __init__(
+        self,
+        vocab_file: str,
+        max_len: int = 256,
+        lower_case: bool = True,
+        strip_accents: bool = True,
+        unk_token: str = "[UNK]",
+        cls_token: str = "[CLS]",
+        sep_token: str = "[SEP]",
+        pad_token: str = "[PAD]",
+    ):
+        self.vocab = load_vocab(vocab_file)
+        self.max_len = max_len
+        self.lower_case = lower_case
+        self.strip_accents = strip_accents
+        for tok in (unk_token, cls_token, sep_token, pad_token):
+            if tok not in self.vocab:
+                raise ValueError(f"special token {tok!r} missing from {vocab_file}")
+        self.unk_id = self.vocab[unk_token]
+        self.cls_id = self.vocab[cls_token]
+        self.sep_id = self.vocab[sep_token]
+        self.pad_id = self.vocab[pad_token]
+        self.unk_token = unk_token
+
+    # ---- text normalization (the BERT "basic tokenizer") -----------------
+
+    def _clean(self, text: str) -> str:
+        out = []
+        for ch in text:
+            cp = ord(ch)
+            if cp == 0 or cp == 0xFFFD or _is_control(ch):
+                continue
+            out.append(" " if _is_whitespace(ch) else ch)
+        return "".join(out)
+
+    def _space_cjk(self, text: str) -> str:
+        out = []
+        for ch in text:
+            if _is_cjk(ord(ch)):
+                out += [" ", ch, " "]
+            else:
+                out.append(ch)
+        return "".join(out)
+
+    def _strip_accents(self, word: str) -> str:
+        return "".join(
+            ch
+            for ch in unicodedata.normalize("NFD", word)
+            if unicodedata.category(ch) != "Mn"
+        )
+
+    def _split_punct(self, word: str) -> List[str]:
+        pieces: List[List[str]] = []
+        new_word = True
+        for ch in word:
+            if _is_punctuation(ch):
+                pieces.append([ch])
+                new_word = True
+            else:
+                if new_word:
+                    pieces.append([])
+                    new_word = False
+                pieces[-1].append(ch)
+        return ["".join(p) for p in pieces]
+
+    def basic_tokenize(self, text: str) -> List[str]:
+        text = self._space_cjk(self._clean(text))
+        words: List[str] = []
+        for word in text.split():
+            if self.lower_case:
+                word = word.lower()
+            if self.strip_accents:
+                word = self._strip_accents(word)
+            words += self._split_punct(word)
+        return [w for w in words if w]
+
+    # ---- WordPiece (greedy longest-match) --------------------------------
+
+    def wordpiece(self, word: str) -> List[str]:
+        if len(word) > _MAX_WORD_CHARS:
+            return [self.unk_token]
+        pieces: List[str] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    piece = sub
+                    break
+                end -= 1
+            if piece is None:
+                return [self.unk_token]  # whole word is UNK (BERT behavior)
+            pieces.append(piece)
+            start = end
+        return pieces
+
+    def tokenize(self, text: str) -> List[str]:
+        out: List[str] = []
+        for word in self.basic_tokenize(text):
+            out += self.wordpiece(word)
+        return out
+
+    # ---- HF-style batch encoding -----------------------------------------
+
+    def __call__(self, texts: Sequence[str]) -> dict:
+        # normalization (Unicode-aware) in Python; the greedy matcher — the
+        # hot loop — runs in the native runtime when available (parity
+        # asserted in tests/test_native_loader.py)
+        words_per_text = [self.basic_tokenize(t) for t in texts]
+        native = self._native_matcher()
+        if native is not None:
+            return native.encode(
+                words_per_text, self.unk_id, self.cls_id, self.sep_id,
+                self.pad_id, self.max_len, max_word_chars=_MAX_WORD_CHARS,
+            )
+        return self.python_encode(words_per_text)
+
+    def python_encode(self, words_per_text: Sequence[List[str]]) -> dict:
+        """The reference Python matcher (also the native-parity oracle)."""
+        ids = np.full((len(words_per_text), self.max_len), self.pad_id, dtype=np.int32)
+        mask = np.zeros((len(words_per_text), self.max_len), dtype=np.int32)
+        for row, words in enumerate(words_per_text):
+            pieces: List[str] = []
+            for word in words:
+                pieces += self.wordpiece(word)
+            toks = [self.vocab[t] for t in pieces][: self.max_len - 2]
+            toks = [self.cls_id] + toks + [self.sep_id]
+            ids[row, : len(toks)] = toks
+            mask[row, : len(toks)] = 1
+        return {"input_ids": ids, "attention_mask": mask}
+
+    def _native_matcher(self):
+        if not hasattr(self, "_native"):
+            from ..native.loader import NativeWordPiece
+
+            ordered = [t for t, _ in sorted(self.vocab.items(), key=lambda kv: kv[1])]
+            self._native = NativeWordPiece.build(ordered)
+        return self._native

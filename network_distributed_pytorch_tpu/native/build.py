@@ -107,3 +107,13 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.c_void_p, c.c_void_p,
     ]
     lib.ndp_tokenize_hash.restype = None
+    lib.ndp_wordpiece_build.argtypes = [c.c_void_p, c.c_void_p, c.c_int64]
+    lib.ndp_wordpiece_build.restype = c.c_void_p
+    lib.ndp_wordpiece_free.argtypes = [c.c_void_p]
+    lib.ndp_wordpiece_free.restype = None
+    lib.ndp_wordpiece_encode.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64,
+        c.c_int32, c.c_int32, c.c_int32, c.c_int32, c.c_int32, c.c_int,
+        c.c_void_p, c.c_void_p,
+    ]
+    lib.ndp_wordpiece_encode.restype = None

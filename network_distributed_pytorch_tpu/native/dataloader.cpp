@@ -29,7 +29,9 @@
 #include <functional>
 #include <mutex>
 #include <queue>
+#include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 // ---------------------------------------------------------------- threading
@@ -266,6 +268,102 @@ void ndp_tokenize_hash(const uint8_t* bytes, const int64_t* offsets,
       }
       ids[pos++] = 2;  // [SEP]
       for (int32_t j = 0; j < pos; ++j) mask[j] = 1;
+    }
+  });
+}
+
+// ----------------------------------------------------- WordPiece matcher
+// Greedy longest-match WordPiece (parity with data/wordpiece
+// .WordPieceTokenizer, the first-party DistilBertTokenizerFast equivalent,
+// ddp_powersgd_distillBERT_IMDb/ddp_init.py:74-77). The Unicode-aware text
+// normalization (clean / CJK spacing / lowercase / NFD accent strip /
+// punctuation split) stays in Python where it is correct by construction;
+// this is the hot inner loop — probing word substrings against the vocab
+// hash table. Probes are byte-level: vocab entries are valid UTF-8, so a
+// probe can only succeed on a character boundary, and among succeeding
+// probes byte-longest == char-longest. Token-for-token equal to the Python
+// matcher for all input (asserted in tests/test_native_loader.py).
+
+struct NdpWordPiece {
+  std::unordered_map<std::string, int32_t> root;  // pieces without "##"
+  std::unordered_map<std::string, int32_t> cont;  // "##" pieces, prefix stripped
+};
+
+void* ndp_wordpiece_build(const uint8_t* vocab_bytes, const int64_t* offsets,
+                          int64_t n_tokens) {
+  auto* h = new NdpWordPiece();
+  for (int64_t i = 0; i < n_tokens; ++i) {
+    const char* p = (const char*)vocab_bytes + offsets[i];
+    int64_t len = offsets[i + 1] - offsets[i];
+    if (len >= 2 && p[0] == '#' && p[1] == '#')
+      h->cont.emplace(std::string(p + 2, (size_t)(len - 2)), (int32_t)i);
+    else
+      h->root.emplace(std::string(p, (size_t)len), (int32_t)i);
+  }
+  return h;
+}
+
+void ndp_wordpiece_free(void* handle) { delete (NdpWordPiece*)handle; }
+
+// words arrive pre-normalized as concatenated UTF-8 bytes + offsets
+// (n_words+1), grouped per text by text_word_counts (n_texts). A word with
+// no full vocab tiling emits ONE unk_id (BERT whole-word [UNK]; the Python
+// side substitutes a lone 0xff byte for over-long words so the same rule
+// fires). Rows: [CLS] pieces… [SEP], pad — piece list truncated to
+// max_len-2 exactly like the Python `[:max_len-2]`.
+void ndp_wordpiece_encode(void* handle, const uint8_t* word_bytes,
+                          const int64_t* word_offsets,
+                          const int64_t* text_word_counts, int64_t n_texts,
+                          int32_t unk_id, int32_t cls_id, int32_t sep_id,
+                          int32_t pad_id, int32_t max_len, int n_threads,
+                          int32_t* ids_out, int32_t* mask_out) {
+  auto* H = (NdpWordPiece*)handle;
+  std::vector<int64_t> first(n_texts + 1, 0);
+  for (int64_t i = 0; i < n_texts; ++i)
+    first[i + 1] = first[i] + text_word_counts[i];
+  int64_t total_bytes = first[n_texts] ? word_offsets[first[n_texts]] : 0;
+  parallel_for(n_texts, effective_threads(total_bytes, n_threads),
+               [&](int64_t lo, int64_t hi) {
+    std::string probe;          // reused across probes — no realloc once grown
+    std::vector<int32_t> pieces;
+    const int32_t cap = max_len - 2;
+    for (int64_t t = lo; t < hi; ++t) {
+      pieces.clear();
+      for (int64_t w = first[t];
+           w < first[t + 1] && (int32_t)pieces.size() < cap; ++w) {
+        const char* wp = (const char*)word_bytes + word_offsets[w];
+        int64_t wlen = word_offsets[w + 1] - word_offsets[w];
+        if (wlen == 0) continue;  // Python yields no pieces for ""
+        size_t mark = pieces.size();
+        int64_t start = 0;
+        bool ok = true;
+        while (start < wlen) {
+          int64_t end = wlen;
+          int32_t id = -1;
+          for (; end > start; --end) {
+            probe.assign(wp + start, (size_t)(end - start));
+            const auto& m = start ? H->cont : H->root;
+            auto it = m.find(probe);
+            if (it != m.end()) { id = it->second; break; }
+          }
+          if (id < 0) { ok = false; break; }
+          pieces.push_back(id);
+          start = end;
+        }
+        if (!ok) {
+          pieces.resize(mark);
+          pieces.push_back(unk_id);
+        }
+      }
+      if ((int32_t)pieces.size() > cap) pieces.resize((size_t)cap);
+      int32_t* ids = ids_out + t * max_len;
+      int32_t* mask = mask_out + t * max_len;
+      int32_t pos = 0;
+      ids[pos++] = cls_id;
+      for (int32_t p : pieces) ids[pos++] = p;
+      ids[pos++] = sep_id;
+      for (int32_t j = pos; j < max_len; ++j) ids[j] = pad_id;
+      for (int32_t j = 0; j < max_len; ++j) mask[j] = j < pos ? 1 : 0;
     }
   });
 }

@@ -18,6 +18,18 @@ from .build import load_library
 _N_THREADS = max(1, min(8, os.cpu_count() or 1))
 
 
+def _pack_strings(chunks) -> Tuple[np.ndarray, np.ndarray]:
+    """(byte buffer, int64 offsets[n+1]) for a list of byte strings — the
+    flat layout every native string-consuming entry point takes. The buffer
+    is 1 dummy byte when empty (ctypes needs a valid pointer)."""
+    offsets = np.zeros(len(chunks) + 1, np.int64)
+    if chunks:
+        np.cumsum([len(b) for b in chunks], out=offsets[1:])
+    blob = b"".join(chunks)
+    buf = np.frombuffer(blob, np.uint8) if blob else np.zeros(1, np.uint8)
+    return buf, offsets
+
+
 def decode_cifar10_bin(
     records: np.ndarray, mean: float = 0.5, std: float = 0.5
 ) -> Tuple[np.ndarray, np.ndarray]:
@@ -80,11 +92,7 @@ def tokenize_hash(texts, vocab_size: int, max_len: int) -> Optional[dict]:
     if lib is None:
         return None
     enc = [" ".join(t.lower().split()).encode("utf-8") for t in texts]
-    offsets = np.zeros(len(enc) + 1, np.int64)
-    if enc:
-        np.cumsum([len(b) for b in enc], out=offsets[1:])
-    blob = b"".join(enc)
-    buf = np.frombuffer(blob, np.uint8) if blob else np.zeros(1, np.uint8)
+    buf, offsets = _pack_strings(enc)
     ids = np.zeros((len(enc), max_len), np.int32)
     mask = np.zeros((len(enc), max_len), np.int32)
     if enc:
@@ -93,6 +101,71 @@ def tokenize_hash(texts, vocab_size: int, max_len: int) -> Optional[dict]:
             max_len, _N_THREADS, ids.ctypes.data, mask.ctypes.data,
         )
     return {"input_ids": ids, "attention_mask": mask}
+
+
+class NativeWordPiece:
+    """Native greedy longest-match WordPiece matcher over a built vocab
+    hash table (``data.wordpiece.WordPieceTokenizer``'s hot loop in
+    multithreaded C++). The Unicode normalization that PRODUCES the words
+    stays in Python (``WordPieceTokenizer.basic_tokenize``); this matches
+    pre-normalized words against the vocab. ``None``-returning factory when
+    the native library is unavailable."""
+
+    def __init__(self, lib, handle):
+        import weakref
+
+        self._lib = lib
+        self._handle = handle
+        # free the C-side table when the Python object dies
+        self._finalizer = weakref.finalize(
+            self, lib.ndp_wordpiece_free, handle
+        )
+
+    @classmethod
+    def build(cls, vocab_tokens) -> Optional["NativeWordPiece"]:
+        """``vocab_tokens``: token strings in id order (line order)."""
+        lib = load_library()
+        if lib is None:
+            return None
+        buf, offsets = _pack_strings([t.encode("utf-8") for t in vocab_tokens])
+        handle = lib.ndp_wordpiece_build(
+            buf.ctypes.data, offsets.ctypes.data, len(vocab_tokens)
+        )
+        return cls(lib, handle) if handle else None
+
+    def encode(
+        self,
+        words_per_text,
+        unk_id: int,
+        cls_id: int,
+        sep_id: int,
+        pad_id: int,
+        max_len: int,
+        max_word_chars: int = 100,
+    ) -> dict:
+        """HF-style (input_ids, attention_mask) for pre-normalized words.
+        Words over ``max_word_chars`` become a lone 0xff byte — invalid
+        UTF-8, never in a vocab — so the C side's no-tiling rule emits the
+        same whole-word [UNK] the Python matcher does."""
+        flat = []
+        counts = np.zeros(len(words_per_text), np.int64)
+        for i, words in enumerate(words_per_text):
+            counts[i] = len(words)
+            flat += [
+                w.encode("utf-8") if len(w) <= max_word_chars else b"\xff"
+                for w in words
+            ]
+        buf, offsets = _pack_strings(flat)
+        n = len(words_per_text)
+        ids = np.zeros((n, max_len), np.int32)
+        mask = np.zeros((n, max_len), np.int32)
+        if n:
+            self._lib.ndp_wordpiece_encode(
+                self._handle, buf.ctypes.data, offsets.ctypes.data,
+                counts.ctypes.data, n, unk_id, cls_id, sep_id, pad_id,
+                max_len, _N_THREADS, ids.ctypes.data, mask.ctypes.data,
+            )
+        return {"input_ids": ids, "attention_mask": mask}
 
 
 class NativeBatchLoader:

@@ -145,3 +145,47 @@ def test_tokenize_hash_fallback(monkeypatch):
     assert out["input_ids"][0, 0] == 1 and out["attention_mask"][0].sum() == 4
     monkeypatch.setattr(build, "_lib", None)
     monkeypatch.setattr(build, "_load_attempted", False)
+
+
+def test_native_wordpiece_parity(tmp_path):
+    """Native greedy matcher ≡ the Python WordPiece oracle token-for-token,
+    across multi-piece words, greedy ties, [UNK] whole words, over-long
+    words, unicode (the matcher is byte-level; probes only succeed on UTF-8
+    boundaries), empties, and truncation."""
+    from network_distributed_pytorch_tpu.data.wordpiece import WordPieceTokenizer
+    from network_distributed_pytorch_tpu.native.build import native_available
+
+    if not native_available():
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+
+    vocab = [
+        "[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "movie", "un", "##believ",
+        "##able", "unbeliev", "watch", "##ed", "!", ",", "café", "ca",
+        "##fé", "电", "影", "a", "##b", "##c", "abc",
+    ]
+    vf = tmp_path / "vocab.txt"
+    vf.write_text("\n".join(vocab) + "\n", encoding="utf-8")
+    tok = WordPieceTokenizer(str(vf), max_len=16)
+    texts = [
+        "the movie was unbelievable!",   # multi-piece + whole-word [UNK]
+        "watched, watch abc ab",          # greedy longest-match (abc whole)
+        "café 电影 cafe",                  # unicode pieces + CJK + [UNK]
+        "",                               # empty row
+        "x" * 500,                        # over the 100-char cap → [UNK]
+        "the " * 50,                      # truncation past max_len
+    ]
+    words = [tok.basic_tokenize(t) for t in texts]
+    ref = tok.python_encode(words)
+    native = tok._native_matcher()
+    assert native is not None
+    out = native.encode(
+        words, tok.unk_id, tok.cls_id, tok.sep_id, tok.pad_id, tok.max_len
+    )
+    np.testing.assert_array_equal(out["input_ids"], ref["input_ids"])
+    np.testing.assert_array_equal(out["attention_mask"], ref["attention_mask"])
+    # front door selects the native path and agrees too
+    np.testing.assert_array_equal(
+        tok(texts)["input_ids"], ref["input_ids"]
+    )

@@ -1,0 +1,115 @@
+"""First-party WordPiece vs HF ``DistilBertTokenizerFast`` — token-for-token
+parity on a shared ``vocab.txt`` (round-2 verdict #5: with this proven, real
+``distilbert-base-uncased`` tokenization needs only the vocab file on disk,
+no ``transformers`` at runtime). The HF fast tokenizer is constructed from
+the SAME local vocab file (no download), configured exactly as the reference
+uses it (``ddp_powersgd_distillBERT_IMDb/ddp_init.py:74-77``: uncased,
+truncation+padding)."""
+
+import numpy as np
+import pytest
+
+from network_distributed_pytorch_tpu.data import WordPieceTokenizer, prepare_imdb
+from network_distributed_pytorch_tpu.data.wordpiece import load_vocab
+
+transformers = pytest.importorskip("transformers")
+
+# [PAD]/[UNK]/[CLS]/[SEP]/[MASK] first (ids 0-4), then whole words and
+# ##-continuations exercising every matcher path: multi-piece words, greedy
+# longest-match ties, punctuation, digits, accent-folded forms, CJK.
+VOCAB = [
+    "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+    "the", "movie", "was", "great", "terrible", "un", "##believ", "##able",
+    "##believable", "unbeliev", "act", "##ing", "!", ",", ".", "?", "'",
+    "##s", "it", "good", "bad", "really", "re", "##ally", "café", "cafe",
+    "##fe", "ca", "2", "##0", "##2", "##4", "in", "20", "##24", "watch",
+    "##ed", "watched", "-", "co", "##-", "##op", "电", "影", "a", "an",
+    "##n", "hyphen", "##ated",
+]
+
+TEXTS = [
+    "The movie was great!",
+    "Unbelievable acting, really.",
+    "It was TERRIBLE?",
+    "café cafe CAFÉ",                      # accent stripping + casing
+    "watched in 2024",                     # digit pieces
+    "co-op hyphenated-words, it's good",   # punctuation splitting
+    "电影 was good",                        # CJK spacing
+    "zzzzqqqq unknownword the",            # whole-word [UNK]
+    "",                                    # empty text → [CLS] [SEP] only
+    "the " * 300,                          # truncation past max_len
+]
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("wp") / "vocab.txt"
+    p.write_text("\n".join(VOCAB) + "\n", encoding="utf-8")
+    return str(p)
+
+
+@pytest.fixture(scope="module")
+def hf_tok(vocab_file):
+    return transformers.DistilBertTokenizerFast(
+        vocab_file=vocab_file, do_lower_case=True
+    )
+
+
+def test_vocab_roundtrip(vocab_file):
+    vocab = load_vocab(vocab_file)
+    assert vocab["[PAD]"] == 0 and vocab["[CLS]"] == 2
+    assert len(vocab) == len(VOCAB)
+
+
+@pytest.mark.parametrize("max_len", [16, 64])
+def test_parity_with_hf_fast(vocab_file, hf_tok, max_len):
+    ours = WordPieceTokenizer(vocab_file, max_len=max_len)
+    enc = ours(TEXTS)
+    ref = hf_tok(
+        TEXTS, truncation=True, padding="max_length", max_length=max_len
+    )
+    np.testing.assert_array_equal(
+        enc["input_ids"], np.asarray(ref["input_ids"], np.int32)
+    )
+    np.testing.assert_array_equal(
+        enc["attention_mask"], np.asarray(ref["attention_mask"], np.int32)
+    )
+
+
+def test_piece_level_parity(vocab_file, hf_tok):
+    ours = WordPieceTokenizer(vocab_file)
+    for text in TEXTS:
+        assert ours.tokenize(text) == hf_tok.tokenize(text), text
+
+
+def test_greedy_longest_match(vocab_file):
+    tok = WordPieceTokenizer(vocab_file)
+    # "unbelievable" must take the LONGEST first piece ("unbeliev", not "un")
+    assert tok.wordpiece("unbelievable") == ["unbeliev", "##able"]
+    # single char falls through to [UNK] when absent
+    assert tok.wordpiece("q") == ["[UNK]"]
+    assert tok.wordpiece("x" * 200) == ["[UNK]"]  # over the 100-char cap
+
+
+def test_static_shapes_and_specials(vocab_file):
+    tok = WordPieceTokenizer(vocab_file, max_len=8)
+    enc = tok(["", "the movie was great ! ! ! ! ! !"])
+    assert enc["input_ids"].shape == (2, 8)
+    assert enc["input_ids"][0, 0] == tok.cls_id
+    assert enc["input_ids"][0, 1] == tok.sep_id
+    assert enc["input_ids"][0, 2] == tok.pad_id
+    assert enc["input_ids"][1, -1] == tok.sep_id  # truncated row still ends [SEP]
+    assert enc["attention_mask"].sum() == 2 + 8
+
+
+def test_prepare_imdb_picks_up_vocab_txt(tmp_path):
+    """A vocab.txt beside the dataset dir selects WordPiece as the default
+    tokenizer (the drop-files-on-disk parity path, data/imdb.py)."""
+    (tmp_path / "vocab.txt").write_text("\n".join(VOCAB) + "\n", encoding="utf-8")
+    train, val, is_real = prepare_imdb(
+        data_dir=str(tmp_path), max_len=32, synthetic_n=8
+    )
+    assert not is_real  # synthetic texts (no train/ dir) but real WordPiece ids
+    assert train["input_ids"].shape[1] == 32
+    # every row starts with [CLS]=2 — proves the WordPiece path was taken
+    assert (train["input_ids"][:, 0] == 2).all()
