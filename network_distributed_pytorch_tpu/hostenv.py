@@ -1,0 +1,47 @@
+"""Host-platform pinning, importable BEFORE jax.
+
+Every CPU-mesh entry point (the test conftest, the multi-process rendezvous
+workers, the driver's multichip dryrun, study scripts) needs the same
+pre-import dance: ``JAX_PLATFORMS=cpu`` plus an
+``--xla_force_host_platform_device_count`` flag, applied before jax's first
+backend init. This module deliberately imports no jax (and the package
+``__init__`` imports nothing), so it is safe at the very top of any script.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Optional
+
+_COUNT_FLAG = r"--xla_force_host_platform_device_count=\d+\s*"
+
+
+def force_cpu_devices(
+    n: Optional[int] = 8,
+    replace: bool = True,
+    drop_tpu_tunnel: bool = False,
+) -> None:
+    """Pin jax to the host (CPU) platform with ``n`` virtual devices.
+
+    ``n=None`` REMOVES any device-count flag (one real device per process —
+    the multi-process rendezvous world). ``replace=False`` keeps a
+    pre-existing count flag (so a caller's own ``XLA_FLAGS`` wins).
+    ``drop_tpu_tunnel`` also forgets the axon TPU pool env so a subprocess
+    can never claim the chip. If jax is already imported, the platform
+    config is updated directly too (the env var alone would be too late).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if drop_tpu_tunnel:
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = os.environ.get("XLA_FLAGS", "")
+    had_count = "xla_force_host_platform_device_count" in flags
+    if n is None:
+        flags = re.sub(_COUNT_FLAG, "", flags)
+    elif replace or not had_count:
+        flags = re.sub(_COUNT_FLAG, "", flags).strip()
+        flags += f" --xla_force_host_platform_device_count={n}"
+    os.environ["XLA_FLAGS"] = flags.strip()
+    if "jax" in sys.modules:
+        sys.modules["jax"].config.update("jax_platforms", "cpu")

@@ -12,16 +12,15 @@ This must run before jax initializes its backends, hence module-import time.
 import os
 
 # Force CPU even when the environment pre-sets a TPU platform: tests exercise
-# the distributed code path on 8 virtual devices, which needs the host platform.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# the distributed code path on 8 virtual devices, which needs the host
+# platform. replace=False keeps a user-supplied device-count flag; the
+# helper also covers the jax-already-imported case via jax.config.
+from network_distributed_pytorch_tpu.hostenv import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(8, replace=False)
 
 import jax  # noqa: E402
 
-# jax snapshots JAX_PLATFORMS at import time; if anything imported jax before
-# this conftest ran, the env var alone is too late — set the config directly.
 jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: the suite is hundreds of small XLA compiles;

@@ -14,19 +14,16 @@ Usage: python multiprocess_worker.py <coordinator_port> <process_id> <num_proces
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 # must happen before jax import: 1 CPU device per process, no TPU plugin
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["XLA_FLAGS"] = " ".join(
-    f for f in os.environ.get("XLA_FLAGS", "").split()
-    if "xla_force_host_platform_device_count" not in f
-)
+from network_distributed_pytorch_tpu.hostenv import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(n=None, drop_tpu_tunnel=True)
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 from jax.sharding import PartitionSpec as P  # noqa: E402
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from network_distributed_pytorch_tpu.data.multihost import (  # noqa: E402
     global_batch_from_local,
