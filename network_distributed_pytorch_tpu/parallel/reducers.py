@@ -21,7 +21,14 @@ Everything traces into one XLA computation under ``jit``/``shard_map``:
 - The reference's async rank-1 allreduce overlapped with orthogonalization
   (``reducer.py:131-137``) needs no handles here: the rank-1 ``pmean`` is
   issued in trace order between the P collective and the Gram-Schmidt, and
-  XLA's latency-hiding scheduler overlaps it with the compute.
+  the compiler owns the schedule. What the compiled v5e executable actually
+  does (measured, ``OVERLAP.json``) is stronger than hiding the collective:
+  XLA's all-reduce **combiner merges the rank-1 payload into the Q
+  all-reduce** — the separate collective the reference could only overlap
+  is eliminated outright (4 logical → 2 compiled collectives). When the
+  latency-hiding scheduler additionally emits async ``*-start``/``*-done``
+  pairs (``bench.py`` compiles with the async-collective flags), the
+  compute scheduled inside those windows is counted in the same artifact.
 - The shared-seed no-communication Q init (``reducer.py:36-41``: every worker
   seeds the same RNG, so Q is identical everywhere for free) becomes "same
   PRNGKey on every worker" — identical by construction.

@@ -1,0 +1,303 @@
+"""PowerSGD-vs-exact ACCURACY equivalence, end-to-end (round-2 verdict #4).
+
+The reference's core claim is that rank-r PowerSGD with error feedback
+matches exact-allreduce training accuracy at a fraction of the gradient
+bytes (``ddp_powersgd_guide_cifar10/reducer.py:43-170``; the repo never
+demonstrates it — no eval anywhere, SURVEY §4). Real CIFAR-10/aclImdb are
+environmentally blocked (zero egress), so this study runs the equivalence
+the sandbox allows: the SAME class-separable synthetic set, the SAME model
+and schedule, trained to eval-accuracy plateau under (a) exact allreduce
+and (b) PowerSGD, on a REAL 8-worker data-parallel mesh (virtual CPU
+devices — the same `psum` code path as ICI).
+
+Outputs ``artifacts/ACCURACY_STUDY.json``: per-epoch eval accuracy for both
+arms, final/best accuracy delta, and measured bytes-on-wire per step with
+the compression ratio.
+
+Usage: python scripts/accuracy_study.py [--task cifar|imdb|both]
+       [--max-epochs N] [--patience K]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# the study runs the real collective path on 8 virtual devices; set BEFORE
+# the first jax import (ACCURACY_STUDY_PLATFORM=tpu runs on the chip instead)
+if os.environ.get("ACCURACY_STUDY_PLATFORM", "cpu") == "cpu":
+    from network_distributed_pytorch_tpu.hostenv import force_cpu_devices
+
+    force_cpu_devices(8, replace=False)
+
+OUT = os.path.join(REPO, "artifacts", "ACCURACY_STUDY.json")
+
+
+def run_to_plateau(
+    arm_name,
+    step,
+    state,
+    epoch_batches,
+    evaluate,
+    max_epochs: int,
+    patience: int,
+    min_delta: float = 0.0025,
+):
+    """Train epoch-by-epoch until eval accuracy stops improving by
+    ``min_delta`` for ``patience`` consecutive epochs. Returns the arm
+    record (accuracy curve, best/final accuracy, measured wire cost)."""
+    from network_distributed_pytorch_tpu.experiments.common import train_loop
+
+    curve = []
+    best, mark, mark_epoch, total_steps = 0.0, 0.0, -1, 0
+    plateaued = False
+    t0 = time.perf_counter()
+    for epoch in range(max_epochs):
+        state, logger = train_loop(
+            step, state, lambda _e: epoch_batches(epoch), 1, log_every=0
+        )
+        total_steps += logger.summary()["steps"]
+        acc = evaluate(step, state)
+        curve.append(round(acc, 4))
+        best = max(best, acc)  # reported best: unconditional
+        if acc > mark + min_delta:  # patience mark: meaningful jumps only
+            mark, mark_epoch = acc, epoch
+        print(
+            f"# {arm_name} epoch {epoch}: eval_acc {acc:.4f} "
+            f"(best {best:.4f}, last improvement @ {mark_epoch})",
+            flush=True,
+        )
+        if epoch - mark_epoch >= patience:
+            plateaued = True
+            break
+    return {
+        "eval_accuracy_curve": curve,
+        "final_accuracy": curve[-1],
+        "best_accuracy": round(best, 4),
+        "epochs_run": len(curve),
+        "plateaued": plateaued,
+        "bits_per_step": step.bits_per_step,
+        "bytes_per_step": step.bits_per_step // 8,
+        "total_steps": total_steps,
+        "total_mb_on_wire": round(step.bits_per_step * total_steps / 8e6, 2),
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+
+
+def cifar_study(max_epochs: int, patience: int) -> dict:
+    """ResNet-18 on class-blob CIFAR: exact-SGD (C2 semantics) vs PowerSGD
+    r=4 EF-momentum (C3 semantics), same data/model/lr/schedule."""
+    import jax
+    import jax.numpy as jnp
+
+    from network_distributed_pytorch_tpu.data import (
+        iterate_batches,
+        synthetic_cifar10,
+    )
+    from network_distributed_pytorch_tpu.experiments.common import (
+        evaluate_image_classifier,
+        image_classifier_loss,
+    )
+    from network_distributed_pytorch_tpu.models import resnet18
+    from network_distributed_pytorch_tpu.parallel import (
+        ExactReducer,
+        PowerSGDReducer,
+        make_mesh,
+    )
+    from network_distributed_pytorch_tpu.parallel.trainer import make_train_step
+
+    # ONE synthetic draw, split train/test: identical class means, disjoint
+    # noise samples (a held-out set synthetic_cifar10 alone doesn't give)
+    images, labels = synthetic_cifar10(5120, seed=0)
+    train_x, train_y = images[:4096], labels[:4096]
+    test_x, test_y = images[4096:], labels[4096:]
+
+    mesh = make_mesh()
+    model = resnet18(num_classes=10, norm="batch", stem="cifar", width=16)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=True
+    )
+    loss_fn = image_classifier_loss(model, has_batch_stats=True)
+    batch_size, lr = 256, 0.02
+
+    def epoch_batches(epoch):
+        return iterate_batches(
+            [train_x, train_y], batch_size, shuffle=True, seed=1234 + epoch
+        )
+
+    def evaluate(step, state):
+        return evaluate_image_classifier(
+            model,
+            state.params,
+            step.eval_model_state(state)["batch_stats"],
+            test_x,
+            test_y,
+        )
+
+    arms = {}
+    for arm, (reducer, algorithm) in {
+        "exact": (ExactReducer(), "sgd"),
+        "powersgd_r4": (
+            PowerSGDReducer(random_seed=714, compression_rank=4, matricize="last"),
+            "ef_momentum",
+        ),
+    }.items():
+        step = make_train_step(
+            loss_fn, reducer, variables["params"], learning_rate=lr,
+            momentum=0.9, algorithm=algorithm, mesh=mesh,
+            # both arms init from the SAME variables; donation would delete
+            # the shared init buffers under the second arm's feet
+            donate_state=False,
+        )
+        state = step.init_state(
+            variables["params"],
+            model_state={"batch_stats": variables["batch_stats"]},
+        )
+        arms[arm] = run_to_plateau(
+            f"cifar/{arm}", step, state, epoch_batches, evaluate,
+            max_epochs, patience,
+        )
+
+    exact, psgd = arms["exact"], arms["powersgd_r4"]
+    return {
+        "task": "cifar10_synthetic",
+        "model": "resnet18_w16",
+        "workers": mesh.size,
+        "global_batch": batch_size,
+        "lr": lr,
+        "arms": arms,
+        "accuracy_delta_pts": round(
+            100 * (exact["best_accuracy"] - psgd["best_accuracy"]), 2
+        ),
+        "gradient_bytes_ratio": round(
+            exact["bytes_per_step"] / psgd["bytes_per_step"], 1
+        ),
+    }
+
+
+def imdb_study(max_epochs: int, patience: int) -> dict:
+    """DistilBERT-tiny on class-separable synthetic reviews: exact vs
+    PowerSGD r=16 (the reference's IMDb rank, ddp_init.py:38)."""
+    import jax
+    import jax.numpy as jnp
+
+    from network_distributed_pytorch_tpu.data import iterate_batches, prepare_imdb
+    from network_distributed_pytorch_tpu.experiments.common import (
+        evaluate_text_classifier,
+    )
+    from network_distributed_pytorch_tpu.models import distilbert_tiny
+    from network_distributed_pytorch_tpu.parallel import (
+        ExactReducer,
+        PowerSGDReducer,
+        make_mesh,
+    )
+    from network_distributed_pytorch_tpu.parallel.trainer import (
+        make_train_step,
+        stateless_loss,
+    )
+
+    from network_distributed_pytorch_tpu.utils.losses import cross_entropy_loss
+
+    # distilbert_tiny's fixed vocab/positions (vocab 1024, max_len 64)
+    train, val, _ = prepare_imdb(max_len=64, synthetic_n=2048, vocab_size=1024)
+    mesh = make_mesh()
+    model = distilbert_tiny(num_labels=2)
+    sample = (
+        jnp.zeros((1, 64), jnp.int32),
+        jnp.ones((1, 64), jnp.int32),
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), *sample, deterministic=True
+    )["params"]
+
+    def loss(p, batch):
+        ids, mask, y = batch
+        logits = model.apply({"params": p}, ids, mask, deterministic=True)
+        return cross_entropy_loss(logits, y)
+
+    batch_size, lr = 128, 0.005
+
+    def epoch_batches(epoch):
+        return iterate_batches(
+            [train["input_ids"], train["attention_mask"], train["labels"]],
+            batch_size, shuffle=True, seed=1234 + epoch,
+        )
+
+    def evaluate(step, state):
+        return evaluate_text_classifier(model, state.params, val)
+
+    arms = {}
+    for arm, (reducer, algorithm) in {
+        "exact": (ExactReducer(), "sgd"),
+        "powersgd_r16": (
+            PowerSGDReducer(random_seed=714, compression_rank=16, matricize="last"),
+            "ef_momentum",
+        ),
+    }.items():
+        step = make_train_step(
+            stateless_loss(loss), reducer, params, learning_rate=lr,
+            momentum=0.9, algorithm=algorithm, mesh=mesh,
+            donate_state=False,  # shared init params across arms (see cifar)
+        )
+        state = step.init_state(params)
+        arms[arm] = run_to_plateau(
+            f"imdb/{arm}", step, state, epoch_batches, evaluate,
+            max_epochs, patience,
+        )
+
+    exact, psgd = arms["exact"], arms["powersgd_r16"]
+    return {
+        "task": "imdb_synthetic",
+        "model": "distilbert_tiny",
+        "workers": mesh.size,
+        "global_batch": batch_size,
+        "lr": lr,
+        "arms": arms,
+        "accuracy_delta_pts": round(
+            100 * (exact["best_accuracy"] - psgd["best_accuracy"]), 2
+        ),
+        "gradient_bytes_ratio": round(
+            exact["bytes_per_step"] / psgd["bytes_per_step"], 1
+        ),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--task", default="both", choices=["cifar", "imdb", "both"])
+    ap.add_argument("--max-epochs", type=int, default=30)
+    ap.add_argument("--patience", type=int, default=5)
+    args = ap.parse_args()
+
+    import jax
+
+    out = {
+        "device": getattr(
+            jax.devices()[0], "device_kind", jax.devices()[0].platform
+        ),
+        "n_devices": len(jax.devices()),
+    }
+    if args.task in ("cifar", "both"):
+        out["cifar"] = cifar_study(args.max_epochs, args.patience)
+        _save(out)
+    if args.task in ("imdb", "both"):
+        out["imdb"] = imdb_study(args.max_epochs, args.patience)
+        _save(out)
+    print(json.dumps({k: v for k, v in out.items() if k in ("cifar", "imdb") and isinstance(v, dict) and v.get("accuracy_delta_pts") is not None}, default=str)[:400])
+    return 0
+
+
+def _save(out: dict) -> None:
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

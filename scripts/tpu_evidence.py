@@ -102,10 +102,14 @@ def main() -> int:
     try:
         devices = _probe_devices(args.init_timeout)
     except BaseException as e:  # noqa: BLE001
-        evidence["error"] = f"backend init failed: {type(e).__name__}: {e}"[:500]
-        _save()
-        print(json.dumps(evidence), flush=True)
-        return 0
+        # do NOT overwrite the committed artifact with an error-only stub —
+        # a wedged tunnel must not destroy previously-recorded evidence
+        err = {"error": f"backend init failed: {type(e).__name__}: {e}"[:500]}
+        os.makedirs(ARTIFACTS, exist_ok=True)
+        with open(os.path.join(ARTIFACTS, "TPU_EVIDENCE_init_error.json"), "w") as f:
+            json.dump(err, f, indent=1)
+        print(json.dumps(err), flush=True)
+        return 1
     evidence["device"] = getattr(devices[0], "device_kind", devices[0].platform)
     evidence["n_devices"] = len(devices)
     evidence["steps"] = args.steps
@@ -117,40 +121,64 @@ def main() -> int:
     )
     from network_distributed_pytorch_tpu.utils.config import ExperimentConfig
 
-    def cifar_full():
+    def cifar_full(dtype: str):
         # the reference's flagship config — ResNet-152, global batch 512,
-        # r=4, EF-SGD lr .001 λ=.9 (ddp_powersgd_guide_cifar10/ddp_init.py)
-        cfg = ExperimentConfig(
-            training_epochs=1, global_batch_size=512, learning_rate=0.001,
-            reducer_rank=4, log_every=0,
-        )
-        out = powersgd_cifar10.run(
-            cfg, preset="full", max_steps_per_epoch=args.steps
-        )
-        return {
-            "experiment": out["experiment"],
-            "losses_first_last": [out.get("first_loss"), out.get("final_loss")],
-            "raw": {
-                k: v
-                for k, v in out.items()
-                if isinstance(v, (int, float, str, bool, list))
-            },
-        }
+        # r=4, EF-SGD lr .001 λ=.9 (ddp_powersgd_guide_cifar10/ddp_init.py);
+        # dtype="bfloat16" is the same workload on the MXU's native compute
+        # type (round-2 verdict #2: prove the perf story at full preset)
+        def fn():
+            cfg = ExperimentConfig(
+                training_epochs=1, global_batch_size=512, learning_rate=0.001,
+                reducer_rank=4, log_every=0, compute_dtype=dtype,
+            )
+            out = powersgd_cifar10.run(
+                cfg, preset="full", max_steps_per_epoch=args.steps
+            )
+            return {
+                "experiment": out["experiment"],
+                "compute_dtype": dtype,
+                "losses_first_last": [out.get("first_loss"), out.get("final_loss")],
+                "raw": {
+                    k: v
+                    for k, v in out.items()
+                    if isinstance(v, (int, float, str, bool, list))
+                },
+            }
 
-    def imdb_full():
-        cfg = ExperimentConfig(
-            training_epochs=1, learning_rate=5e-5, reducer_rank=16,
-            global_batch_size=0, log_every=0,
+        return fn
+
+    def imdb_full(dtype: str):
+        def fn():
+            cfg = ExperimentConfig(
+                training_epochs=1, learning_rate=5e-5, reducer_rank=16,
+                global_batch_size=0, log_every=0, compute_dtype=dtype,
+            )
+            out = powersgd_imdb.run(
+                cfg, preset="full", max_steps_per_epoch=args.steps
+            )
+            return {
+                "experiment": out["experiment"],
+                "compute_dtype": dtype,
+                "raw": {
+                    k: v
+                    for k, v in out.items()
+                    if isinstance(v, (int, float, str, bool, list))
+                },
+            }
+
+        return fn
+
+    def gpt_decode():
+        # KV-cache prefill + decode on the 124M GPT — the one entry point
+        # with no hardware record before round 3 (round-2 verdict #7)
+        from network_distributed_pytorch_tpu.experiments import gpt_generate
+
+        cfg = ExperimentConfig(compute_dtype="bfloat16")
+        out = gpt_generate.run(
+            cfg, preset="full", batch=8, prompt_len=128, max_new_tokens=128,
+            vocab=50257,  # the true GPT-2-small shape (124M)
         )
-        out = powersgd_imdb.run(cfg, preset="full", max_steps_per_epoch=args.steps)
-        return {
-            "experiment": out["experiment"],
-            "raw": {
-                k: v
-                for k, v in out.items()
-                if isinstance(v, (int, float, str, bool, list))
-            },
-        }
+        return {k: v for k, v in out.items() if isinstance(v, (int, float, str, bool, list, type(None)))}
 
     def profile_trace():
         # a short profiler capture of the bench flagship's PowerSGD step
@@ -199,9 +227,26 @@ def main() -> int:
             files += [os.path.join(os.path.relpath(root, ARTIFACTS), n) for n in names]
         return {"trace_dir": "artifacts/tpu_trace", "trace_files": files[:20]}
 
-    _phase("powersgd_cifar10_full", cifar_full)
-    _phase("powersgd_imdb_full", imdb_full)
+    # bf16 first: if the tunnel dies mid-run, the NEW evidence (round-2
+    # verdict #2/#7) is already on disk; fp32 re-runs give the same-session
+    # fp32-vs-bf16 ratio and land last
+    _phase("powersgd_cifar10_full_bf16", cifar_full("bfloat16"))
+    _phase("powersgd_imdb_full_bf16", imdb_full("bfloat16"))
+    _phase("gpt_generate_124m_bf16", gpt_decode)
+    _phase("powersgd_cifar10_full_fp32", cifar_full("float32"))
+    _phase("powersgd_imdb_full_fp32", imdb_full("float32"))
     _phase("profile_trace", profile_trace)
+
+    for pair in (
+        ("powersgd_cifar10_full_bf16", "powersgd_cifar10_full_fp32"),
+        ("powersgd_imdb_full_bf16", "powersgd_imdb_full_fp32"),
+    ):
+        bf, fp = (evidence["phases"].get(k, {}) for k in pair)
+        tb = (bf.get("raw") or {}).get("mean_step_time_s")
+        tf = (fp.get("raw") or {}).get("mean_step_time_s")
+        if tb and tf:
+            evidence.setdefault("fp32_over_bf16_step_ratio", {})[pair[0]] = round(tf / tb, 2)
+    _save()
 
     print(json.dumps({k: evidence["phases"][k].get("ok") for k in evidence["phases"]}), flush=True)
     return 0
