@@ -276,22 +276,22 @@ def _measure_gpt(results: dict) -> None:
     interpretable). Same honest methodology as the flagship: AOT-compiled
     executable, cost analysis of the exact program timed, fetch-to-observe
     timing. Best-effort — failures are recorded, never fatal."""
-    import jax
-    import jax.numpy as jnp
-
-    from network_distributed_pytorch_tpu.models import (
-        gpt_small,
-        gpt_tiny,
-        next_token_loss,
-    )
-    from network_distributed_pytorch_tpu.parallel import ExactReducer, make_mesh
-    from network_distributed_pytorch_tpu.parallel.trainer import (
-        make_train_step,
-        stateless_loss,
-    )
-    from network_distributed_pytorch_tpu.utils.timing import wait_result
-
     try:
+        import jax
+        import jax.numpy as jnp
+
+        from network_distributed_pytorch_tpu.models import (
+            gpt_small,
+            gpt_tiny,
+            next_token_loss,
+        )
+        from network_distributed_pytorch_tpu.parallel import ExactReducer, make_mesh
+        from network_distributed_pytorch_tpu.parallel.trainer import (
+            make_train_step,
+            stateless_loss,
+        )
+        from network_distributed_pytorch_tpu.utils.timing import wait_result
+
         small = results.get("preset") == "small"
         # full tier: the true GPT-2-small shape (50257 vocab, 124M params)
         seq_len, batch = (64, 8) if small else (1024, 8)

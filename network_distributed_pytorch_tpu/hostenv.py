@@ -22,6 +22,7 @@ def force_cpu_devices(
     n: Optional[int] = 8,
     replace: bool = True,
     drop_tpu_tunnel: bool = False,
+    collective_timeout_s: Optional[int] = None,
 ) -> None:
     """Pin jax to the host (CPU) platform with ``n`` virtual devices.
 
@@ -31,6 +32,14 @@ def force_cpu_devices(
     ``drop_tpu_tunnel`` also forgets the axon TPU pool env so a subprocess
     can never claim the chip. If jax is already imported, the platform
     config is updated directly too (the env var alone would be too late).
+
+    ``collective_timeout_s`` raises XLA:CPU's collective-rendezvous
+    warn/terminate deadlines (default 20 s/40 s). On a host with fewer
+    cores than virtual devices the per-device compute of one step runs
+    SERIALLY, so a heavy step can legitimately keep the last participant
+    thread away past 40 s and the default deadline kills the process
+    ("Expected N threads to join the rendezvous") — raise it for big-model
+    CPU-mesh runs.
     """
     os.environ["JAX_PLATFORMS"] = "cpu"
     if drop_tpu_tunnel:
@@ -42,6 +51,11 @@ def force_cpu_devices(
     elif replace or not had_count:
         flags = re.sub(_COUNT_FLAG, "", flags).strip()
         flags += f" --xla_force_host_platform_device_count={n}"
+    if collective_timeout_s is not None:
+        flags += (
+            f" --xla_cpu_collective_call_warn_stuck_timeout_seconds={collective_timeout_s}"
+            f" --xla_cpu_collective_call_terminate_timeout_seconds={2 * collective_timeout_s}"
+        )
     os.environ["XLA_FLAGS"] = flags.strip()
     if "jax" in sys.modules:
         sys.modules["jax"].config.update("jax_platforms", "cpu")

@@ -43,6 +43,10 @@ def restore_checkpoint(path: str, template: Any) -> Any:
     # arrays instead — uncommitted inputs let jit place each leaf on the
     # step's own sharding, exactly like the freshly-initialized state.
     restored = jax.device_get(restored)
+    return _rebuild_carry(template, restored)
+
+
+def _rebuild_carry(template: Any, restored: Any) -> Any:
     # orbax flattens NamedTuple carries (TrainState, DiLoCoState, ...) to
     # plain tuples; rebuild the carry type the step function expects
     if (
@@ -52,6 +56,31 @@ def restore_checkpoint(path: str, template: Any) -> Any:
     ):
         return type(template)(*restored)
     return restored
+
+
+def restore_checkpoint_sharded(path: str, template: Any) -> Any:
+    """Restore directly INTO the template's shardings — the pod-scale path.
+
+    :func:`restore_checkpoint` returns host (numpy) arrays so jit can place
+    them, which replicates the FULL state onto every host — fine at
+    single-host scale, wrong for pod FSDP/ZeRO state where each host should
+    only ever materialize its own shards. Here ``template`` is the live
+    initial state (or any pytree of ``jax.Array``/``ShapeDtypeStruct``
+    leaves carrying ``.sharding``); orbax reads each leaf shard-by-shard
+    onto its target devices, so per-host memory is the SHARD size, not the
+    global size.
+    """
+    def _abstract(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x
+        return jax.ShapeDtypeStruct(
+            x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+        )
+
+    abstract = jax.tree_util.tree_map(_abstract, template)
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(os.path.abspath(path), abstract)
+    return _rebuild_carry(template, restored)
 
 
 def latest_step_path(root: str) -> Optional[str]:

@@ -34,7 +34,11 @@ sys.path.insert(0, REPO)
 if os.environ.get("ACCURACY_STUDY_PLATFORM", "cpu") == "cpu":
     from network_distributed_pytorch_tpu.hostenv import force_cpu_devices
 
-    force_cpu_devices(8, replace=False)
+    # big-model steps on few cores serialize the 8 per-device computes, so
+    # one step can exceed XLA:CPU's default 40 s collective-rendezvous kill
+    # deadline — raise it moderately (a genuinely-deadlocked run should
+    # still die fast enough to retry); correctness is unaffected
+    force_cpu_devices(8, replace=False, collective_timeout_s=120)
 
 OUT = os.path.join(REPO, "artifacts", "ACCURACY_STUDY.json")
 
@@ -60,7 +64,8 @@ def run_to_plateau(
     t0 = time.perf_counter()
     for epoch in range(max_epochs):
         state, logger = train_loop(
-            step, state, lambda _e: epoch_batches(epoch), 1, log_every=0
+            step, state, lambda _e: epoch_batches(epoch), 1, log_every=0,
+            prefetch=0,  # no async device_put threads (see main(): 1-core host)
         )
         total_steps += logger.summary()["steps"]
         acc = evaluate(step, state)
@@ -277,6 +282,14 @@ def main() -> int:
 
     import jax
 
+    if jax.default_backend() == "cpu" or os.environ.get("JAX_PLATFORMS") == "cpu":
+        # on a host with fewer cores than virtual devices, async dispatch +
+        # prefetch threads can exhaust the execution pool while a collective
+        # program waits for all 8 replica threads — observed as a zero-CPU
+        # all-reduce rendezvous deadlock. Synchronous dispatch serializes
+        # the pipeline and removes the hazard (slower, but it finishes).
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+
     out = {
         "device": getattr(
             jax.devices()[0], "device_kind", jax.devices()[0].platform
@@ -289,7 +302,25 @@ def main() -> int:
     if args.task in ("imdb", "both"):
         out["imdb"] = imdb_study(args.max_epochs, args.patience)
         _save(out)
-    print(json.dumps({k: v for k, v in out.items() if k in ("cifar", "imdb") and isinstance(v, dict) and v.get("accuracy_delta_pts") is not None}, default=str)[:400])
+    # one slim machine-readable line (the full record is in the artifact)
+    print(
+        json.dumps(
+            {
+                task: {
+                    "accuracy_delta_pts": out[task]["accuracy_delta_pts"],
+                    "gradient_bytes_ratio": out[task]["gradient_bytes_ratio"],
+                    "exact_best": out[task]["arms"]["exact"]["best_accuracy"],
+                    "compressed_best": min(
+                        a["best_accuracy"]
+                        for k, a in out[task]["arms"].items()
+                        if k != "exact"
+                    ),
+                }
+                for task in ("cifar", "imdb")
+                if task in out
+            }
+        )
+    )
     return 0
 
 

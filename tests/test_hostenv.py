@@ -1,0 +1,80 @@
+"""hostenv.force_cpu_devices — pure env-var manipulation, no jax needed.
+Covers the four caller profiles: conftest (keep user flag), dryrun
+(replace), multiprocess worker (remove + drop tunnel), study (raise the
+collective-rendezvous deadlines)."""
+
+import importlib
+
+from network_distributed_pytorch_tpu import hostenv
+
+
+def _clean(monkeypatch):
+    monkeypatch.delenv("XLA_FLAGS", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+
+
+def test_sets_platform_and_count(monkeypatch):
+    _clean(monkeypatch)
+    import os
+
+    hostenv.force_cpu_devices(8)
+    assert os.environ["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=8" in os.environ["XLA_FLAGS"]
+    assert os.environ["PALLAS_AXON_POOL_IPS"] == "127.0.0.1"  # kept by default
+
+
+def test_replace_false_keeps_existing(monkeypatch):
+    _clean(monkeypatch)
+    import os
+
+    monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+    hostenv.force_cpu_devices(8, replace=False)
+    assert "count=4" in os.environ["XLA_FLAGS"]
+    assert "count=8" not in os.environ["XLA_FLAGS"]
+    hostenv.force_cpu_devices(8, replace=True)
+    assert "count=8" in os.environ["XLA_FLAGS"]
+    assert "count=4" not in os.environ["XLA_FLAGS"]
+
+
+def test_none_removes_count_and_drops_tunnel(monkeypatch):
+    _clean(monkeypatch)
+    import os
+
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--foo=1 --xla_force_host_platform_device_count=8 --bar=2"
+    )
+    hostenv.force_cpu_devices(n=None, drop_tpu_tunnel=True)
+    assert "device_count" not in os.environ["XLA_FLAGS"]
+    assert "--foo=1" in os.environ["XLA_FLAGS"]  # unrelated flags kept
+    assert "--bar=2" in os.environ["XLA_FLAGS"]
+    assert "PALLAS_AXON_POOL_IPS" not in os.environ
+
+
+def test_collective_timeout_flags(monkeypatch):
+    _clean(monkeypatch)
+    import os
+
+    hostenv.force_cpu_devices(8, collective_timeout_s=600)
+    flags = os.environ["XLA_FLAGS"]
+    assert "--xla_cpu_collective_call_warn_stuck_timeout_seconds=600" in flags
+    assert "--xla_cpu_collective_call_terminate_timeout_seconds=1200" in flags
+
+
+def test_updates_config_when_jax_imported(monkeypatch):
+    _clean(monkeypatch)
+    import jax  # the test suite has jax imported already
+
+    jax.config.update("jax_platforms", "cpu")  # conftest state
+    hostenv.force_cpu_devices(8)
+    assert jax.config.jax_platforms == "cpu"
+
+
+def test_module_importable_without_jax_side_effects():
+    """The module itself must not import jax (it runs pre-init)."""
+    src = importlib.util.find_spec(
+        "network_distributed_pytorch_tpu.hostenv"
+    ).origin
+    with open(src) as f:
+        text = f.read()
+    assert "import jax" not in text
