@@ -50,6 +50,17 @@ INIT_TIMEOUT_S = int(
 ) or _INIT_TIMEOUT_LADDER[
     min(int(os.environ.get(ATTEMPT_ENV, "1")) - 1, len(_INIT_TIMEOUT_LADDER) - 1)
 ]
+# total wall budget across the whole re-exec ladder: the driver must get
+# its one JSON line before ITS patience runs out, so once the ladder has
+# burned this much the next failure skips straight to the CPU fallback
+# instead of another long TPU attempt. First exec stamps the start time.
+TOTAL_DEADLINE_S = int(os.environ.get("BENCH_TOTAL_DEADLINE_S", "1500"))
+_START_ENV = "BENCH_START_TS"
+os.environ.setdefault(_START_ENV, str(int(time.time())))
+
+
+def _ladder_elapsed_s() -> float:
+    return time.time() - float(os.environ[_START_ENV])
 
 # Peak dense bf16 FLOP/s per chip by device_kind substring (public spec
 # sheets). Longest match wins ("v5 lite" before "v5").
@@ -273,78 +284,32 @@ def _measure_gpt(results: dict) -> None:
     workload where MFU is meaningful (CIFAR's 32×32 convs genuinely bound MXU
     utilization, so the flagship CIFAR MFU reads low by construction; a
     768-dim decoder at seq 1024 keeps the MXU fed and makes the number
-    interpretable). Same honest methodology as the flagship: AOT-compiled
-    executable, cost analysis of the exact program timed, fetch-to-observe
-    timing. Best-effort — failures are recorded, never fatal."""
+    interpretable). The measurement itself lives in
+    ``utils.benchmarks.time_gpt_train_step`` — the SAME scaffold
+    ``scripts/tpu_evidence.py`` uses, so the driver metric and the committed
+    hardware record share one methodology (AOT executable, cost analysis of
+    the exact program timed, fetch-to-observe timing). Best-effort —
+    failures are recorded, never fatal."""
     try:
         import jax
-        import jax.numpy as jnp
 
-        from network_distributed_pytorch_tpu.models import (
-            gpt_small,
-            gpt_tiny,
-            next_token_loss,
+        from network_distributed_pytorch_tpu.utils.benchmarks import (
+            time_gpt_train_step,
         )
-        from network_distributed_pytorch_tpu.parallel import ExactReducer, make_mesh
-        from network_distributed_pytorch_tpu.parallel.trainer import (
-            make_train_step,
-            stateless_loss,
-        )
-        from network_distributed_pytorch_tpu.utils.timing import wait_result
 
         small = results.get("preset") == "small"
-        # full tier: the true GPT-2-small shape (50257 vocab, 124M params)
-        seq_len, batch = (64, 8) if small else (1024, 8)
-        vocab = 128 if small else 50257
-        make = gpt_tiny if small else gpt_small
-        model = make(
-            vocab_size=vocab, max_position_embeddings=seq_len,
-            dtype=jnp.bfloat16, dropout=0.0,
+        gpt = time_gpt_train_step(
+            small=small,
+            seq_len=64 if small else 1024,
+            batch=8,
+            vocab=128 if small else 50257,
+            reps=2 if small else 10,
         )
-        params = model.init(
-            jax.random.PRNGKey(0), jnp.zeros((1, seq_len), jnp.int32)
-        )["params"]
-
-        def loss(p, b):
-            x, y = b
-            return next_token_loss(model.apply({"params": p}, x), y)
-
-        step = make_train_step(
-            stateless_loss(loss), ExactReducer(), params, learning_rate=1e-3,
-            momentum=0.9, algorithm="sgd", mesh=make_mesh(), donate_state=False,
-        )
-        state = step.init_state(params)
-        toks = jnp.broadcast_to(
-            jnp.arange(seq_len + 1, dtype=jnp.int32)[None, :] % vocab,
-            (batch, seq_len + 1),
-        )
-        batch_xy = (toks[:, :-1], toks[:, 1:])
-        compiled = step.fn.lower(state, batch_xy).compile()
-        flops = 0.0
-        try:
-            ca = compiled.cost_analysis()
-            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-            flops = float(ca.get("flops", 0.0))
-        except Exception:  # cost analysis is best-effort
-            pass
-        state, l = compiled(state, batch_xy)  # warmup
-        wait_result(l)
-        reps = 2 if small else 10
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            state, l = compiled(state, batch_xy)
-        wait_result(l)  # fetch-to-observe-completion, utils.timing
-        dt = (time.perf_counter() - t0) / reps
-        gpt = {
-            "model": "gpt_tiny" if small else "gpt2_small_124M",
-            "seq_len": seq_len,
-            "batch": batch,
-            "step_time_ms": round(1000.0 * dt, 3),
-            "tokens_per_sec": round(batch * seq_len / dt, 1),
-        }
+        flops = gpt.pop("flops_per_step", None)
         peak = _peak_flops(jax.devices()[0])
-        if flops > 0 and peak > 0:
-            gpt["mfu"] = round(flops / dt / peak, 4)
+        if flops and peak > 0:
+            gpt["mfu"] = round(flops / (gpt["step_time_ms"] / 1000.0) / peak, 4)
+            gpt["flops_per_step"] = flops
         results["gpt"] = gpt
     except Exception as e:  # noqa: BLE001 — evidence is best-effort
         results["gpt"] = {"error": f"{type(e).__name__}: {e}"[:300]}
@@ -482,6 +447,39 @@ def _overlap_evidence(results: dict, make_model, mesh) -> None:
         results["overlap"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
 
+def _artifact_pointers(out: dict) -> None:
+    """Compact pointers to the round's committed hardware/accuracy evidence
+    (artifacts/TPU_EVIDENCE.json, artifacts/ACCURACY_STUDY.json) so the one
+    bench line names the fuller record even when the end-of-round tunnel is
+    wedged and this process had to fall back to the CPU smoke tier."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        with open(os.path.join(here, "artifacts", "TPU_EVIDENCE.json")) as f:
+            ev = json.load(f)
+        out["tpu_evidence"] = {
+            "device": ev.get("device"),
+            "recorded_unix": ev.get("recorded_unix"),  # None = pre-round-3
+            "phases_ok": sorted(
+                k for k, v in ev.get("phases", {}).items() if v.get("ok")
+            ),
+        }
+    except Exception:  # noqa: BLE001 — pointer only
+        pass
+    try:
+        with open(os.path.join(here, "artifacts", "ACCURACY_STUDY.json")) as f:
+            st = json.load(f)
+        out["accuracy_study"] = {
+            t: {
+                "accuracy_delta_pts": st[t].get("accuracy_delta_pts"),
+                "gradient_bytes_ratio": st[t].get("gradient_bytes_ratio"),
+            }
+            for t in ("cifar", "imdb")
+            if t in st
+        }
+    except Exception:  # noqa: BLE001 — pointer only
+        pass
+
+
 def main() -> int:
     out = {
         "metric": "cifar10_resnet50_train_imgs_per_sec",
@@ -489,16 +487,18 @@ def main() -> int:
         "unit": "imgs/sec",
         "vs_baseline": 0.0,
     }
+    _artifact_pointers(out)
     try:
         _init_backend()
     except (_InitTimeout, Exception) as e:
         attempt = int(os.environ.get(ATTEMPT_ENV, "1"))
-        if attempt < MAX_ATTEMPTS:
+        if attempt < MAX_ATTEMPTS and _ladder_elapsed_s() < TOTAL_DEADLINE_S:
             # backend-init failures are cached per-process: a fresh interpreter
             # is the only real retry
             print(
                 f"# bench: attempt {attempt} failed at init "
-                f"({type(e).__name__}: {e}); re-exec",
+                f"({type(e).__name__}: {e}); re-exec "
+                f"({int(_ladder_elapsed_s())}s/{TOTAL_DEADLINE_S}s budget)",
                 file=sys.stderr, flush=True,
             )
             os.environ[ATTEMPT_ENV] = str(attempt + 1)

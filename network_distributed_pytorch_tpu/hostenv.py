@@ -16,6 +16,9 @@ import sys
 from typing import Optional
 
 _COUNT_FLAG = r"--xla_force_host_platform_device_count=\d+\s*"
+_TIMEOUT_FLAGS = (
+    r"--xla_cpu_collective_call_(?:warn_stuck|terminate)_timeout_seconds=\d+\s*"
+)
 
 
 def force_cpu_devices(
@@ -52,6 +55,7 @@ def force_cpu_devices(
         flags = re.sub(_COUNT_FLAG, "", flags).strip()
         flags += f" --xla_force_host_platform_device_count={n}"
     if collective_timeout_s is not None:
+        flags = re.sub(_TIMEOUT_FLAGS, "", flags).strip()  # no duplicates
         flags += (
             f" --xla_cpu_collective_call_warn_stuck_timeout_seconds={collective_timeout_s}"
             f" --xla_cpu_collective_call_terminate_timeout_seconds={2 * collective_timeout_s}"

@@ -1,0 +1,93 @@
+"""Shared benchmark scaffolds.
+
+One implementation of "build the GPT training step and time it honestly" so
+``bench.py`` (the driver's one-line metric) and ``scripts/tpu_evidence.py``
+(the committed hardware record) measure with IDENTICAL methodology:
+AOT-compiled executable (cost analysis of the exact program timed),
+deterministic cyclic token batch, warmup call, fetch-to-observe timing
+(``utils.timing.wait_result``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+
+def time_gpt_train_step(
+    *,
+    small: bool = False,
+    seq_len: int = 1024,
+    batch: int = 8,
+    vocab: int = 50257,
+    attn_impl: str = "einsum",
+    reps: int = 10,
+    learning_rate: float = 1e-3,
+) -> Dict:
+    """Step time / tokens/sec (and FLOPs when cost analysis offers them)
+    for one data-parallel GPT training step on the attached backend.
+
+    ``small=True`` swaps in the test-tier decoder (CI smoke); otherwise the
+    GPT-2-small (124M at the default 50257 vocab) shape. Returns
+    ``{model, seq_len, batch, attn_impl, step_time_ms, tokens_per_sec,
+    flops_per_step?}``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import gpt_small, gpt_tiny, next_token_loss
+    from ..parallel import ExactReducer, make_mesh
+    from ..parallel.trainer import make_train_step, stateless_loss
+    from .timing import wait_result
+
+    make = gpt_tiny if small else gpt_small
+    model = make(
+        vocab_size=vocab, max_position_embeddings=seq_len,
+        dtype=jnp.bfloat16, dropout=0.0, attn_impl=attn_impl,
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, seq_len), jnp.int32)
+    )["params"]
+
+    def loss(p, b):
+        x, y = b
+        return next_token_loss(model.apply({"params": p}, x), y)
+
+    step = make_train_step(
+        stateless_loss(loss), ExactReducer(), params,
+        learning_rate=learning_rate, momentum=0.9, algorithm="sgd",
+        mesh=make_mesh(), donate_state=False,
+    )
+    state = step.init_state(params)
+    toks = jnp.broadcast_to(
+        jnp.arange(seq_len + 1, dtype=jnp.int32)[None, :] % vocab,
+        (batch, seq_len + 1),
+    )
+    batch_xy = (toks[:, :-1], toks[:, 1:])
+    compiled = step.fn.lower(state, batch_xy).compile()
+    flops: Optional[float] = None
+    try:
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        f = float(ca.get("flops", 0.0))
+        flops = f if f > 0 else None
+    except Exception:  # cost analysis is best-effort
+        pass
+    state, l = compiled(state, batch_xy)  # warmup
+    wait_result(l)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        state, l = compiled(state, batch_xy)
+    wait_result(l)  # fetch-to-observe-completion, utils.timing
+    dt = (time.perf_counter() - t0) / reps
+    out = {
+        "model": "gpt_tiny" if small else "gpt2_small_124M",
+        "seq_len": seq_len,
+        "batch": batch,
+        "attn_impl": attn_impl,
+        "step_time_ms": round(1000.0 * dt, 3),
+        "tokens_per_sec": round(batch * seq_len / dt, 1),
+    }
+    if flops is not None:
+        out["flops_per_step"] = flops
+    return out

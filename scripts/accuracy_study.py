@@ -137,12 +137,20 @@ def cifar_study(max_epochs: int, patience: int) -> dict:
         )
 
     def evaluate(step, state):
+        # everything host-side first: step.eval_model_state's per-worker
+        # BN collapse runs on the 8-device state, and fresh multi-device
+        # programs intermittently deadlock their rendezvous on a 1-core
+        # host (the train step's collectives, compiled once and stepped
+        # repeatedly, are fine). device_get first, then the library's own
+        # collapse on host arrays — a single-device program.
+        from network_distributed_pytorch_tpu.parallel.trainer import (
+            collapse_per_worker,
+        )
+
+        host_ms = jax.device_get(state.model_state)
+        batch_stats = collapse_per_worker(host_ms, "mean")["batch_stats"]
         return evaluate_image_classifier(
-            model,
-            state.params,
-            step.eval_model_state(state)["batch_stats"],
-            test_x,
-            test_y,
+            model, jax.device_get(state.params), batch_stats, test_x, test_y
         )
 
     arms = {}
@@ -235,7 +243,8 @@ def imdb_study(max_epochs: int, patience: int) -> dict:
         )
 
     def evaluate(step, state):
-        return evaluate_text_classifier(model, state.params, val)
+        # host fetch → single-device eval program (see cifar evaluate)
+        return evaluate_text_classifier(model, jax.device_get(state.params), val)
 
     arms = {}
     for arm, (reducer, algorithm) in {

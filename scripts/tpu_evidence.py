@@ -113,6 +113,7 @@ def main() -> int:
     evidence["device"] = getattr(devices[0], "device_kind", devices[0].platform)
     evidence["n_devices"] = len(devices)
     evidence["steps"] = args.steps
+    evidence["recorded_unix"] = int(time.time())  # freshness for consumers
     _save()
 
     from network_distributed_pytorch_tpu.experiments import (
@@ -174,65 +175,20 @@ def main() -> int:
     def gpt_train_attn_compare():
         # the Pallas flash-attention kernel vs XLA einsum attention on the
         # SAME 124M training step (batch 8, seq 1024, bf16) — measured on
-        # chip, the "faster kernel" evidence for ops/flash_attention
-        import jax
-        import jax.numpy as jnp
+        # chip with the SAME scaffold bench.py's GPT row uses
+        # (utils.benchmarks: AOT executable, fetch-to-observe timing)
+        from network_distributed_pytorch_tpu.utils.benchmarks import (
+            time_gpt_train_step,
+        )
 
-        from network_distributed_pytorch_tpu.models import (
-            gpt_small,
-            next_token_loss,
-        )
-        from network_distributed_pytorch_tpu.parallel import (
-            ExactReducer,
-            make_mesh,
-        )
-        from network_distributed_pytorch_tpu.parallel.trainer import (
-            make_train_step,
-            stateless_loss,
-        )
-        from network_distributed_pytorch_tpu.utils.timing import wait_result
-
-        seq_len, batch, vocab = 1024, 8, 50257
-        toks = jnp.broadcast_to(
-            jnp.arange(seq_len + 1, dtype=jnp.int32)[None, :] % vocab,
-            (batch, seq_len + 1),
-        )
-        batch_xy = (toks[:, :-1], toks[:, 1:])
         out = {}
         for impl in ("einsum", "flash"):
-            model = gpt_small(
-                vocab_size=vocab, max_position_embeddings=seq_len,
-                dtype=jnp.bfloat16, dropout=0.0, attn_impl=impl,
-            )
-            params = model.init(
-                jax.random.PRNGKey(0), jnp.zeros((1, seq_len), jnp.int32)
-            )["params"]
-
-            def loss(p, b, _model=model):
-                x, y = b
-                return next_token_loss(_model.apply({"params": p}, x), y)
-
-            step = make_train_step(
-                stateless_loss(loss), ExactReducer(), params,
-                learning_rate=1e-3, momentum=0.9, algorithm="sgd",
-                mesh=make_mesh(), donate_state=False,
-            )
-            state = step.init_state(params)
-            state, l = step(state, batch_xy)  # compile + warmup
-            wait_result(l)
-            t0 = time.perf_counter()
-            for _ in range(5):
-                state, l = step(state, batch_xy)
-            wait_result(l)
-            dt = (time.perf_counter() - t0) / 5
-            out[impl] = {
-                "step_time_ms": round(1000.0 * dt, 2),
-                "tokens_per_sec": round(batch * seq_len / dt, 1),
-            }
-        if out.get("einsum") and out.get("flash"):
-            out["flash_speedup"] = round(
-                out["einsum"]["step_time_ms"] / out["flash"]["step_time_ms"], 3
-            )
+            r = time_gpt_train_step(attn_impl=impl, reps=5)
+            r.pop("flops_per_step", None)  # MFU is bench.py's column
+            out[impl] = r
+        out["flash_speedup"] = round(
+            out["einsum"]["step_time_ms"] / out["flash"]["step_time_ms"], 3
+        )
         return out
 
     def gpt_decode():

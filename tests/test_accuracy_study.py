@@ -1,0 +1,82 @@
+"""run_to_plateau semantics (scripts/accuracy_study.py) with faked
+train_loop/step/evaluate — no devices: best-accuracy is tracked
+unconditionally while the patience mark only moves on meaningful jumps, and
+the plateaued flag reflects the break, not the curve length."""
+
+import importlib.util
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_study():
+    spec = importlib.util.spec_from_file_location(
+        "accuracy_study", os.path.join(REPO, "scripts", "accuracy_study.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _FakeLogger:
+    def summary(self):
+        return {"steps": 4}
+
+
+class _FakeStep:
+    bits_per_step = 800
+
+
+def _run(mod, accs, max_epochs=30, patience=3, monkeypatch=None):
+    import network_distributed_pytorch_tpu.experiments.common as common
+
+    calls = {"n": 0}
+
+    def fake_train_loop(step, state, batches, epochs, log_every=0, prefetch=0):
+        return state, _FakeLogger()
+
+    monkeypatch.setattr(common, "train_loop", fake_train_loop)
+
+    def evaluate(step, state):
+        i = min(calls["n"], len(accs) - 1)
+        calls["n"] += 1
+        return accs[i]
+
+    return mod.run_to_plateau(
+        "t", _FakeStep(), None, lambda e: iter(()), evaluate,
+        max_epochs, patience,
+    )
+
+
+def test_best_tracks_small_gains(monkeypatch):
+    """Steady sub-min_delta improvement: the patience mark stays put (the
+    arm plateaus) but best_accuracy reports the true maximum, not epoch 0."""
+    mod = _load_study()
+    accs = [0.90, 0.901, 0.9012, 0.9013, 0.9014, 0.9015]
+    rec = _run(mod, accs, patience=3, monkeypatch=monkeypatch)
+    assert rec["plateaued"] is True
+    assert rec["epochs_run"] == 4  # mark at epoch 0, +patience
+    assert rec["best_accuracy"] == 0.9013  # max seen, not the mark
+
+def test_plateaued_true_when_break_on_last_epoch(monkeypatch):
+    """Patience met exactly on the final allowed epoch still records
+    plateaued=True (previously inferred — wrongly — from curve length)."""
+    mod = _load_study()
+    accs = [0.5, 0.9, 0.9, 0.9, 0.9]
+    rec = _run(mod, accs, max_epochs=5, patience=3, monkeypatch=monkeypatch)
+    assert rec["epochs_run"] == 5
+    assert rec["plateaued"] is True
+
+
+def test_budget_capped_run_not_plateaued(monkeypatch):
+    """Accuracy still climbing past min_delta each epoch when max_epochs
+    runs out: plateaued=False."""
+    mod = _load_study()
+    accs = [0.1, 0.2, 0.3, 0.4, 0.5, 0.6]
+    rec = _run(mod, accs, max_epochs=4, patience=3, monkeypatch=monkeypatch)
+    assert rec["epochs_run"] == 4
+    assert rec["plateaued"] is False
+    assert rec["best_accuracy"] == 0.4
+    assert rec["total_mb_on_wire"] == round(800 * 16 / 8e6, 2)
