@@ -425,7 +425,13 @@ def _overlap_evidence(results: dict, make_model, mesh) -> None:
         rep["combiner_merged"] = aud["count"] < 4
         rep["workload"] = "powersgd_r4_" + ("resnet18" if "small" == results.get("preset") else "resnet50")
         rep["compiled_for"] = topology_note
-        rep["device"] = results.get("device", "?")
+        # an AOT-topology schedule is attached-device-independent — say so
+        # rather than stamping whatever chip happened to be attached
+        rep["device"] = (
+            "AOT (schedule is attached-device-independent)"
+            if target_mesh is not mesh
+            else results.get("device", "?")
+        )
         # only the real-chip run owns OVERLAP.json — a CPU smoke run must
         # not clobber the committed TPU artifact (it once did)
         name = (

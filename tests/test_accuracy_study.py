@@ -11,7 +11,11 @@ import types
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _load_study():
+def _load_study(monkeypatch):
+    # a non-"cpu" platform value skips the script's module-level env setup
+    # (force_cpu_devices + rendezvous-deadline XLA_FLAGS), which would
+    # otherwise leak into every later test's subprocesses
+    monkeypatch.setenv("ACCURACY_STUDY_PLATFORM", "preset-by-conftest")
     spec = importlib.util.spec_from_file_location(
         "accuracy_study", os.path.join(REPO, "scripts", "accuracy_study.py")
     )
@@ -53,7 +57,7 @@ def _run(mod, accs, max_epochs=30, patience=3, monkeypatch=None):
 def test_best_tracks_small_gains(monkeypatch):
     """Steady sub-min_delta improvement: the patience mark stays put (the
     arm plateaus) but best_accuracy reports the true maximum, not epoch 0."""
-    mod = _load_study()
+    mod = _load_study(monkeypatch)
     accs = [0.90, 0.901, 0.9012, 0.9013, 0.9014, 0.9015]
     rec = _run(mod, accs, patience=3, monkeypatch=monkeypatch)
     assert rec["plateaued"] is True
@@ -63,7 +67,7 @@ def test_best_tracks_small_gains(monkeypatch):
 def test_plateaued_true_when_break_on_last_epoch(monkeypatch):
     """Patience met exactly on the final allowed epoch still records
     plateaued=True (previously inferred — wrongly — from curve length)."""
-    mod = _load_study()
+    mod = _load_study(monkeypatch)
     accs = [0.5, 0.9, 0.9, 0.9, 0.9]
     rec = _run(mod, accs, max_epochs=5, patience=3, monkeypatch=monkeypatch)
     assert rec["epochs_run"] == 5
@@ -73,7 +77,7 @@ def test_plateaued_true_when_break_on_last_epoch(monkeypatch):
 def test_budget_capped_run_not_plateaued(monkeypatch):
     """Accuracy still climbing past min_delta each epoch when max_epochs
     runs out: plateaued=False."""
-    mod = _load_study()
+    mod = _load_study(monkeypatch)
     accs = [0.1, 0.2, 0.3, 0.4, 0.5, 0.6]
     rec = _run(mod, accs, max_epochs=4, patience=3, monkeypatch=monkeypatch)
     assert rec["epochs_run"] == 4

@@ -55,3 +55,25 @@ def test_overlap_report_on_real_cpu_hlo(devices):
     rep = overlap_report(compiled_hlo_text(step.fn, state, batch))
     assert rep["scheduled"]
     assert rep["n_async_collectives"] == 0
+
+
+def test_overlap_report_generic_async_wrapper():
+    """XLA's generic `async-start`/`async-done` wrapper (what the TPU
+    async-collective-fusion pass emits) is recognized and classified by the
+    wrapped collective named on the line."""
+    hlo = "\n".join([
+        "HloModule m, is_scheduled=true",
+        "ENTRY %main () -> f32[8] {",
+        "  %p = f32[8]{0} parameter(0)",
+        "  %ar = ((f32[8]), f32[8]) async-start(%p), calls=%wrapped_all-reduce.1",
+        "  %f1 = f32[8]{0} fusion(%p), kind=kLoop",
+        "  %d = f32[8]{0} dot(%f1, %f1)",
+        "  %done = f32[8]{0} async-done(%ar)",
+        "  ROOT %r = f32[8]{0} add(%done, %d)",
+        "}",
+    ])
+    rep = overlap_report(hlo)
+    assert rep["n_async_collectives"] == 1
+    assert rep["collectives"][0]["kind"] == "all-reduce"
+    assert rep["n_overlapped"] == 1  # the fusion + dot sit inside the window
+    assert rep["collectives"][0]["compute_ops_between"] == 2
