@@ -189,17 +189,45 @@ class WordPieceTokenizer:
     # ---- HF-style batch encoding -----------------------------------------
 
     def __call__(self, texts: Sequence[str]) -> dict:
-        # normalization (Unicode-aware) in Python; the greedy matcher — the
-        # hot loop — runs in the native runtime when available (parity
-        # asserted in tests/test_native_loader.py)
-        words_per_text = [self.basic_tokenize(t) for t in texts]
+        # the measured hot loop is NORMALIZATION, not matching — so ASCII
+        # rows (the common case; under the default lowercase+strip-accents
+        # config the rules reduce to byte rules) take a one-pass native
+        # normalize+match, while remaining rows pay the Unicode-aware
+        # Python normalizer and then the (config-independent) native
+        # matcher. Parity asserted in tests/test_native_loader.py.
         native = self._native_matcher()
-        if native is not None:
-            return native.encode(
-                words_per_text, self.unk_id, self.cls_id, self.sep_id,
-                self.pad_id, self.max_len, max_word_chars=_MAX_WORD_CHARS,
+        if native is None:
+            return self.python_encode(
+                [self.basic_tokenize(t) for t in texts]
             )
-        return self.python_encode(words_per_text)
+        ascii_ok = self.lower_case and self.strip_accents
+        ascii_rows: List[int] = []
+        other_rows: List[int] = []
+        for i, t in enumerate(texts):
+            (ascii_rows if ascii_ok and t.isascii() else other_rows).append(i)
+        special = (
+            self.unk_id, self.cls_id, self.sep_id, self.pad_id, self.max_len,
+        )
+        if not other_rows:
+            return native.encode_ascii(
+                list(texts), *special, max_word_chars=_MAX_WORD_CHARS
+            )
+        out_o = native.encode(
+            [self.basic_tokenize(texts[i]) for i in other_rows],
+            *special, max_word_chars=_MAX_WORD_CHARS,
+        )
+        if not ascii_rows:
+            return out_o
+        out_a = native.encode_ascii(
+            [texts[i] for i in ascii_rows], *special,
+            max_word_chars=_MAX_WORD_CHARS,
+        )
+        ids = np.empty((len(texts), self.max_len), np.int32)
+        mask = np.empty((len(texts), self.max_len), np.int32)
+        for src, rows in ((out_a, ascii_rows), (out_o, other_rows)):
+            ids[rows] = src["input_ids"]
+            mask[rows] = src["attention_mask"]
+        return {"input_ids": ids, "attention_mask": mask}
 
     def python_encode(self, words_per_text: Sequence[List[str]]) -> dict:
         """The reference Python matcher (also the native-parity oracle)."""

@@ -117,3 +117,9 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.c_void_p, c.c_void_p,
     ]
     lib.ndp_wordpiece_encode.restype = None
+    lib.ndp_wordpiece_encode_ascii.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64,
+        c.c_int32, c.c_int32, c.c_int32, c.c_int32, c.c_int32, c.c_int32,
+        c.c_int, c.c_void_p, c.c_void_p,
+    ]
+    lib.ndp_wordpiece_encode_ascii.restype = None

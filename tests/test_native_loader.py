@@ -189,3 +189,64 @@ def test_native_wordpiece_parity(tmp_path):
     np.testing.assert_array_equal(
         tok(texts)["input_ids"], ref["input_ids"]
     )
+
+
+def test_native_wordpiece_ascii_onepass_parity(tmp_path):
+    """The one-pass ASCII normalize+match kernel ≡ Python normalize +
+    oracle match, on control bytes, VT/FF, punct runs, casing, over-long
+    words, whitespace-only and empty rows, and cap truncation."""
+    from network_distributed_pytorch_tpu.data.wordpiece import WordPieceTokenizer
+    from network_distributed_pytorch_tpu.native.build import native_available
+
+    if not native_available():
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+
+    vocab = [
+        "[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "movie", "was", "great",
+        "!", ",", ".", "-", "a", "##b", "ab", "x", "##x",
+    ]
+    vf = tmp_path / "vocab.txt"
+    vf.write_text("\n".join(vocab) + "\n", encoding="utf-8")
+    tok = WordPieceTokenizer(str(vf), max_len=12)
+    texts = [
+        "The MOVIE was GREAT!",
+        "a\x00b\x01c",                      # NUL/control dropped mid-word
+        "the\x0bmovie\x0cwas",              # VT/FF are control (joined), not spaces
+        "--..!!,,",                         # punctuation run
+        "",                                 # empty
+        " \t\n\r ",                         # whitespace only
+        "x" * 150,                          # over the 100-char cap → [UNK]
+        "xxxx",                             # multi-piece x ##x ##x ##x
+        ("the great movie ! " * 20),        # truncation past max_len
+    ]
+    assert all(t.isascii() for t in texts)
+    ref = tok.python_encode([tok.basic_tokenize(t) for t in texts])
+    out = tok._native_matcher().encode_ascii(
+        texts, tok.unk_id, tok.cls_id, tok.sep_id, tok.pad_id, tok.max_len
+    )
+    np.testing.assert_array_equal(out["input_ids"], ref["input_ids"])
+    np.testing.assert_array_equal(out["attention_mask"], ref["attention_mask"])
+
+
+def test_native_wordpiece_mixed_batch_routing(tmp_path):
+    """__call__ routes ASCII rows to the one-pass kernel and non-ASCII rows
+    through the Python normalizer, reassembling rows in order."""
+    from network_distributed_pytorch_tpu.data.wordpiece import WordPieceTokenizer
+    from network_distributed_pytorch_tpu.native.build import native_available
+
+    if not native_available():
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "cafe", "movie", "电"]
+    vf = tmp_path / "vocab.txt"
+    vf.write_text("\n".join(vocab) + "\n", encoding="utf-8")
+    tok = WordPieceTokenizer(str(vf), max_len=8)
+    texts = ["the movie", "café 电", "the the", "CAFÉ"]
+    out = tok(texts)
+    ref = tok.python_encode([tok.basic_tokenize(t) for t in texts])
+    np.testing.assert_array_equal(out["input_ids"], ref["input_ids"])
+    np.testing.assert_array_equal(out["attention_mask"], ref["attention_mask"])
