@@ -40,9 +40,27 @@ def accumulated_batches(
             f" by accum_steps {k}"
         )
 
+    # the plain (image, label) epochs — every CIFAR experiment — run through
+    # the C++ prefetch runtime: identical batch semantics to iterate_batches
+    # (asserted in tests/test_native_loader.py) with assembly on a worker
+    # thread one batch ahead of the training loop; dict/accumulated batches
+    # keep the numpy path. Eligibility (dtypes, pair shape) lives with the
+    # loader itself.
+    native_loader = None
+    if k == 1 and keys is None:
+        from ..data import NativeBatchLoader
+
+        native_loader = NativeBatchLoader.maybe_create(
+            arrays, config.global_batch_size, seed=config.seed
+        )
+
     def gen(epoch: int):
-        it = iterate_batches(
-            arrays, config.global_batch_size, seed=config.seed, epoch=epoch
+        it = (
+            native_loader.epoch(epoch)
+            if native_loader is not None
+            else iterate_batches(
+                arrays, config.global_batch_size, seed=config.seed, epoch=epoch
+            )
         )
         for i, batch in enumerate(it):
             if max_steps_per_epoch is not None and i >= max_steps_per_epoch:

@@ -58,6 +58,14 @@ static int effective_threads(int64_t work_bytes, int n_threads) {
   return work_bytes < (int64_t)1 << 18 ? 1 : n_threads;
 }
 
+// Same guard for COMPUTE-bound kernels (tokenization does hash probes per
+// byte, ~50 MB/s vs memcpy's GB/s): far fewer bytes amortize the spawn
+// cost, so the threshold is 16 KB instead of 256 KB — a typical per-step
+// text batch fans out instead of running single-threaded.
+static int effective_threads_compute(int64_t work_bytes, int n_threads) {
+  return work_bytes < (int64_t)1 << 14 ? 1 : n_threads;
+}
+
 extern "C" {
 
 // ------------------------------------------------------------------ decode
@@ -242,7 +250,7 @@ void ndp_tokenize_hash(const uint8_t* bytes, const int64_t* offsets,
                        int64_t n_texts, int32_t vocab_size, int32_t max_len,
                        int n_threads, int32_t* ids_out, int32_t* mask_out) {
   int64_t total = n_texts ? offsets[n_texts] : 0;
-  parallel_for(n_texts, effective_threads(total, n_threads),
+  parallel_for(n_texts, effective_threads_compute(total, n_threads),
                [&](int64_t lo, int64_t hi) {
     for (int64_t i = lo; i < hi; ++i) {
       const uint8_t* p = bytes + offsets[i];
@@ -366,7 +374,7 @@ void ndp_wordpiece_encode(void* handle, const uint8_t* word_bytes,
   for (int64_t i = 0; i < n_texts; ++i)
     first[i + 1] = first[i] + text_word_counts[i];
   int64_t total_bytes = first[n_texts] ? word_offsets[first[n_texts]] : 0;
-  parallel_for(n_texts, effective_threads(total_bytes, n_threads),
+  parallel_for(n_texts, effective_threads_compute(total_bytes, n_threads),
                [&](int64_t lo, int64_t hi) {
     std::string probe;          // reused across probes — no realloc once grown
     std::vector<int32_t> pieces;
@@ -408,7 +416,7 @@ void ndp_wordpiece_encode_ascii(void* handle, const uint8_t* bytes,
                                 int32_t* ids_out, int32_t* mask_out) {
   auto* H = (NdpWordPiece*)handle;
   int64_t total = n_texts ? offsets[n_texts] : 0;
-  parallel_for(n_texts, effective_threads(total, n_threads),
+  parallel_for(n_texts, effective_threads_compute(total, n_threads),
                [&](int64_t lo, int64_t hi) {
     std::string probe;
     std::string word;           // current normalized word, reused

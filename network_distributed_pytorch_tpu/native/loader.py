@@ -235,6 +235,25 @@ class NativeBatchLoader:
         self._depth = depth
         self._lib = load_library()
 
+    @classmethod
+    def maybe_create(
+        cls, arrays, batch_size: int, seed: int = 0
+    ) -> Optional["NativeBatchLoader"]:
+        """The eligibility contract, next to the semantics it encodes: a
+        plain ``(x, y)`` pair with float32 features and integer labels is
+        byte-identical between this loader and ``iterate_batches`` (u8
+        features are NOT eligible here — the loader's fused normalize would
+        change what raw-u8 callers see). Returns None when ineligible, so
+        call sites need no condition block of their own."""
+        if len(arrays) != 2:
+            return None
+        x, y = arrays
+        if getattr(x, "dtype", None) != np.float32:
+            return None
+        if not np.issubdtype(getattr(y, "dtype", np.float64), np.integer):
+            return None
+        return cls(x, y, batch_size, seed=seed)
+
     def _order(self, epoch: int) -> np.ndarray:
         from ..data.loader import epoch_order  # the one source of semantics
 
