@@ -30,23 +30,17 @@ def _normalize(images_u8: np.ndarray) -> np.ndarray:
 
 
 def cifar10_on_disk(data_dir: str = "./data") -> Optional[str]:
-    """Path of an extracted ``cifar-10-batches-py`` directory, if present."""
-    p = os.path.join(data_dir, "cifar-10-batches-py")
-    return p if os.path.isdir(p) else None
+    """Path of an extracted CIFAR-10 directory, if present: the torchvision
+    pickle form (``cifar-10-batches-py``) or the binary form
+    (``cifar-10-batches-bin``, decoded by the native runtime)."""
+    for name in ("cifar-10-batches-py", "cifar-10-batches-bin"):
+        p = os.path.join(data_dir, name)
+        if os.path.isdir(p):
+            return p
+    return None
 
 
-def load_cifar10(
-    data_dir: str = "./data", train: bool = True
-) -> Tuple[np.ndarray, np.ndarray]:
-    """(images NHWC float32 normalized, labels int32). Raises if absent —
-    use ``load_cifar10_or_synthetic`` for the gated fallback."""
-    base = cifar10_on_disk(data_dir)
-    if base is None:
-        raise FileNotFoundError(
-            f"CIFAR-10 not found under {data_dir!r} (expected cifar-10-batches-py/; "
-            "the reference downloads it via torchvision, ddp_guide_cifar10/ddp_init.py:45)"
-        )
-    names = [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
+def _load_pickle_batches(base: str, names) -> Tuple[np.ndarray, np.ndarray]:
     xs, ys = [], []
     for name in names:
         with open(os.path.join(base, name), "rb") as f:
@@ -55,6 +49,53 @@ def load_cifar10(
         ys.append(np.asarray(entry["labels"], dtype=np.int32))
     data = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)  # NCHW→NHWC
     return _normalize(data), np.concatenate(ys)
+
+
+def _load_bin_batches(base: str, names) -> Tuple[np.ndarray, np.ndarray]:
+    # cifar-10-batches-bin record = [label u8][3072 CHW bytes]; decoded
+    # (and normalized, identically to _normalize) by the multithreaded C++
+    # runtime, numpy fallback inside
+    from ..native import decode_cifar10_bin
+
+    xs, ys = [], []
+    for name in names:
+        raw = np.fromfile(os.path.join(base, name), dtype=np.uint8)
+        if raw.size % 3073 != 0:
+            raise ValueError(
+                f"{name}: {raw.size} bytes is not a whole number of "
+                "3073-byte CIFAR-10 records"
+            )
+        images, labels = decode_cifar10_bin(
+            raw.reshape(-1, 3073), mean=_MEAN, std=_STD
+        )
+        xs.append(images)
+        ys.append(labels)
+    return np.concatenate(xs), np.concatenate(ys)
+
+
+def load_cifar10(
+    data_dir: str = "./data", train: bool = True
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(images NHWC float32 normalized, labels int32). Raises if absent —
+    use ``load_cifar10_or_synthetic`` for the gated fallback. Reads either
+    on-disk form (pickle via Python, binary via the native decoder); both
+    yield identical arrays (``tests/test_data.py``)."""
+    base = cifar10_on_disk(data_dir)
+    if base is None:
+        raise FileNotFoundError(
+            f"CIFAR-10 not found under {data_dir!r} (expected cifar-10-batches-py/ "
+            "or cifar-10-batches-bin/; the reference downloads the former via "
+            "torchvision, ddp_guide_cifar10/ddp_init.py:45)"
+        )
+    if base.endswith("-bin"):
+        names = (
+            [f"data_batch_{i}.bin" for i in range(1, 6)]
+            if train
+            else ["test_batch.bin"]
+        )
+        return _load_bin_batches(base, names)
+    names = [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
+    return _load_pickle_batches(base, names)
 
 
 def synthetic_cifar10(

@@ -150,3 +150,51 @@ def test_device_prefetch_preserves_trajectory():
         outs.append((np.asarray(state.params["w"]), logger.summary()["final_loss"]))
     np.testing.assert_array_equal(outs[0][0], outs[1][0])
     assert outs[0][1] == outs[1][1]
+
+
+def test_cifar10_bin_format_matches_pickle(tmp_path):
+    """The SAME dataset written as cifar-10-batches-bin (native decoder) and
+    cifar-10-batches-py (pickle) loads to identical arrays."""
+    _write_fake_cifar(tmp_path)
+    xp, yp = load_cifar10(str(tmp_path), train=True)
+    xpt, ypt = load_cifar10(str(tmp_path), train=False)
+
+    bin_root = tmp_path / "bin"
+    base = bin_root / "cifar-10-batches-bin"
+    base.mkdir(parents=True)
+
+    def write_bin(pickle_name, bin_name):
+        with open(tmp_path / "cifar-10-batches-py" / pickle_name, "rb") as f:
+            entry = pickle.load(f, encoding="latin1")
+        np.concatenate(
+            [
+                np.asarray(entry["labels"], np.uint8)[:, None],
+                np.asarray(entry["data"], np.uint8),
+            ],
+            axis=1,
+        ).tofile(base / bin_name)
+
+    for i in range(1, 6):
+        write_bin(f"data_batch_{i}", f"data_batch_{i}.bin")
+    write_bin("test_batch", "test_batch.bin")
+
+    xb, yb = load_cifar10(str(bin_root), train=True)
+    xbt, ybt = load_cifar10(str(bin_root), train=False)
+    np.testing.assert_array_equal(yb, yp)
+    np.testing.assert_array_equal(ybt, ypt)
+    np.testing.assert_allclose(xb, xp, rtol=0, atol=1e-6)
+    np.testing.assert_allclose(xbt, xpt, rtol=0, atol=1e-6)
+
+
+def test_cifar10_bin_rejects_truncated_file(tmp_path):
+    base = tmp_path / "cifar-10-batches-bin"
+    base.mkdir(parents=True)
+    for i in range(1, 6):
+        np.zeros(99, np.uint8).tofile(base / f"data_batch_{i}.bin")
+    np.zeros(100, np.uint8).tofile(base / "test_batch.bin")  # not a record multiple
+    import pytest
+
+    with pytest.raises(ValueError, match="3073"):
+        load_cifar10(str(tmp_path), train=False)
+    with pytest.raises(ValueError, match="3073"):
+        load_cifar10(str(tmp_path), train=True)
