@@ -3,10 +3,12 @@
 The reference loads CIFAR-10 via
 ``torchvision.datasets.CIFAR10("./data", train=True, download=True,
 transform=[ToTensor, Normalize((.5,.5,.5),(.5,.5,.5))])``
-(``ddp_guide_cifar10/ddp_init.py:42-47``). This module reads the SAME on-disk
-format (the ``cifar-10-batches-py`` pickle batches torchvision downloads)
-directly — no torch in the loop — applies the same normalization, and emits
-**NHWC** float32 (TPU-native layout; the reference's NCHW is a GPU-ism).
+(``ddp_guide_cifar10/ddp_init.py:42-47``). This module reads BOTH on-disk
+forms directly — the ``cifar-10-batches-py`` pickle batches torchvision
+downloads (Python) and the ``cifar-10-batches-bin`` binary records (the
+native C++ decoder) — no torch in the loop — applies the same
+normalization, and emits **NHWC** float32 (TPU-native layout; the
+reference's NCHW is a GPU-ism).
 
 When the dataset is not on disk (this build environment has no egress), a
 deterministic synthetic stand-in with identical shapes/dtypes/semantics keeps
@@ -30,12 +32,18 @@ def _normalize(images_u8: np.ndarray) -> np.ndarray:
 
 
 def cifar10_on_disk(data_dir: str = "./data") -> Optional[str]:
-    """Path of an extracted CIFAR-10 directory, if present: the torchvision
-    pickle form (``cifar-10-batches-py``) or the binary form
-    (``cifar-10-batches-bin``, decoded by the native runtime)."""
-    for name in ("cifar-10-batches-py", "cifar-10-batches-bin"):
+    """Path of a USABLE extracted CIFAR-10 directory: the torchvision pickle
+    form (``cifar-10-batches-py``) or the binary form
+    (``cifar-10-batches-bin``, decoded by the native runtime). A directory
+    must actually contain its first training batch — a stale/empty dir
+    (e.g. an interrupted download) must not shadow a complete one in the
+    other format."""
+    for name, probe in (
+        ("cifar-10-batches-py", "data_batch_1"),
+        ("cifar-10-batches-bin", "data_batch_1.bin"),
+    ):
         p = os.path.join(data_dir, name)
-        if os.path.isdir(p):
+        if os.path.isfile(os.path.join(p, probe)):
             return p
     return None
 
@@ -54,23 +62,30 @@ def _load_pickle_batches(base: str, names) -> Tuple[np.ndarray, np.ndarray]:
 def _load_bin_batches(base: str, names) -> Tuple[np.ndarray, np.ndarray]:
     # cifar-10-batches-bin record = [label u8][3072 CHW bytes]; decoded
     # (and normalized, identically to _normalize) by the multithreaded C++
-    # runtime, numpy fallback inside
+    # runtime, numpy fallback inside. Decoded straight into slices of one
+    # preallocated output (no second concatenate copy of the f32 data).
     from ..native import decode_cifar10_bin
 
-    xs, ys = [], []
+    raws = []
     for name in names:
         raw = np.fromfile(os.path.join(base, name), dtype=np.uint8)
-        if raw.size % 3073 != 0:
+        if raw.size == 0 or raw.size % 3073 != 0:
             raise ValueError(
-                f"{name}: {raw.size} bytes is not a whole number of "
-                "3073-byte CIFAR-10 records"
+                f"{name}: {raw.size} bytes is not a positive whole number "
+                "of 3073-byte CIFAR-10 records"
             )
-        images, labels = decode_cifar10_bin(
-            raw.reshape(-1, 3073), mean=_MEAN, std=_STD
+        raws.append(raw.reshape(-1, 3073))
+    total = sum(r.shape[0] for r in raws)
+    images = np.empty((total, 32, 32, 3), np.float32)
+    labels = np.empty((total,), np.int32)
+    at = 0
+    for raw in raws:
+        n = raw.shape[0]
+        images[at : at + n], labels[at : at + n] = decode_cifar10_bin(
+            raw, mean=_MEAN, std=_STD
         )
-        xs.append(images)
-        ys.append(labels)
-    return np.concatenate(xs), np.concatenate(ys)
+        at += n
+    return images, labels
 
 
 def load_cifar10(

@@ -198,3 +198,34 @@ def test_cifar10_bin_rejects_truncated_file(tmp_path):
         load_cifar10(str(tmp_path), train=False)
     with pytest.raises(ValueError, match="3073"):
         load_cifar10(str(tmp_path), train=True)
+
+
+def test_cifar10_stale_empty_dir_does_not_shadow(tmp_path):
+    """An empty cifar-10-batches-py dir (interrupted download) must not
+    shadow a complete cifar-10-batches-bin dir; and a 0-byte bin file fails
+    loudly instead of silently shrinking the dataset."""
+    from network_distributed_pytorch_tpu.data.cifar10 import cifar10_on_disk
+
+    (tmp_path / "cifar-10-batches-py").mkdir(parents=True)  # empty: unusable
+    base = tmp_path / "cifar-10-batches-bin"
+    base.mkdir()
+    rng = np.random.RandomState(3)
+    for i in range(1, 6):
+        rec = np.concatenate(
+            [
+                rng.randint(0, 10, (4, 1), dtype=np.uint8),
+                rng.randint(0, 256, (4, 3072), dtype=np.uint8),
+            ],
+            axis=1,
+        )
+        rec.tofile(base / f"data_batch_{i}.bin")
+    assert cifar10_on_disk(str(tmp_path)) == str(base)
+    x, y = load_cifar10(str(tmp_path), train=True)
+    assert x.shape == (20, 32, 32, 3)
+
+    # truncate one file to zero bytes: loud failure, not a 16-image epoch
+    (base / "data_batch_2.bin").write_bytes(b"")
+    import pytest
+
+    with pytest.raises(ValueError, match="3073"):
+        load_cifar10(str(tmp_path), train=True)
