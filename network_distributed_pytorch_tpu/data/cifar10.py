@@ -31,19 +31,27 @@ def _normalize(images_u8: np.ndarray) -> np.ndarray:
     return ((images_u8.astype(np.float32) / 255.0) - _MEAN) / _STD
 
 
-def cifar10_on_disk(data_dir: str = "./data") -> Optional[str]:
+def cifar10_on_disk(
+    data_dir: str = "./data", train: Optional[bool] = None
+) -> Optional[str]:
     """Path of a USABLE extracted CIFAR-10 directory: the torchvision pickle
     form (``cifar-10-batches-py``) or the binary form
-    (``cifar-10-batches-bin``, decoded by the native runtime). A directory
-    must actually contain its first training batch — a stale/empty dir
-    (e.g. an interrupted download) must not shadow a complete one in the
-    other format."""
-    for name, probe in (
-        ("cifar-10-batches-py", "data_batch_1"),
-        ("cifar-10-batches-bin", "data_batch_1.bin"),
+    (``cifar-10-batches-bin``, decoded by the native runtime).
+
+    ``train`` selects which split must actually be present (None = either):
+    a stale/partial directory — an interrupted download, an eval-only drop —
+    must not shadow a directory in the OTHER format that has the split the
+    caller needs."""
+    for name, train_probe, test_probe in (
+        ("cifar-10-batches-py", "data_batch_1", "test_batch"),
+        ("cifar-10-batches-bin", "data_batch_1.bin", "test_batch.bin"),
     ):
         p = os.path.join(data_dir, name)
-        if os.path.isfile(os.path.join(p, probe)):
+        if train is None:
+            probes = (train_probe, test_probe)
+        else:
+            probes = (train_probe,) if train else (test_probe,)
+        if any(os.path.isfile(os.path.join(p, f)) for f in probes):
             return p
     return None
 
@@ -62,8 +70,9 @@ def _load_pickle_batches(base: str, names) -> Tuple[np.ndarray, np.ndarray]:
 def _load_bin_batches(base: str, names) -> Tuple[np.ndarray, np.ndarray]:
     # cifar-10-batches-bin record = [label u8][3072 CHW bytes]; decoded
     # (and normalized, identically to _normalize) by the multithreaded C++
-    # runtime, numpy fallback inside. Decoded straight into slices of one
-    # preallocated output (no second concatenate copy of the f32 data).
+    # runtime, numpy fallback inside. One preallocated output; each file
+    # decodes IN PLACE into its slice (outer-dim slices of a C-contiguous
+    # array are contiguous) — no concatenate copy, no per-file f32 temp.
     from ..native import decode_cifar10_bin
 
     raws = []
@@ -81,8 +90,9 @@ def _load_bin_batches(base: str, names) -> Tuple[np.ndarray, np.ndarray]:
     at = 0
     for raw in raws:
         n = raw.shape[0]
-        images[at : at + n], labels[at : at + n] = decode_cifar10_bin(
-            raw, mean=_MEAN, std=_STD
+        decode_cifar10_bin(
+            raw, mean=_MEAN, std=_STD,
+            out_images=images[at : at + n], out_labels=labels[at : at + n],
         )
         at += n
     return images, labels
@@ -95,7 +105,7 @@ def load_cifar10(
     use ``load_cifar10_or_synthetic`` for the gated fallback. Reads either
     on-disk form (pickle via Python, binary via the native decoder); both
     yield identical arrays (``tests/test_data.py``)."""
-    base = cifar10_on_disk(data_dir)
+    base = cifar10_on_disk(data_dir, train=train)
     if base is None:
         raise FileNotFoundError(
             f"CIFAR-10 not found under {data_dir!r} (expected cifar-10-batches-py/ "

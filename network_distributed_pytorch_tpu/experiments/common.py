@@ -254,7 +254,13 @@ def evaluate_image_classifier(
         return jnp.argmax(logits, axis=-1)
 
     correct = total = 0
-    for x, y in iterate_batches([images, labels], batch_size, shuffle=False):
+    # drop_last=False: evaluation must score EVERY example — the training
+    # default (drop ragged tails for static shapes) would silently skip the
+    # remainder, and with fewer examples than batch_size would score NOTHING
+    # and report 0.0
+    for x, y in iterate_batches(
+        [images, labels], batch_size, shuffle=False, drop_last=False
+    ):
         correct += int((predict(jnp.asarray(x)) == jnp.asarray(y)).sum())
         total += len(y)
     return correct / max(total, 1)
@@ -273,7 +279,10 @@ def evaluate_text_classifier(model, params, split, batch_size: int = 64) -> floa
 
     arrays = [split["input_ids"], split["attention_mask"], split["labels"]]
     correct = total = 0
-    for ids, mask, y in iterate_batches(arrays, batch_size, shuffle=False):
+    # drop_last=False — score every example (see evaluate_image_classifier)
+    for ids, mask, y in iterate_batches(
+        arrays, batch_size, shuffle=False, drop_last=False
+    ):
         correct += int((predict(jnp.asarray(ids), jnp.asarray(mask)) == jnp.asarray(y)).sum())
         total += len(y)
     return correct / max(total, 1)

@@ -31,25 +31,50 @@ def _pack_strings(chunks) -> Tuple[np.ndarray, np.ndarray]:
 
 
 def decode_cifar10_bin(
-    records: np.ndarray, mean: float = 0.5, std: float = 0.5
+    records: np.ndarray,
+    mean: float = 0.5,
+    std: float = 0.5,
+    out_images: Optional[np.ndarray] = None,
+    out_labels: Optional[np.ndarray] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Decode cifar-10-batches-bin records (n×3073 uint8: label byte + CHW
-    pixels) to (NHWC float32 normalized, int32 labels)."""
+    pixels) to (NHWC float32 normalized, int32 labels). Pass ``out_images``
+    / ``out_labels`` (C-contiguous, matching shape/dtype — e.g. slices of a
+    larger preallocated dataset array) to decode IN PLACE with zero extra
+    allocation; both are also the return value then."""
     records = np.ascontiguousarray(records, dtype=np.uint8)
     assert records.ndim == 2 and records.shape[1] == 3073, records.shape
     n = records.shape[0]
+    if out_images is None:
+        out_images = np.empty((n, 32, 32, 3), np.float32)
+    if out_labels is None:
+        out_labels = np.empty((n,), np.int32)
+    # raise, don't assert (the _check_bounds convention): the native call
+    # writes through raw pointers, so a wrong shape/dtype/layout under
+    # ``python -O`` would be silent heap corruption, not a Python error
+    if out_images.shape != (n, 32, 32, 3) or out_images.dtype != np.float32:
+        raise ValueError(
+            f"out_images must be float32 {(n, 32, 32, 3)}, got "
+            f"{out_images.dtype} {out_images.shape}"
+        )
+    if out_labels.shape != (n,) or out_labels.dtype != np.int32:
+        raise ValueError(
+            f"out_labels must be int32 ({n},), got "
+            f"{out_labels.dtype} {out_labels.shape}"
+        )
+    if not (out_images.flags.c_contiguous and out_labels.flags.c_contiguous):
+        raise ValueError("out arrays must be C-contiguous")
     lib = load_library()
     if lib is not None:
-        images = np.empty((n, 32, 32, 3), np.float32)
-        labels = np.empty((n,), np.int32)
         lib.ndp_decode_cifar10_bin(
-            records.ctypes.data, n, mean, std, images.ctypes.data,
-            labels.ctypes.data, _N_THREADS,
+            records.ctypes.data, n, mean, std, out_images.ctypes.data,
+            out_labels.ctypes.data, _N_THREADS,
         )
-        return images, labels
-    labels = records[:, 0].astype(np.int32)
+        return out_images, out_labels
+    out_labels[:] = records[:, 0].astype(np.int32)
     chw = records[:, 1:].reshape(n, 3, 32, 32).transpose(0, 2, 3, 1)
-    return ((chw.astype(np.float32) / 255.0) - mean) / std, labels
+    out_images[:] = ((chw.astype(np.float32) / 255.0) - mean) / std
+    return out_images, out_labels
 
 
 def _check_bounds(idx: np.ndarray, n: int) -> None:

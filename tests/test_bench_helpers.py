@@ -1,0 +1,74 @@
+"""bench.py's pure helpers — no backend needed: the peak-FLOPs device map,
+the escalating init-timeout ladder, and the artifact pointers that ride the
+one JSON line."""
+
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench(monkeypatch, attempt=None):
+    if attempt is not None:
+        monkeypatch.setenv("BENCH_ATTEMPT", str(attempt))
+    else:
+        monkeypatch.delenv("BENCH_ATTEMPT", raising=False)
+    monkeypatch.delenv("BENCH_INIT_TIMEOUT_S", raising=False)
+    # bench.py stamps BENCH_START_TS at import (ladder wall budget). Pin it
+    # via monkeypatch so teardown REMOVES it — a bare setdefault from the
+    # import would otherwise leak a stale stamp into later tests'
+    # subprocesses (which would then skip straight to the CPU fallback).
+    monkeypatch.setenv("BENCH_START_TS", "0")
+    spec = importlib.util.spec_from_file_location(
+        f"bench_under_test_{attempt}", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _FakeDevice:
+    def __init__(self, platform, kind):
+        self.platform = platform
+        self.device_kind = kind
+
+
+def test_peak_flops_device_map(monkeypatch):
+    bench = _load_bench(monkeypatch)
+    assert bench._peak_flops(_FakeDevice("tpu", "TPU v5 lite")) == 197e12
+    assert bench._peak_flops(_FakeDevice("tpu", "TPU v5p")) == 459e12
+    assert bench._peak_flops(_FakeDevice("tpu", "TPU v6e")) == 918e12
+    # longest-match: "v5 lite" must not resolve via the bare "v5" entry
+    assert bench._peak_flops(_FakeDevice("tpu", "tpu v5 litepod-8")) == 197e12
+    assert bench._peak_flops(_FakeDevice("cpu", "cpu")) == 0.0  # smoke tier
+    assert bench._peak_flops(_FakeDevice("tpu", "TPU v99")) == 0.0  # unknown
+
+
+def test_init_timeout_ladder_escalates(monkeypatch):
+    assert _load_bench(monkeypatch, attempt=1).INIT_TIMEOUT_S == 180
+    assert _load_bench(monkeypatch, attempt=2).INIT_TIMEOUT_S == 300
+    assert _load_bench(monkeypatch, attempt=3).INIT_TIMEOUT_S == 600
+    assert _load_bench(monkeypatch, attempt=9).INIT_TIMEOUT_S == 600  # clamped
+    monkeypatch.setenv("BENCH_INIT_TIMEOUT_S", "42")  # explicit pin wins
+    spec = importlib.util.spec_from_file_location(
+        "bench_pinned", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.INIT_TIMEOUT_S == 42
+
+
+def test_artifact_pointers_ride_the_line(monkeypatch):
+    """The committed evidence artifacts surface as compact pointers in the
+    bench payload (device + phase list + freshness, study deltas)."""
+    bench = _load_bench(monkeypatch)
+    out = {}
+    bench._artifact_pointers(out)
+    # ACCURACY_STUDY.json is committed this round — pointers must decode it
+    assert "accuracy_study" in out
+    assert out["accuracy_study"]["cifar"]["gradient_bytes_ratio"] > 10
+    assert "tpu_evidence" in out
+    assert isinstance(out["tpu_evidence"]["phases_ok"], list)
+    json.dumps(out)  # the line must stay serializable

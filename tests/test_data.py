@@ -229,3 +229,35 @@ def test_cifar10_stale_empty_dir_does_not_shadow(tmp_path):
 
     with pytest.raises(ValueError, match="3073"):
         load_cifar10(str(tmp_path), train=True)
+
+
+def test_cifar10_split_aware_format_fallthrough(tmp_path):
+    """An eval-only pickle drop must not shadow a bin dir that HAS the
+    training split: format selection is per requested split."""
+    from network_distributed_pytorch_tpu.data.cifar10 import cifar10_on_disk
+
+    py = tmp_path / "cifar-10-batches-py"
+    py.mkdir(parents=True)
+    entry = {
+        "data": np.zeros((4, 3072), np.uint8),
+        "labels": [0, 1, 2, 3],
+    }
+    with open(py / "test_batch", "wb") as f:
+        pickle.dump(entry, f)  # eval-only drop
+    bin_dir = tmp_path / "cifar-10-batches-bin"
+    bin_dir.mkdir()
+    rng = np.random.RandomState(5)
+    for i in range(1, 6):
+        np.concatenate(
+            [
+                rng.randint(0, 10, (4, 1), dtype=np.uint8),
+                rng.randint(0, 256, (4, 3072), dtype=np.uint8),
+            ],
+            axis=1,
+        ).tofile(bin_dir / f"data_batch_{i}.bin")
+    assert cifar10_on_disk(str(tmp_path), train=True) == str(bin_dir)
+    assert cifar10_on_disk(str(tmp_path), train=False) == str(py)
+    x, _ = load_cifar10(str(tmp_path), train=True)   # bin format
+    assert x.shape == (20, 32, 32, 3)
+    xt, _ = load_cifar10(str(tmp_path), train=False)  # pickle format
+    assert xt.shape == (4, 32, 32, 3)

@@ -332,3 +332,41 @@ def test_gpt_pp_data_parallel_powersgd_learns(devices):
     assert out["reducer"] == "powersgd"
     assert out["data_shards"] == 2
     assert out["final_loss"] < out["first_loss"] * 0.5, out
+
+
+def test_eval_scores_every_example_even_below_batch_size(devices):
+    """Regression: evaluation must not drop ragged tails — with fewer
+    examples than batch_size the old drop-last path scored NOTHING and
+    reported exactly 0.0."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from network_distributed_pytorch_tpu.experiments.common import (
+        evaluate_image_classifier,
+    )
+    from network_distributed_pytorch_tpu.models import resnet18
+
+    model = resnet18(num_classes=10, norm="batch", stem="cifar", width=8)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=True
+    )
+    x = np.random.RandomState(0).randn(10, 32, 32, 3).astype(np.float32)
+    # an untrained model still predicts SOMETHING for all 10 rows; label
+    # everything with its argmax so accuracy is exactly 1.0 — impossible
+    # under the old tail-dropping bug (total would be 0 → 0.0)
+    logits = model.apply(
+        {"params": variables["params"], "batch_stats": variables["batch_stats"]},
+        jnp.asarray(x), train=False,
+    )
+    y = np.asarray(jnp.argmax(logits, -1), np.int32)
+    acc = evaluate_image_classifier(
+        model, variables["params"], variables["batch_stats"], x, y,
+        batch_size=256,  # larger than the dataset
+    )
+    assert acc == 1.0
+    # ragged tail: 10 examples at batch 4 → 4+4+2, all scored
+    acc = evaluate_image_classifier(
+        model, variables["params"], variables["batch_stats"], x, y, batch_size=4
+    )
+    assert acc == 1.0

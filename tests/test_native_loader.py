@@ -250,3 +250,30 @@ def test_native_wordpiece_mixed_batch_routing(tmp_path):
     ref = tok.python_encode([tok.basic_tokenize(t) for t in texts])
     np.testing.assert_array_equal(out["input_ids"], ref["input_ids"])
     np.testing.assert_array_equal(out["attention_mask"], ref["attention_mask"])
+
+
+def test_decode_cifar10_bin_out_params(monkeypatch):
+    """In-place decode into slices of a larger preallocated array — native
+    and numpy-fallback paths produce identical results to the allocating
+    form, and the returned arrays ARE the passed slices."""
+    import network_distributed_pytorch_tpu.native.build as build
+
+    rng = np.random.RandomState(4)
+    records = rng.randint(0, 256, size=(12, 3073), dtype=np.uint8)
+    want_x, want_y = decode_cifar10_bin(records)
+
+    for force_fallback in (False, True):
+        if force_fallback:
+            monkeypatch.setattr(build, "_lib", None)
+            monkeypatch.setattr(build, "_load_attempted", True)
+        big_x = np.zeros((20, 32, 32, 3), np.float32)
+        big_y = np.zeros((20,), np.int32)
+        rx, ry = decode_cifar10_bin(
+            records, out_images=big_x[5:17], out_labels=big_y[5:17]
+        )
+        assert rx.base is big_x and ry.base is big_y
+        np.testing.assert_array_equal(big_x[5:17], want_x)
+        np.testing.assert_array_equal(big_y[5:17], want_y)
+        assert not big_x[:5].any() and not big_x[17:].any()  # no overwrite
+    monkeypatch.setattr(build, "_lib", None)
+    monkeypatch.setattr(build, "_load_attempted", False)
