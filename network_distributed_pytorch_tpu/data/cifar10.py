@@ -41,17 +41,26 @@ def cifar10_on_disk(
     ``train`` selects which split must actually be present (None = either):
     a stale/partial directory — an interrupted download, an eval-only drop —
     must not shadow a directory in the OTHER format that has the split the
-    caller needs."""
-    for name, train_probe, test_probe in (
-        ("cifar-10-batches-py", "data_batch_1", "test_batch"),
-        ("cifar-10-batches-bin", "data_batch_1.bin", "test_batch.bin"),
+    caller needs. The train probe requires ALL FIVE data_batch files —
+    ``load_cifar10`` reads batches 1-5, so a directory holding only batch 1
+    (interrupted extraction) would pass a single-file probe and then crash
+    in ``open()`` instead of falling through to the other format."""
+    for name, suffix in (
+        ("cifar-10-batches-py", ""),
+        ("cifar-10-batches-bin", ".bin"),
     ):
         p = os.path.join(data_dir, name)
-        if train is None:
-            probes = (train_probe, test_probe)
-        else:
-            probes = (train_probe,) if train else (test_probe,)
-        if any(os.path.isfile(os.path.join(p, f)) for f in probes):
+        train_files = [f"data_batch_{i}{suffix}" for i in range(1, 6)]
+        test_files = [f"test_batch{suffix}"]
+        candidates = (
+            [train_files, test_files]
+            if train is None
+            else [train_files if train else test_files]
+        )
+        if any(
+            all(os.path.isfile(os.path.join(p, f)) for f in files)
+            for files in candidates
+        ):
             return p
     return None
 

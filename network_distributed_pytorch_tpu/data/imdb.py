@@ -64,6 +64,9 @@ class HashTokenizer:
     id 0 = [PAD], 1 = [CLS], 2 = [SEP]; words hash into [3, vocab)."""
 
     def __init__(self, vocab_size: int = 30522, max_len: int = 256):
+        from ..native.loader import _check_max_len
+
+        _check_max_len(max_len)  # [CLS] + [SEP] alone need 2 slots
         self.vocab_size = vocab_size
         self.max_len = max_len
 

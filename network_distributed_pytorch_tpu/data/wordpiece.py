@@ -91,6 +91,9 @@ class WordPieceTokenizer:
         sep_token: str = "[SEP]",
         pad_token: str = "[PAD]",
     ):
+        from ..native.loader import _check_max_len
+
+        _check_max_len(max_len)  # [CLS] + [SEP] alone need 2 slots
         self.vocab = load_vocab(vocab_file)
         self.max_len = max_len
         self.lower_case = lower_case
@@ -245,8 +248,22 @@ class WordPieceTokenizer:
 
     def _native_matcher(self):
         if not hasattr(self, "_native"):
-            from ..native.loader import NativeWordPiece
+            ids = sorted(self.vocab.values())
+            if ids != list(range(len(ids))):
+                # blank/duplicate vocab lines make line-number ids sparse
+                # (load_vocab skips blanks, later duplicates shadow earlier
+                # lines). NativeWordPiece.build assigns ids by list
+                # POSITION, so a sparse vocab would make the native matcher
+                # silently emit compacted ids that disagree with the Python
+                # matcher and with the special-token ids — wrong embedding
+                # rows, no error. Degenerate vocab → the correct-but-slower
+                # Python matcher.
+                self._native = None
+            else:
+                from ..native.loader import NativeWordPiece
 
-            ordered = [t for t, _ in sorted(self.vocab.items(), key=lambda kv: kv[1])]
-            self._native = NativeWordPiece.build(ordered)
+                ordered = [
+                    t for t, _ in sorted(self.vocab.items(), key=lambda kv: kv[1])
+                ]
+                self._native = NativeWordPiece.build(ordered)
         return self._native

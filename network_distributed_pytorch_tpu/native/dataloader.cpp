@@ -347,6 +347,10 @@ static void wp_match_word(const NdpWordPiece* H, const char* wp, int64_t wlen,
 static void wp_emit_row(std::vector<int32_t>& pieces, int32_t cls_id,
                         int32_t sep_id, int32_t pad_id, int32_t max_len,
                         int32_t* ids, int32_t* mask) {
+  // the Python layer rejects max_len < 2 before calling in; guard anyway —
+  // a negative cap cast to size_t below would be a multi-exabyte resize
+  // and the CLS/SEP stores would run off the (caller-zeroed) row
+  if (max_len < 2) { pieces.clear(); return; }
   const int32_t cap = max_len - 2;
   if ((int32_t)pieces.size() > cap) pieces.resize((size_t)cap);
   int32_t pos = 0;

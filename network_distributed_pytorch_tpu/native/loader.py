@@ -128,6 +128,14 @@ def tokenize_hash(texts, vocab_size: int, max_len: int) -> Optional[dict]:
     return {"input_ids": ids, "attention_mask": mask}
 
 
+def _check_max_len(max_len: int) -> None:
+    # the C encoders compute ``cap = max_len - 2`` ([CLS]/[SEP] slots); a
+    # negative cap cast to size_t would be a multi-exabyte resize plus OOB
+    # writes — reject before anything crosses the ctypes boundary
+    if max_len < 2:
+        raise ValueError(f"max_len must be >= 2 ([CLS] + [SEP]), got {max_len}")
+
+
 class NativeWordPiece:
     """Native greedy longest-match WordPiece matcher over a built vocab
     hash table (``data.wordpiece.WordPieceTokenizer``'s hot loop in
@@ -172,6 +180,7 @@ class NativeWordPiece:
         Words over ``max_word_chars`` become a lone 0xff byte — invalid
         UTF-8, never in a vocab — so the C side's no-tiling rule emits the
         same whole-word [UNK] the Python matcher does."""
+        _check_max_len(max_len)
         flat = []
         counts = np.zeros(len(words_per_text), np.int64)
         for i, words in enumerate(words_per_text):
@@ -207,6 +216,7 @@ class NativeWordPiece:
         ASCII input the BERT rules reduce to byte rules done in C++
         (``ndp_wordpiece_encode_ascii``). Callers must route non-ASCII rows
         to the Python normalizer (``WordPieceTokenizer.__call__`` does)."""
+        _check_max_len(max_len)
         enc = [t.encode("ascii") for t in texts]
         buf, offsets = _pack_strings(enc)
         n = len(texts)

@@ -1,28 +1,25 @@
-"""bench.py's pure helpers — no backend needed: the peak-FLOPs device map,
-the escalating init-timeout ladder, and the artifact pointers that ride the
-one JSON line."""
+"""bench.py's jax-free logic: the peak-FLOPs device map, the artifact
+pointers that ride the line, the phase-result merge, and the parent
+orchestrator's resilience policy (hard per-phase timeouts, child respawn,
+CPU fallback, cumulative emission) — driven by scripted fake children, no
+backend and no subprocess needed."""
 
 import importlib.util
 import json
 import os
-import sys
+import queue
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _load_bench(monkeypatch, attempt=None):
-    if attempt is not None:
-        monkeypatch.setenv("BENCH_ATTEMPT", str(attempt))
-    else:
-        monkeypatch.delenv("BENCH_ATTEMPT", raising=False)
-    monkeypatch.delenv("BENCH_INIT_TIMEOUT_S", raising=False)
-    # bench.py stamps BENCH_START_TS at import (ladder wall budget). Pin it
-    # via monkeypatch so teardown REMOVES it — a bare setdefault from the
-    # import would otherwise leak a stale stamp into later tests'
-    # subprocesses (which would then skip straight to the CPU fallback).
-    monkeypatch.setenv("BENCH_START_TS", "0")
+def _load_bench(monkeypatch, **env):
+    for k in list(os.environ):
+        if k.startswith("BENCH_"):
+            monkeypatch.delenv(k, raising=False)
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
     spec = importlib.util.spec_from_file_location(
-        f"bench_under_test_{attempt}", os.path.join(REPO, "bench.py")
+        "bench_under_test", os.path.join(REPO, "bench.py")
     )
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
@@ -46,29 +43,189 @@ def test_peak_flops_device_map(monkeypatch):
     assert bench._peak_flops(_FakeDevice("tpu", "TPU v99")) == 0.0  # unknown
 
 
-def test_init_timeout_ladder_escalates(monkeypatch):
-    assert _load_bench(monkeypatch, attempt=1).INIT_TIMEOUT_S == 180
-    assert _load_bench(monkeypatch, attempt=2).INIT_TIMEOUT_S == 300
-    assert _load_bench(monkeypatch, attempt=3).INIT_TIMEOUT_S == 600
-    assert _load_bench(monkeypatch, attempt=9).INIT_TIMEOUT_S == 600  # clamped
-    monkeypatch.setenv("BENCH_INIT_TIMEOUT_S", "42")  # explicit pin wins
-    spec = importlib.util.spec_from_file_location(
-        "bench_pinned", os.path.join(REPO, "bench.py")
-    )
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    assert mod.INIT_TIMEOUT_S == 42
-
-
 def test_artifact_pointers_ride_the_line(monkeypatch):
     """The committed evidence artifacts surface as compact pointers in the
     bench payload (device + phase list + freshness, study deltas)."""
     bench = _load_bench(monkeypatch)
     out = {}
     bench._artifact_pointers(out)
-    # ACCURACY_STUDY.json is committed this round — pointers must decode it
+    # ACCURACY_STUDY.json is committed — pointers must decode it
     assert "accuracy_study" in out
     assert out["accuracy_study"]["cifar"]["gradient_bytes_ratio"] > 10
     assert "tpu_evidence" in out
     assert isinstance(out["tpu_evidence"]["phases_ok"], list)
     json.dumps(out)  # the line must stay serializable
+
+
+def test_merge_builds_value_and_ratio(monkeypatch):
+    bench = _load_bench(monkeypatch)
+    out, status = {"value": 0.0, "vs_baseline": 0.0}, {}
+    bench._merge(out, "probe", True, {"device": "TPU v5e", "platform": "tpu",
+                                      "n_devices": 1}, status)
+    assert out["device"] == "TPU v5e" and status["probe"] == "ok"
+    bench._merge(out, "flagship", True,
+                 {"flagship_imgs_per_sec": 1000.0, "step_time_ms": 2.0}, status)
+    assert out["value"] == 1000.0  # flagship IS the headline metric
+    bench._merge(out, "baseline", True, {"baseline_imgs_per_sec": 250.0}, status)
+    assert out["vs_baseline"] == 4.0
+    bench._merge(out, "gpt", False, {"error": "boom"}, status)
+    assert status["gpt"].startswith("error: boom")
+    assert "gpt" not in out  # failed phases contribute no fields
+
+
+class _FakeChild:
+    """Scripted stand-in for bench._ChildProc: a list of events, where an
+    event is a dict (phase line), None (EOF), or "hang" (queue.Empty —
+    what a compile wedged in C++ looks like to the parent)."""
+
+    spawns = []  # [(phases, script), ...] consumed in order
+    killed = []
+
+    def __init__(self, phases):
+        assert _FakeChild.spawns, f"unexpected spawn for phases={phases}"
+        expect, self.script = _FakeChild.spawns.pop(0)
+        assert list(phases) == expect, (phases, expect)
+
+    def next_event(self, timeout_s):
+        ev = self.script.pop(0)
+        if ev == "hang":
+            raise queue.Empty()
+        return ev
+
+    def kill(self):
+        _FakeChild.killed.append(True)
+
+
+def _ok(phase, **data):
+    return {"phase": phase, "ok": True, "data": data}
+
+
+def _run_orchestrator(bench, spawns):
+    lines = []
+    _FakeChild.spawns = spawns
+    _FakeChild.killed = []
+    bench._ChildProc = _FakeChild
+    bench._emit = lambda payload: lines.append(json.loads(json.dumps(payload)))
+    assert bench.orchestrate() == 0
+    assert not _FakeChild.spawns, "orchestrator under-spawned"
+    return lines
+
+
+def test_orchestrator_happy_path(monkeypatch):
+    """One child serves every phase; a cumulative line lands after each;
+    the tail line is the richest and is final (partial=False)."""
+    bench = _load_bench(monkeypatch)
+    all_phases = list(bench.PHASES)
+    lines = _run_orchestrator(bench, [(all_phases, [
+        _ok("probe", device="TPU v5e", platform="tpu", n_devices=1),
+        _ok("flagship", flagship_imgs_per_sec=1000.0, step_time_ms=2.56,
+            mfu=0.41, preset="full"),
+        _ok("baseline", baseline_imgs_per_sec=100.0),
+        _ok("gpt", gpt={"step_time_ms": 50.0, "mfu": 0.35}),
+        _ok("overlap", overlap={"combiner_merged": True}),
+        None,
+    ])])
+    # first line precedes any backend touch and is already valid
+    assert lines[0]["partial"] is True and lines[0]["value"] == 0.0
+    tail = lines[-1]
+    assert tail["partial"] is False
+    assert tail["value"] == 1000.0 and tail["vs_baseline"] == 10.0
+    assert tail["device"] == "TPU v5e"
+    assert tail["gpt"]["mfu"] == 0.35
+    assert all(tail["phases"][p] == "ok" for p in bench.PHASES)
+    # every line is a self-contained superset of the one before it
+    assert len(lines) == 2 + len(bench.PHASES)
+
+
+def test_orchestrator_survives_hang_and_respawns(monkeypatch):
+    """A child wedged mid-flagship (the round-3 killer) costs exactly that
+    phase: the parent kills it, respawns for the remainder, and the tail
+    line still carries everything else."""
+    bench = _load_bench(monkeypatch)
+    lines = _run_orchestrator(bench, [
+        (list(bench.PHASES), [
+            _ok("probe", device="TPU v5e", platform="tpu", n_devices=1),
+            "hang",  # flagship compile wedged in C++
+        ]),
+        (["baseline", "gpt", "overlap"], [
+            _ok("baseline", baseline_imgs_per_sec=100.0),
+            _ok("gpt", gpt={"step_time_ms": 50.0}),
+            _ok("overlap", overlap={"combiner_merged": True}),
+            None,
+        ]),
+    ])
+    tail = lines[-1]
+    assert tail["phases"]["flagship"].startswith("timeout")
+    assert tail["phases"]["baseline"] == "ok"
+    assert tail["phases"]["overlap"] == "ok"
+    assert tail["value"] == 0.0  # flagship lost → headline honestly absent
+    assert _FakeChild.killed  # the wedged child was hard-killed
+
+
+def test_orchestrator_cpu_fallback_after_two_init_failures(monkeypatch):
+    """Two consecutive init failures degrade to the clearly-labeled CPU
+    smoke tier; the TPU error stays on the line."""
+    bench = _load_bench(monkeypatch)
+    init_fail = [{"phase": "__init__", "ok": False,
+                  "data": {"error": "TimeoutError: init exceeded 240s"}}]
+    all_phases = list(bench.PHASES)
+    lines = _run_orchestrator(bench, [
+        (all_phases, list(init_fail)),
+        (all_phases, list(init_fail)),
+        (all_phases, [  # post-fallback child, now on cpu
+            _ok("probe", device="cpu", platform="cpu", n_devices=8),
+            _ok("flagship", flagship_imgs_per_sec=50.0, preset="small"),
+            _ok("baseline", baseline_imgs_per_sec=25.0),
+            _ok("gpt", gpt={"step_time_ms": 400.0}),
+            _ok("overlap", overlap={"combiner_merged": True}),
+            None,
+        ]),
+    ])
+    tail = lines[-1]
+    assert os.environ.get("BENCH_PLATFORM") == "cpu"  # set for the fallback
+    assert tail["tpu_error"].startswith("TimeoutError")
+    assert tail["device"] == "cpu" and tail["value"] == 50.0
+    os.environ.pop("BENCH_PLATFORM", None)  # orchestrate mutated real env
+
+
+def test_orchestrator_no_cpu_fallback_env(monkeypatch):
+    """BENCH_NO_CPU_FALLBACK=1 restores fail-hard: two init failures end
+    the run with the error on the line and every phase unresolved."""
+    bench = _load_bench(monkeypatch, BENCH_NO_CPU_FALLBACK="1")
+    init_fail = [{"phase": "__init__", "ok": False,
+                  "data": {"error": "RuntimeError: UNAVAILABLE"}}]
+    all_phases = list(bench.PHASES)
+    lines = _run_orchestrator(bench, [
+        (all_phases, list(init_fail)),
+        (all_phases, list(init_fail)),
+    ])
+    tail = lines[-1]
+    assert tail["value"] == 0.0
+    assert tail["tpu_error"].startswith("RuntimeError")
+    assert all(tail["phases"][p].startswith("skipped") for p in bench.PHASES)
+    assert os.environ.get("BENCH_PLATFORM") is None
+
+
+def test_orchestrator_counts_silent_child_death_as_init_failure(monkeypatch):
+    """A child that dies before emitting ANY marker line (native crash in
+    the PJRT client during backend init — no Python exception, no __init__
+    report) must count toward the init-failure fallback policy instead of
+    burning one phase per crash."""
+    bench = _load_bench(monkeypatch)
+    all_phases = list(bench.PHASES)
+    lines = _run_orchestrator(bench, [
+        (all_phases, [None]),  # EOF with zero events
+        (all_phases, [None]),  # again → 2 init failures → CPU fallback
+        (all_phases, [
+            _ok("probe", device="cpu", platform="cpu", n_devices=8),
+            _ok("flagship", flagship_imgs_per_sec=50.0, preset="small"),
+            _ok("baseline", baseline_imgs_per_sec=25.0),
+            _ok("gpt", gpt={"step_time_ms": 400.0}),
+            _ok("overlap", overlap={"combiner_merged": True}),
+            None,
+        ]),
+    ])
+    tail = lines[-1]
+    assert tail["tpu_error"] == "child process died during backend init"
+    assert tail["value"] == 50.0 and tail["phases"]["probe"] == "ok"
+    os.environ.pop("BENCH_PLATFORM", None)  # orchestrate mutated real env

@@ -261,3 +261,37 @@ def test_cifar10_split_aware_format_fallthrough(tmp_path):
     assert x.shape == (20, 32, 32, 3)
     xt, _ = load_cifar10(str(tmp_path), train=False)  # pickle format
     assert xt.shape == (4, 32, 32, 3)
+
+
+def test_cifar10_partial_train_dir_falls_through(tmp_path):
+    """A pickle dir holding only data_batch_1 (interrupted extraction) must
+    not satisfy the train probe — load_cifar10 reads batches 1-5 and would
+    crash with a raw FileNotFoundError from open(). The probe requires all
+    five, so the complete bin dir wins (and with no alternative, the loader
+    raises its own clear FileNotFoundError)."""
+    from network_distributed_pytorch_tpu.data.cifar10 import cifar10_on_disk
+
+    py = tmp_path / "cifar-10-batches-py"
+    py.mkdir(parents=True)
+    with open(py / "data_batch_1", "wb") as f:
+        pickle.dump({"data": np.zeros((4, 3072), np.uint8),
+                     "labels": [0, 1, 2, 3]}, f)
+    # partial dir alone: train probe fails outright -> clear error path
+    assert cifar10_on_disk(str(tmp_path), train=True) is None
+    import pytest
+
+    with pytest.raises(FileNotFoundError, match="CIFAR-10 not found"):
+        load_cifar10(str(tmp_path), train=True)
+
+    # ...and it must not shadow a COMPLETE bin dir
+    bin_dir = tmp_path / "cifar-10-batches-bin"
+    bin_dir.mkdir()
+    rng = np.random.RandomState(7)
+    for i in range(1, 6):
+        np.concatenate(
+            [rng.randint(0, 10, (4, 1), dtype=np.uint8),
+             rng.randint(0, 256, (4, 3072), dtype=np.uint8)], axis=1,
+        ).tofile(bin_dir / f"data_batch_{i}.bin")
+    assert cifar10_on_disk(str(tmp_path), train=True) == str(bin_dir)
+    x, _ = load_cifar10(str(tmp_path), train=True)
+    assert x.shape == (20, 32, 32, 3)

@@ -277,3 +277,67 @@ def test_decode_cifar10_bin_out_params(monkeypatch):
         assert not big_x[:5].any() and not big_x[17:].any()  # no overwrite
     monkeypatch.setattr(build, "_lib", None)
     monkeypatch.setattr(build, "_load_attempted", False)
+
+
+def test_wordpiece_sparse_vocab_falls_back_to_python(tmp_path):
+    """Blank/duplicate vocab lines make line-number ids sparse;
+    NativeWordPiece.build assigns ids by list position, so the native
+    matcher must be REFUSED then (silent id compaction would feed wrong
+    embedding rows) and the front door must still produce line-number ids
+    via the Python matcher."""
+    from network_distributed_pytorch_tpu.data.wordpiece import WordPieceTokenizer
+
+    # line 4 blank (skipped -> gap), "the" duplicated (first id shadowed)
+    vf = tmp_path / "vocab.txt"
+    vf.write_text(
+        "[PAD]\n[UNK]\n[CLS]\n[SEP]\n\nthe\nmovie\nthe\n", encoding="utf-8"
+    )
+    tok = WordPieceTokenizer(str(vf), max_len=8)
+    assert sorted(tok.vocab.values()) != list(range(len(tok.vocab)))
+    assert tok._native_matcher() is None  # sparse -> no native table
+    out = tok(["the movie"])
+    # line-number ids: "the" = 7 (duplicate shadows line 5), "movie" = 6
+    np.testing.assert_array_equal(
+        out["input_ids"][0][:4], [tok.cls_id, 7, 6, tok.sep_id]
+    )
+
+
+def test_wordpiece_dense_vocab_still_uses_native(tmp_path):
+    """The dense-vocab gate must not disable the native matcher for a
+    well-formed vocab.txt."""
+    from network_distributed_pytorch_tpu.data.wordpiece import WordPieceTokenizer
+    from network_distributed_pytorch_tpu.native.build import native_available
+
+    if not native_available():
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    vf = tmp_path / "vocab.txt"
+    vf.write_text("[PAD]\n[UNK]\n[CLS]\n[SEP]\nthe\nmovie\n", encoding="utf-8")
+    tok = WordPieceTokenizer(str(vf), max_len=8)
+    assert tok._native_matcher() is not None
+
+
+def test_tokenizer_max_len_guards(tmp_path):
+    """max_len < 2 cannot reach the native encoders: the C side computes
+    cap = max_len - 2, and a negative cap cast to size_t would be a
+    multi-exabyte resize plus OOB CLS/SEP writes."""
+    import pytest
+
+    from network_distributed_pytorch_tpu.data.imdb import HashTokenizer
+    from network_distributed_pytorch_tpu.data.wordpiece import WordPieceTokenizer
+    from network_distributed_pytorch_tpu.native.build import native_available
+    from network_distributed_pytorch_tpu.native.loader import NativeWordPiece
+
+    vf = tmp_path / "vocab.txt"
+    vf.write_text("[PAD]\n[UNK]\n[CLS]\n[SEP]\n", encoding="utf-8")
+    with pytest.raises(ValueError, match="max_len"):
+        WordPieceTokenizer(str(vf), max_len=1)
+    with pytest.raises(ValueError, match="max_len"):
+        HashTokenizer(max_len=1)
+    if native_available():
+        native = NativeWordPiece.build(["[PAD]", "[UNK]", "[CLS]", "[SEP]"])
+        with pytest.raises(ValueError, match="max_len"):
+            native.encode([["x"]], 1, 2, 3, 0, max_len=0)
+        with pytest.raises(ValueError, match="max_len"):
+            native.encode_ascii(["x"], 1, 2, 3, 0, max_len=1)
