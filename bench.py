@@ -77,6 +77,13 @@ PHASE_BUDGET_S = {
     "overlap": int(os.environ.get("BENCH_OVERLAP_BUDGET_S", "240")),
 }
 PHASES = ("probe", "flagship", "baseline", "gpt", "overlap")
+# extra wait on a child's FIRST event only: process start + jax import +
+# the backend-init watchdog (BENCH_INIT_TIMEOUT_S, default 240 s) all
+# precede it. Without this, a respawned child that hangs at init would be
+# misclassified as a per-phase timeout (its phase budget expires before
+# the child's own init watchdog can report), and the 2-init-failure CPU
+# fallback would engage late or never.
+INIT_GRACE_S = int(os.environ.get("BENCH_INIT_GRACE_S", "300"))
 
 # Peak dense bf16 FLOP/s per chip by device_kind substring (public spec
 # sheets). Longest match wins ("v5 lite" before "v5").
@@ -585,6 +592,7 @@ def _merge(out: dict, phase: str, ok: bool, data: dict, status: dict) -> None:
     if phase == "probe":
         out["device"] = data["device"]
         out["platform"] = data["platform"]
+        out["n_devices"] = data["n_devices"]
     else:
         out.update(data)
     flag = out.get("flagship_imgs_per_sec")
@@ -621,7 +629,11 @@ def orchestrate() -> int:
         child_events = 0
         try:
             while pending:
-                budget = min(PHASE_BUDGET_S.get(pending[0], 240), left() - 15)
+                budget = min(
+                    PHASE_BUDGET_S.get(pending[0], 240)
+                    + (INIT_GRACE_S if child_events == 0 else 0),
+                    left() - 15,
+                )
                 if budget <= 0:
                     break
                 try:
@@ -670,6 +682,8 @@ def orchestrate() -> int:
             os.environ["BENCH_PLATFORM"] = "cpu"
             os.environ.pop("PALLAS_AXON_POOL_IPS", None)
             cpu_fallback = True
+            init_failures = 0  # the CPU tier gets its own failure budget —
+            # otherwise one early CPU hiccup would hit `>= 2` and abort
             pending = [p for p in PHASES if status.get(p) != "ok"]
         elif init_failures >= 2:
             break

@@ -133,15 +133,42 @@ def load_cifar10(
 
 
 def synthetic_cifar10(
-    n: int = 50000, seed: int = 0, num_classes: int = 10
-) -> Tuple[np.ndarray, np.ndarray]:
+    n: int = 50000,
+    seed: int = 0,
+    num_classes: int = 10,
+    class_sep: float = 0.5,
+    noise: float = 0.25,
+    label_noise: float = 0.0,
+    return_means: bool = False,
+):
     """Deterministic CIFAR-shaped class-blob data (32×32×3, normalized range),
-    learnable by the real models — the test/no-egress stand-in."""
+    learnable by the real models — the test/no-egress stand-in.
+
+    ``class_sep`` scales the class means against ``noise``'s per-pixel std:
+    the defaults are near-perfectly separable (smoke tests need fast
+    convergence), while e.g. ``class_sep=0.012`` puts the nearest-mean
+    (Bayes-optimal) accuracy near 0.85 — a task accuracy studies can FAIL
+    (round-3 verdict #3: both arms saturating at 1.0 proves nothing).
+    ``label_noise`` symmetrically resamples that fraction of labels AFTER
+    the images are drawn (the pixels keep the original class's blob).
+    ``return_means=True`` appends the TRUE class means to the return (the
+    Bayes-oracle inputs — an accuracy study must score its ceiling against
+    the generator's means, never means re-fit on the scored points, where
+    the self-term makes any task look solvable). Defaults reproduce the
+    historical draws bit-for-bit."""
     rng = np.random.RandomState(seed)
-    means = rng.randn(num_classes, 32, 32, 3).astype(np.float32) * 0.5
+    means = rng.randn(num_classes, 32, 32, 3).astype(np.float32) * class_sep
     labels = rng.randint(0, num_classes, size=n).astype(np.int32)
-    images = means[labels] + 0.25 * rng.randn(n, 32, 32, 3).astype(np.float32)
-    return np.clip(images, -1.0, 1.0), labels
+    images = means[labels] + noise * rng.randn(n, 32, 32, 3).astype(np.float32)
+    if label_noise > 0.0:
+        flip = rng.rand(n) < label_noise
+        labels = np.where(
+            flip, rng.randint(0, num_classes, size=n).astype(np.int32), labels
+        )
+    images = np.clip(images, -1.0, 1.0)
+    if return_means:
+        return images, labels, means
+    return images, labels
 
 
 def load_cifar10_or_synthetic(

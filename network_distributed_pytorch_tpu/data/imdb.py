@@ -99,10 +99,22 @@ class HashTokenizer:
 
 
 def synthetic_imdb(
-    n: int = 2048, seed: int = 0, num_words: int = 40
+    n: int = 2048,
+    seed: int = 0,
+    num_words: int = 40,
+    class_word_rate: float = 0.4,
+    label_noise: float = 0.0,
 ) -> Tuple[List[str], List[int]]:
     """Class-separable synthetic reviews: each class draws words from a
-    distinct vocabulary region, so real models can learn sentiment from it."""
+    distinct vocabulary region, so real models can learn sentiment from it.
+
+    ``class_word_rate`` is the probability each word carries class signal
+    (the rest come from a shared vocabulary); ``label_noise`` symmetrically
+    flips that fraction of labels AFTER text generation — flipped reviews
+    keep the original class's words, so no classifier can exceed
+    ``1 - label_noise/…`` on a split carrying the same noise (the knob that
+    makes accuracy studies falsifiable, round-3 verdict #3). Defaults
+    reproduce the historical draws bit-for-bit."""
     rng = np.random.RandomState(seed)
     pos_vocab = [f"good{i}" for i in range(50)] + ["great", "excellent", "wonderful"]
     neg_vocab = [f"bad{i}" for i in range(50)] + ["awful", "terrible", "boring"]
@@ -112,11 +124,16 @@ def synthetic_imdb(
         label = int(rng.randint(0, 2))
         vocab = pos_vocab if label else neg_vocab
         words = [
-            vocab[rng.randint(len(vocab))] if rng.rand() < 0.4 else common[rng.randint(len(common))]
+            vocab[rng.randint(len(vocab))]
+            if rng.rand() < class_word_rate
+            else common[rng.randint(len(common))]
             for _ in range(num_words)
         ]
         texts.append(" ".join(words))
         labels.append(label)
+    if label_noise > 0.0:
+        flips = rng.rand(n) < label_noise
+        labels = [1 - y if f else y for y, f in zip(labels, flips)]
     return texts, labels
 
 
@@ -127,6 +144,7 @@ def prepare_imdb(
     vocab_size: int = 30522,
     synthetic_n: int = 2048,
     seed: int = 714,
+    synthetic_kwargs: Optional[dict] = None,
 ) -> Tuple[dict, dict, bool]:
     """The ``prepare_IMDb`` equivalent (``ddp_init.py:68-83``): returns
     (train, val, is_real) where each split is
@@ -139,12 +157,16 @@ def prepare_imdb(
     ``DistilBertTokenizerFast`` token-for-token with no HF runtime
     (``tests/test_wordpiece.py``); otherwise the deterministic
     :class:`HashTokenizer` stands in (no-files-on-disk fallback).
+    ``synthetic_kwargs`` forwards to :func:`synthetic_imdb` (hardness knobs
+    for the accuracy study; ignored when real data is on disk).
     """
     if data_dir is not None and os.path.isdir(os.path.join(data_dir, "train")):
         texts, labels = read_imdb_split(os.path.join(data_dir, "train"))
         is_real = True
     else:
-        texts, labels = synthetic_imdb(synthetic_n, seed=seed)
+        texts, labels = synthetic_imdb(
+            synthetic_n, seed=seed, **(synthetic_kwargs or {})
+        )
         is_real = False
     train_texts, val_texts, train_labels, val_labels = train_val_split(
         texts, labels, test_size=0.2, seed=seed

@@ -1,18 +1,28 @@
-"""PowerSGD-vs-exact ACCURACY equivalence, end-to-end (round-2 verdict #4).
+"""PowerSGD-vs-exact ACCURACY equivalence, end-to-end (round-2 verdict #4,
+re-cut per round-3 verdict #3 so it can FAIL).
 
 The reference's core claim is that rank-r PowerSGD with error feedback
 matches exact-allreduce training accuracy at a fraction of the gradient
 bytes (``ddp_powersgd_guide_cifar10/reducer.py:43-170``; the repo never
 demonstrates it — no eval anywhere, SURVEY §4). Real CIFAR-10/aclImdb are
 environmentally blocked (zero egress), so this study runs the equivalence
-the sandbox allows: the SAME class-separable synthetic set, the SAME model
-and schedule, trained to eval-accuracy plateau under (a) exact allreduce
-and (b) PowerSGD, on a REAL 8-worker data-parallel mesh (virtual CPU
-devices — the same `psum` code path as ICI).
+the sandbox allows: the SAME synthetic set, the SAME model and schedule,
+trained to eval-accuracy plateau under (a) exact allreduce and (b)
+PowerSGD, on a REAL 8-worker data-parallel mesh (virtual CPU devices — the
+same `psum` code path as ICI).
+
+**The tasks are deliberately hard enough that neither arm can saturate**
+(round 3's class-separable set hit 1.000 by epoch 2 in both arms — a
+vacuous parity). CIFAR: class separation tuned so the nearest-mean
+(Bayes-optimal) classifier scores ≈0.85 on held-out data — the study
+computes and records that ceiling from the test split itself. IMDb: 12%
+symmetric label noise on train AND val (accuracy ceiling ≈0.94 even for a
+perfect classifier) plus a reduced class-word rate. An arm that degrades
+under compression now has ~15 points of headroom to fall.
 
 Outputs ``artifacts/ACCURACY_STUDY.json``: per-epoch eval accuracy for both
-arms, final/best accuracy delta, and measured bytes-on-wire per step with
-the compression ratio.
+arms, final/best accuracy delta, the task's measured accuracy ceiling, and
+measured bytes-on-wire per step with the compression ratio.
 
 Usage: python scripts/accuracy_study.py [--task cifar|imdb|both]
        [--max-epochs N] [--patience K]
@@ -95,9 +105,29 @@ def run_to_plateau(
     }
 
 
+CIFAR_CLASS_SEP = 0.012  # nearest-mean (Bayes) accuracy ≈ 0.85 at noise 0.25
+IMDB_LABEL_NOISE = 0.12
+IMDB_CLASS_WORD_RATE = 0.25
+
+
+def _nearest_mean_accuracy(x, y, true_means) -> float:
+    """Accuracy of the Bayes-optimal rule for the class-blob generator
+    (equal isotropic covariance ⇒ nearest class mean), scored with the
+    GENERATOR'S true means. Means re-fit on the scored points would be
+    vacuous: the self-term (||x||²/n_c) dwarfs the Bayes margin at low
+    class_sep and classifies every point to its own label."""
+    import numpy as np
+
+    flat = x.reshape(len(x), -1).astype(np.float64)
+    means = true_means.reshape(len(true_means), -1).astype(np.float64)
+    logits = flat @ means.T - 0.5 * (means**2).sum(1)
+    return float((logits.argmax(1) == y).mean())
+
+
 def cifar_study(max_epochs: int, patience: int) -> dict:
-    """ResNet-18 on class-blob CIFAR: exact-SGD (C2 semantics) vs PowerSGD
-    r=4 EF-momentum (C3 semantics), same data/model/lr/schedule."""
+    """ResNet-18 on class-blob CIFAR at Bayes-limited separability
+    (``CIFAR_CLASS_SEP``): exact-SGD (C2 semantics) vs PowerSGD r=4
+    EF-momentum (C3 semantics), same data/model/lr/schedule."""
     import jax
     import jax.numpy as jnp
 
@@ -119,9 +149,12 @@ def cifar_study(max_epochs: int, patience: int) -> dict:
 
     # ONE synthetic draw, split train/test: identical class means, disjoint
     # noise samples (a held-out set synthetic_cifar10 alone doesn't give)
-    images, labels = synthetic_cifar10(5120, seed=0)
+    images, labels, true_means = synthetic_cifar10(
+        5120, seed=0, class_sep=CIFAR_CLASS_SEP, return_means=True
+    )
     train_x, train_y = images[:4096], labels[:4096]
     test_x, test_y = images[4096:], labels[4096:]
+    ceiling = _nearest_mean_accuracy(test_x, test_y, true_means)
 
     mesh = make_mesh()
     model = resnet18(num_classes=10, norm="batch", stem="cifar", width=16)
@@ -179,11 +212,15 @@ def cifar_study(max_epochs: int, patience: int) -> dict:
 
     exact, psgd = arms["exact"], arms["powersgd_r4"]
     return {
-        "task": "cifar10_synthetic",
+        "task": "cifar10_synthetic_bayes_limited",
         "model": "resnet18_w16",
         "workers": mesh.size,
         "global_batch": batch_size,
         "lr": lr,
+        "hardness": {
+            "class_sep": CIFAR_CLASS_SEP,
+            "bayes_ceiling_nearest_mean": round(ceiling, 4),
+        },
         "arms": arms,
         "accuracy_delta_pts": round(
             100 * (exact["best_accuracy"] - psgd["best_accuracy"]), 2
@@ -217,8 +254,16 @@ def imdb_study(max_epochs: int, patience: int) -> dict:
 
     from network_distributed_pytorch_tpu.utils.losses import cross_entropy_loss
 
-    # distilbert_tiny's fixed vocab/positions (vocab 1024, max_len 64)
-    train, val, _ = prepare_imdb(max_len=64, synthetic_n=2048, vocab_size=1024)
+    # distilbert_tiny's fixed vocab/positions (vocab 1024, max_len 64);
+    # symmetric label noise rides BOTH splits, so even a perfect classifier
+    # is capped at ~1 - IMDB_LABEL_NOISE on val (its flipped labels are
+    # simply wrong) — the arm separation the round-3 study lacked
+    train, val, _ = prepare_imdb(
+        max_len=64, synthetic_n=2048, vocab_size=1024,
+        synthetic_kwargs=dict(
+            class_word_rate=IMDB_CLASS_WORD_RATE, label_noise=IMDB_LABEL_NOISE
+        ),
+    )
     mesh = make_mesh()
     model = distilbert_tiny(num_labels=2)
     sample = (
@@ -267,11 +312,16 @@ def imdb_study(max_epochs: int, patience: int) -> dict:
 
     exact, psgd = arms["exact"], arms["powersgd_r16"]
     return {
-        "task": "imdb_synthetic",
+        "task": "imdb_synthetic_label_noise",
         "model": "distilbert_tiny",
         "workers": mesh.size,
         "global_batch": batch_size,
         "lr": lr,
+        "hardness": {
+            "label_noise": IMDB_LABEL_NOISE,
+            "class_word_rate": IMDB_CLASS_WORD_RATE,
+            "accuracy_ceiling": round(1.0 - IMDB_LABEL_NOISE, 4),
+        },
         "arms": arms,
         "accuracy_delta_pts": round(
             100 * (exact["best_accuracy"] - psgd["best_accuracy"]), 2

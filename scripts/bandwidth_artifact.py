@@ -1,0 +1,345 @@
+"""The chip-fed bandwidth-study artifact (round-3 verdict #4).
+
+The reference exists to compare distributed training over in-node vs
+1/10/100 GbE fabrics (``/root/reference/README.md:1-2``) and never reports a
+single number. This script commits that table, fed with REAL measurements
+from both sides of the projection:
+
+- **structure** (8-virtual-device CPU mesh): compiles every reducer config's
+  distributed step and audits the COMPILED HLO for collective count and
+  payload (``experiments.bandwidth_study`` — the combiner's merges are
+  visible only there). The collective structure of the 8-way program is
+  device-independent; only its timing isn't.
+- **chip** (the real TPU): measures per-step compute time for the same
+  model/batch per config — AOT executable, fetch-to-observe timing
+  (``utils.timing``; ``block_until_ready`` lies on this platform).
+- **project**: combines them through the ring model in ``utils.bandwidth``
+  (``t_comm = 2(W-1)/W · B/β + n_coll·latency``, the PowerSGD paper's own
+  first-order model): projected step time on each fabric = chip compute
+  time + modeled comm time of the audited 8-way payload. Also emits a
+  full-preset row (ResNet-152/512, the reference's flagship config) fed by
+  the committed chip step times in ``artifacts/TPU_EVIDENCE.json`` and the
+  analytic payload (tested byte-equal to the audit,
+  ``tests/test_experiments.py``).
+
+Each phase persists into ``artifacts/BANDWIDTH.json`` incrementally, so a
+wedged TPU tunnel cannot destroy the structure half of the record.
+
+Usage:
+    python scripts/bandwidth_artifact.py structure   # CPU mesh (safe anywhere)
+    python scripts/bandwidth_artifact.py chip        # on the TPU tunnel
+    python scripts/bandwidth_artifact.py project     # combine + print table
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+OUT = os.path.join(REPO, "artifacts", "BANDWIDTH.json")
+
+# the per-config chip measurement set: every flat-mesh reducer row of
+# experiments.bandwidth_study (hier/localSGD/DiLoCo rows keep their CPU-mesh
+# timing — their scan/2-D-mesh structure doesn't exist on one chip)
+CHIP_CONFIGS = (
+    "exact",
+    "powersgd_r1",
+    "powersgd_r2",
+    "powersgd_r4",
+    "topk_1pct",
+    "signsgd",
+    "qsgd_int8",
+)
+N_WORKERS = 8  # the projected world: the audited 8-way program
+
+
+def _load() -> dict:
+    try:
+        with open(OUT) as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001 — first phase creates it
+        return {}
+
+
+def _save(art: dict) -> None:
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(art, f, indent=1)
+
+
+def _configs(seed: int = 714):
+    from network_distributed_pytorch_tpu.parallel import (
+        ExactReducer,
+        PowerSGDReducer,
+        QSGDReducer,
+        SignSGDReducer,
+        TopKReducer,
+    )
+
+    return {
+        "exact": (ExactReducer(), "sgd"),
+        "powersgd_r1": (
+            PowerSGDReducer(random_seed=seed, compression_rank=1, matricize="last"),
+            "ef_momentum",
+        ),
+        "powersgd_r2": (
+            PowerSGDReducer(random_seed=seed, compression_rank=2, matricize="last"),
+            "ef_momentum",
+        ),
+        "powersgd_r4": (
+            PowerSGDReducer(random_seed=seed, compression_rank=4, matricize="last"),
+            "ef_momentum",
+        ),
+        "topk_1pct": (TopKReducer(k_fraction=0.01), "ef_momentum"),
+        "signsgd": (SignSGDReducer(), "ef_momentum"),
+        "qsgd_int8": (QSGDReducer(random_seed=seed), "ef_momentum"),
+    }
+
+
+def phase_structure() -> None:
+    """8-virtual-device CPU mesh: run the full study harness; keep the
+    audited collective structure (and the CPU timings, labeled as such)."""
+    from network_distributed_pytorch_tpu.hostenv import force_cpu_devices
+
+    force_cpu_devices(8, replace=False, collective_timeout_s=120)
+    import jax
+
+    jax.config.update("jax_cpu_enable_async_dispatch", False)  # 1-core host
+
+    from network_distributed_pytorch_tpu.experiments import bandwidth_study
+
+    out = bandwidth_study.run(global_batch=256)
+    art = _load()
+    art["structure"] = {
+        "source": "8-virtual-device CPU mesh (collective structure is "
+        "device-independent; timings here are CPU and used only as fallback)",
+        "num_devices": out["num_devices"],
+        "results": out["results"],
+    }
+    art["recorded_unix_structure"] = int(time.time())
+    _save(art)
+    print(json.dumps({k: v["hlo_collectives"] for k, v in out["results"].items()}))
+
+
+def phase_chip(steps: int = 10, init_timeout_s: int = 240) -> None:
+    """Real-chip per-step compute time for each flat-mesh config — same
+    model/batch/loss as the structure phase (resnet18 w16, global batch
+    256, the study harness's small preset)."""
+    import threading
+
+    import jax
+
+    box: dict = {}
+
+    def worker():
+        try:
+            box["devices"] = jax.devices()
+        except BaseException as e:  # noqa: BLE001 — relayed
+            box["error"] = e
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    t.join(init_timeout_s)
+    if t.is_alive():
+        raise TimeoutError(f"backend init exceeded {init_timeout_s}s")
+    if "error" in box:
+        raise box["error"]
+
+    import jax.numpy as jnp
+
+    from network_distributed_pytorch_tpu.data import synthetic_cifar10
+    from network_distributed_pytorch_tpu.experiments.common import (
+        image_classifier_loss,
+    )
+    from network_distributed_pytorch_tpu.models import resnet18
+    from network_distributed_pytorch_tpu.parallel import make_mesh
+    from network_distributed_pytorch_tpu.parallel.trainer import make_train_step
+    from network_distributed_pytorch_tpu.utils.timing import wait_result
+
+    dev = box["devices"][0]
+    mesh = make_mesh()
+    model = resnet18(num_classes=10, norm="batch", stem="cifar", width=16)
+    images, labels = synthetic_cifar10(256, seed=714)
+    batch = (jnp.asarray(images), jnp.asarray(labels))
+    variables = model.init(
+        jax.random.PRNGKey(714), jnp.zeros((1, 32, 32, 3)), train=True
+    )
+    loss_fn = image_classifier_loss(model, has_batch_stats=True)
+
+    art = _load()
+    chip = art.setdefault("chip", {})
+    chip["device"] = getattr(dev, "device_kind", dev.platform)
+    chip["platform"] = dev.platform
+    chip["steps_timed"] = steps
+    times = chip.setdefault("compute_step_s", {})
+    for name, (reducer, algorithm) in _configs().items():
+        if name not in CHIP_CONFIGS:
+            continue
+        step = make_train_step(
+            loss_fn, reducer, variables["params"], learning_rate=0.001,
+            momentum=0.9, algorithm=algorithm, mesh=mesh, donate_state=False,
+        )
+        state = step.init_state(
+            variables["params"],
+            model_state={"batch_stats": variables["batch_stats"]},
+        )
+        compiled = step.fn.lower(state, batch).compile()
+        state, loss = compiled(state, batch)  # warmup
+        wait_result(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss = compiled(state, batch)
+        wait_result(loss)  # fetch-to-observe-completion, utils.timing
+        times[name] = (time.perf_counter() - t0) / steps
+        art["recorded_unix_chip"] = int(time.time())
+        _save(art)  # persist after EVERY config — a dying tunnel keeps all
+        print(f"# chip {name}: {times[name]*1e3:.2f} ms/step", flush=True)
+
+
+def _full_preset_row(art: dict) -> dict | None:
+    """ResNet-152/512 (the reference flagship, r=4): analytic payload
+    (byte-equal to the audit by test) + committed chip step times from
+    TPU_EVIDENCE.json."""
+    try:
+        with open(os.path.join(REPO, "artifacts", "TPU_EVIDENCE.json")) as f:
+            ev = json.load(f)
+    except Exception:  # noqa: BLE001
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    from network_distributed_pytorch_tpu.models import resnet152
+    from network_distributed_pytorch_tpu.parallel import (
+        ExactReducer,
+        PowerSGDReducer,
+    )
+    from network_distributed_pytorch_tpu.parallel.trainer import (
+        LOSS_SYNC_BITS,
+        _reducer_bits,
+    )
+
+    model = resnet152(num_classes=10, norm="batch", stem="imagenet")
+    shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=True
+        )
+    )["params"]
+    bits = {
+        "exact": _reducer_bits(ExactReducer(), shapes) + LOSS_SYNC_BITS,
+        "powersgd_r4": _reducer_bits(
+            PowerSGDReducer(random_seed=714, compression_rank=4, matricize="last"),
+            shapes,
+        )
+        + LOSS_SYNC_BITS,
+    }
+    rows = {}
+    for phase_name, cfg in (
+        ("powersgd_cifar10_full_bf16", "powersgd_r4"),
+        ("powersgd_cifar10_full_fp32", "powersgd_r4"),
+    ):
+        ph = ev.get("phases", {}).get(phase_name, {})
+        step_s = (ph.get("raw") or {}).get("mean_step_time_s")
+        if ph.get("ok") and step_s:
+            rows[phase_name] = {"config": cfg, "chip_step_s": step_s}
+    if not rows:
+        return None
+    return {
+        "model": "resnet152 global_batch 512 (reference flagship, "
+        "ddp_powersgd_guide_cifar10/ddp_init.py:26-36)",
+        "bits_per_step": bits,
+        "exact_over_powersgd_bytes": round(bits["exact"] / bits["powersgd_r4"], 1),
+        "chip_rows": rows,
+        "source": "analytic payload (tested byte-equal to HLO audit) + "
+        "TPU_EVIDENCE.json chip step times",
+    }
+
+
+def phase_project() -> None:
+    """Fuse structure + chip into the per-fabric table and print it."""
+    from network_distributed_pytorch_tpu.utils.bandwidth import (
+        bandwidth_table,
+        format_table,
+    )
+
+    art = _load()
+    structure = art.get("structure", {}).get("results", {})
+    chip_times = art.get("chip", {}).get("compute_step_s", {})
+    if not structure:
+        raise SystemExit("run the structure phase first")
+    tables, table_json = {}, {}
+    for name, rec in structure.items():
+        bits = rec.get("audited_bits_per_step")
+        if bits is None:  # scan rounds audit per-round; keep analytic per-step
+            bits = rec["bits_per_step"]
+        n_coll = sum(rec["hlo_collectives"].values())
+        compute_s = chip_times.get(name)
+        source = "chip"
+        if compute_s is None:
+            compute_s = rec["measured_step_s"]
+            source = "cpu-mesh fallback"
+        table = bandwidth_table(bits, compute_s, N_WORKERS, n_coll)
+        tables[name] = table
+        table_json[name] = {
+            "compute_s": compute_s,
+            "compute_source": source,
+            "bits_per_step": bits,
+            "n_collectives": n_coll,
+            "fabrics": {
+                f: {
+                    "comm_time_s": e.comm_time_s,
+                    "step_time_s": e.step_time_s,
+                    "comm_fraction": round(e.comm_fraction, 4),
+                }
+                for f, e in table.items()
+            },
+        }
+    art["projection"] = {
+        "model": "ring allreduce t = 2(W-1)/W * B/beta + n_coll*latency "
+        "(utils.bandwidth), W=8, serialized comm/compute upper bound",
+        "workers": N_WORKERS,
+        "table": table_json,
+    }
+    full = _full_preset_row(art)
+    if full:
+        art["full_preset"] = full
+    art["recorded_unix_projection"] = int(time.time())
+    _save(art)
+    print(format_table(tables))
+    exact = table_json.get("exact", {})
+    speedups = {}
+    for name, rec in table_json.items():
+        if name == "exact" or not exact:
+            continue
+        speedups[name] = {
+            f: round(
+                exact["fabrics"][f]["step_time_s"] / rec["fabrics"][f]["step_time_s"],
+                2,
+            )
+            for f in rec["fabrics"]
+        }
+    art["speedup_vs_exact"] = speedups
+    _save(art)
+    print(json.dumps({"speedup_vs_exact_1GbE": {
+        k: v.get("1GbE") for k, v in speedups.items()
+    }}))
+
+
+def main() -> int:
+    phase = sys.argv[1] if len(sys.argv) > 1 else "project"
+    if phase == "structure":
+        phase_structure()
+    elif phase == "chip":
+        phase_chip()
+    elif phase == "project":
+        phase_project()
+    else:
+        raise SystemExit(f"unknown phase {phase!r}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

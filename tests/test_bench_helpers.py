@@ -61,8 +61,9 @@ def test_merge_builds_value_and_ratio(monkeypatch):
     bench = _load_bench(monkeypatch)
     out, status = {"value": 0.0, "vs_baseline": 0.0}, {}
     bench._merge(out, "probe", True, {"device": "TPU v5e", "platform": "tpu",
-                                      "n_devices": 1}, status)
+                                      "n_devices": 4}, status)
     assert out["device"] == "TPU v5e" and status["probe"] == "ok"
+    assert out["n_devices"] == 4  # the measured device count rides the line
     bench._merge(out, "flagship", True,
                  {"flagship_imgs_per_sec": 1000.0, "step_time_ms": 2.0}, status)
     assert out["value"] == 1000.0  # flagship IS the headline metric
@@ -80,6 +81,7 @@ class _FakeChild:
 
     spawns = []  # [(phases, script), ...] consumed in order
     killed = []
+    timeouts = []  # budget passed to every next_event call, in order
 
     def __init__(self, phases):
         assert _FakeChild.spawns, f"unexpected spawn for phases={phases}"
@@ -87,6 +89,7 @@ class _FakeChild:
         assert list(phases) == expect, (phases, expect)
 
     def next_event(self, timeout_s):
+        _FakeChild.timeouts.append(round(timeout_s))
         ev = self.script.pop(0)
         if ev == "hang":
             raise queue.Empty()
@@ -104,6 +107,7 @@ def _run_orchestrator(bench, spawns):
     lines = []
     _FakeChild.spawns = spawns
     _FakeChild.killed = []
+    _FakeChild.timeouts = []
     bench._ChildProc = _FakeChild
     bench._emit = lambda payload: lines.append(json.loads(json.dumps(payload)))
     assert bench.orchestrate() == 0
@@ -228,4 +232,60 @@ def test_orchestrator_counts_silent_child_death_as_init_failure(monkeypatch):
     tail = lines[-1]
     assert tail["tpu_error"] == "child process died during backend init"
     assert tail["value"] == 50.0 and tail["phases"]["probe"] == "ok"
+    os.environ.pop("BENCH_PLATFORM", None)  # orchestrate mutated real env
+
+
+def test_first_event_budget_includes_init_grace(monkeypatch):
+    """A child's FIRST event window covers process start + jax import + the
+    backend-init watchdog; later phases in the same child get the bare
+    phase budget. A respawned child's first phase gets the grace again —
+    otherwise an init hang after a mid-run kill would be misclassified as
+    a per-phase timeout and never count toward the CPU fallback."""
+    bench = _load_bench(monkeypatch)
+    lines = _run_orchestrator(bench, [
+        (list(bench.PHASES), [
+            _ok("probe", device="TPU v5e", platform="tpu", n_devices=1),
+            "hang",  # flagship wedged -> kill -> respawn
+        ]),
+        (["baseline", "gpt", "overlap"], [
+            _ok("baseline", baseline_imgs_per_sec=100.0),
+            _ok("gpt", gpt={}),
+            _ok("overlap", overlap={}),
+            None,
+        ]),
+    ])
+    t = _FakeChild.timeouts
+    g = bench.INIT_GRACE_S
+    assert t[0] == bench.PHASE_BUDGET_S["probe"] + g     # child 1, first event
+    assert t[1] == bench.PHASE_BUDGET_S["flagship"]      # same child, no grace
+    assert t[2] == bench.PHASE_BUDGET_S["baseline"] + g  # respawn, grace again
+    assert t[3] == bench.PHASE_BUDGET_S["gpt"]
+    assert lines[-1]["phases"]["baseline"] == "ok"
+
+
+def test_cpu_fallback_gets_fresh_init_failure_budget(monkeypatch):
+    """After the fallback engages, init_failures is reset: one CPU-child
+    hiccup (timeout/early exit) must trigger a respawn, not abort the whole
+    orchestration."""
+    bench = _load_bench(monkeypatch)
+    init_fail = [{"phase": "__init__", "ok": False,
+                  "data": {"error": "TimeoutError: init exceeded 240s"}}]
+    all_phases = list(bench.PHASES)
+    lines = _run_orchestrator(bench, [
+        (all_phases, list(init_fail)),
+        (all_phases, list(init_fail)),       # -> CPU fallback
+        (all_phases, [
+            _ok("probe", device="cpu", platform="cpu", n_devices=8),
+            "hang",                           # CPU child wedges on flagship
+        ]),
+        (["baseline", "gpt", "overlap"], [   # ...and is respawned, not aborted
+            _ok("baseline", baseline_imgs_per_sec=25.0),
+            _ok("gpt", gpt={}),
+            _ok("overlap", overlap={}),
+            None,
+        ]),
+    ])
+    tail = lines[-1]
+    assert tail["phases"]["flagship"].startswith("timeout")
+    assert tail["phases"]["overlap"] == "ok"
     os.environ.pop("BENCH_PLATFORM", None)  # orchestrate mutated real env
