@@ -112,7 +112,7 @@ def synthetic_imdb(
     (the rest come from a shared vocabulary); ``label_noise`` symmetrically
     flips that fraction of labels AFTER text generation — flipped reviews
     keep the original class's words, so no classifier can exceed
-    ``1 - label_noise/…`` on a split carrying the same noise (the knob that
+    ``1 - label_noise`` on a split carrying the same noise (the knob that
     makes accuracy studies falsifiable, round-3 verdict #3). Defaults
     reproduce the historical draws bit-for-bit."""
     rng = np.random.RandomState(seed)

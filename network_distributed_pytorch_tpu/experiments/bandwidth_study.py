@@ -31,6 +31,67 @@ from ..utils.timing import wait_result
 from .common import image_classifier_loss
 
 
+def flat_reducer_configs(seed: int, reducer_ranks=(1, 2, 4)) -> Dict:
+    """The study's flat-mesh reducer matrix: ``name -> (reducer, algorithm)``.
+
+    One table, shared by ``run`` (structure/timing on the attached mesh) and
+    ``scripts/bandwidth_artifact.py`` (per-config chip timing) — the two
+    phases are joined by dict key, so a drifted duplicate would silently
+    pair config X's timing with config Y's audited payload.
+    """
+    from ..parallel import QSGDReducer, SignSGDReducer, TopKReducer
+
+    configs = {"exact": (ExactReducer(), "sgd")}
+    for r in reducer_ranks:
+        configs[f"powersgd_r{r}"] = (
+            PowerSGDReducer(random_seed=seed, compression_rank=r, matricize="last"),
+            "ef_momentum",
+        )
+    # the rest of the compressor family (beyond parity): the other classic
+    # points on the bandwidth/fidelity curve, same EF-chain interface
+    configs["topk_1pct"] = (TopKReducer(k_fraction=0.01), "ef_momentum")
+    configs["signsgd"] = (SignSGDReducer(), "ef_momentum")
+    configs["qsgd_int8"] = (QSGDReducer(random_seed=seed), "ef_momentum")
+    return configs
+
+
+SCAN_SYNC_EVERY = 8  # inner steps per compiled round for the scan rows
+
+
+def scan_round_builders(
+    loss_fn,
+    params,
+    *,
+    mesh,
+    seed: int,
+    learning_rate: float = 0.001,
+    momentum: float = 0.9,
+    sync_every: int = SCAN_SYNC_EVERY,
+) -> Dict:
+    """``name -> compiled-round train fn`` for the communication-AVOIDANCE
+    rows (local SGD and DiLoCo+PowerSGD). One builder, shared by ``run``
+    and ``scripts/bandwidth_artifact.py``'s chip phase: the two records are
+    joined by these names (and amortized by this ``sync_every``), so a
+    hand-copied duplicate could silently stop matching and the projection
+    would drop the rows to the CPU fallback with no error.
+    """
+    from ..parallel import make_diloco_train_fn, make_local_sgd_train_fn
+
+    return {
+        f"local_sgd_h{sync_every}": make_local_sgd_train_fn(
+            loss_fn, params, learning_rate=learning_rate, momentum=momentum,
+            sync_every=sync_every, mesh=mesh, donate_state=False,
+        ),
+        f"diloco_psgd_r4_h{sync_every}": make_diloco_train_fn(
+            loss_fn, params, inner_learning_rate=learning_rate,
+            sync_every=sync_every, mesh=mesh, donate_state=False,
+            reducer=PowerSGDReducer(
+                random_seed=seed, compression_rank=4, matricize="last"
+            ),
+        ),
+    }
+
+
 def _measure_step_time(step, state, batch, steps: int = 5) -> float:
     state, loss = step(state, batch)  # compile + warmup
     wait_result(loss)
@@ -66,19 +127,7 @@ def run(
     )
     loss_fn = image_classifier_loss(model, has_batch_stats=True)
 
-    configs = {"exact": (ExactReducer(), "sgd")}
-    for r in reducer_ranks:
-        configs[f"powersgd_r{r}"] = (
-            PowerSGDReducer(random_seed=config.seed, compression_rank=r, matricize="last"),
-            "ef_momentum",
-        )
-    # the rest of the compressor family (beyond parity): the other classic
-    # points on the bandwidth/fidelity curve, same EF-chain interface
-    from ..parallel import QSGDReducer, SignSGDReducer, TopKReducer
-
-    configs["topk_1pct"] = (TopKReducer(k_fraction=0.01), "ef_momentum")
-    configs["signsgd"] = (SignSGDReducer(), "ef_momentum")
-    configs["qsgd_int8"] = (QSGDReducer(random_seed=config.seed), "ef_momentum")
+    configs = flat_reducer_configs(config.seed, reducer_ranks)
 
     # fabric-aware hierarchy (parallel.hierarchical): exact over a fast
     # 'ici' sub-axis, PowerSGD only across the slow 'dcn' axis — the
@@ -117,10 +166,9 @@ def run(
     # fed from the COMPILED round like every other row; the one adjustment
     # is the in-scan loss pmean, which appears once in HLO text but
     # executes sync_every times per round (see parallel.localsgd).
-    from ..parallel import make_diloco_train_fn, make_local_sgd_train_fn
     from ..parallel.trainer import LOSS_SYNC_BITS
 
-    sync_every = 8
+    sync_every = SCAN_SYNC_EVERY
     lbatches = tuple(
         jnp.broadcast_to(b[None], (sync_every,) + b.shape) for b in batch
     )
@@ -158,25 +206,12 @@ def run(
             "projected_step_s": {f: e.step_time_s for f, e in table.items()},
         }
 
-    measure_round(
-        f"local_sgd_h{sync_every}",
-        make_local_sgd_train_fn(
-            loss_fn, variables["params"], learning_rate=config.learning_rate,
-            momentum=config.momentum, sync_every=sync_every, mesh=mesh,
-            donate_state=False,
-        ),
-    )
-    measure_round(
-        f"diloco_psgd_r4_h{sync_every}",
-        make_diloco_train_fn(
-            loss_fn, variables["params"],
-            inner_learning_rate=config.learning_rate, sync_every=sync_every,
-            mesh=mesh, donate_state=False,
-            reducer=PowerSGDReducer(
-                random_seed=config.seed, compression_rank=4, matricize="last"
-            ),
-        ),
-    )
+    for name, round_ in scan_round_builders(
+        loss_fn, variables["params"], mesh=mesh, seed=config.seed,
+        learning_rate=config.learning_rate, momentum=config.momentum,
+        sync_every=sync_every,
+    ).items():
+        measure_round(name, round_)
     for name, (reducer, algorithm) in configs.items():
         step_mesh, step_axis = mesh, "data"
         if name.startswith("hier_"):

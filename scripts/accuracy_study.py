@@ -16,9 +16,11 @@ same `psum` code path as ICI).
 vacuous parity). CIFAR: class separation tuned so the nearest-mean
 (Bayes-optimal) classifier scores ≈0.85 on held-out data — the study
 computes and records that ceiling from the test split itself. IMDb: 12%
-symmetric label noise on train AND val (accuracy ceiling ≈0.94 even for a
-perfect classifier) plus a reduced class-word rate. An arm that degrades
-under compression now has ~15 points of headroom to fall.
+symmetric label noise on train AND val — the flip is deterministic
+(``y -> 1-y`` for the noised fraction), so even a perfect classifier scores
+exactly ``1 - 0.12 = 0.88`` on the noised val split (the recorded
+``accuracy_ceiling``) — plus a reduced class-word rate. An arm that
+degrades under compression now has 10+ points of headroom to fall.
 
 Outputs ``artifacts/ACCURACY_STUDY.json``: per-epoch eval accuracy for both
 arms, final/best accuracy delta, the task's measured accuracy ceiling, and

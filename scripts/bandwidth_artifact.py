@@ -43,8 +43,11 @@ sys.path.insert(0, REPO)
 OUT = os.path.join(REPO, "artifacts", "BANDWIDTH.json")
 
 # the per-config chip measurement set: every flat-mesh reducer row of
-# experiments.bandwidth_study (hier/localSGD/DiLoCo rows keep their CPU-mesh
-# timing — their scan/2-D-mesh structure doesn't exist on one chip)
+# experiments.bandwidth_study. The scan rows (localSGD/DiLoCo) are ALSO
+# chip-timed, via the shared scan_round_builders below; only the
+# hierarchical row keeps its CPU-mesh timing (its 2-D dcn×ici mesh doesn't
+# exist on one chip), and the projection's cross-tier guard excludes it
+# from speedup_vs_exact rather than ratio it against chip rows
 CHIP_CONFIGS = (
     "exact",
     "powersgd_r1",
@@ -72,32 +75,14 @@ def _save(art: dict) -> None:
 
 
 def _configs(seed: int = 714):
-    from network_distributed_pytorch_tpu.parallel import (
-        ExactReducer,
-        PowerSGDReducer,
-        QSGDReducer,
-        SignSGDReducer,
-        TopKReducer,
+    # the ONE config table, shared with the structure phase's harness — the
+    # chip and structure records are joined by these keys (see the helper's
+    # docstring for why a local duplicate would be a correctness hazard)
+    from network_distributed_pytorch_tpu.experiments.bandwidth_study import (
+        flat_reducer_configs,
     )
 
-    return {
-        "exact": (ExactReducer(), "sgd"),
-        "powersgd_r1": (
-            PowerSGDReducer(random_seed=seed, compression_rank=1, matricize="last"),
-            "ef_momentum",
-        ),
-        "powersgd_r2": (
-            PowerSGDReducer(random_seed=seed, compression_rank=2, matricize="last"),
-            "ef_momentum",
-        ),
-        "powersgd_r4": (
-            PowerSGDReducer(random_seed=seed, compression_rank=4, matricize="last"),
-            "ef_momentum",
-        ),
-        "topk_1pct": (TopKReducer(k_fraction=0.01), "ef_momentum"),
-        "signsgd": (SignSGDReducer(), "ef_momentum"),
-        "qsgd_int8": (QSGDReducer(random_seed=seed), "ef_momentum"),
-    }
+    return flat_reducer_configs(seed)
 
 
 def phase_structure() -> None:
@@ -126,9 +111,12 @@ def phase_structure() -> None:
 
 
 def phase_chip(steps: int = 10, init_timeout_s: int = 240) -> None:
-    """Real-chip per-step compute time for each flat-mesh config — same
-    model/batch/loss as the structure phase (resnet18 w16, global batch
-    256, the study harness's small preset)."""
+    """Real-chip PER-WORKER compute time for each flat-mesh config — same
+    model/loss as the structure phase (resnet18 w16), but batch 256 //
+    N_WORKERS = 32 images: the projection models an 8-worker world where
+    each worker computes its own shard, so the compute term must be one
+    worker's share, not the whole global batch on one chip (which would
+    overstate compute 8× and understate every comm fraction)."""
     import threading
 
     import jax
@@ -163,7 +151,8 @@ def phase_chip(steps: int = 10, init_timeout_s: int = 240) -> None:
     dev = box["devices"][0]
     mesh = make_mesh()
     model = resnet18(num_classes=10, norm="batch", stem="cifar", width=16)
-    images, labels = synthetic_cifar10(256, seed=714)
+    per_worker = 256 // N_WORKERS  # one worker's shard of the study batch
+    images, labels = synthetic_cifar10(per_worker, seed=714)
     batch = (jnp.asarray(images), jnp.asarray(labels))
     variables = model.init(
         jax.random.PRNGKey(714), jnp.zeros((1, 32, 32, 3)), train=True
@@ -175,6 +164,16 @@ def phase_chip(steps: int = 10, init_timeout_s: int = 240) -> None:
     chip["device"] = getattr(dev, "device_kind", dev.platform)
     chip["platform"] = dev.platform
     chip["steps_timed"] = steps
+    if chip.get("batch_per_worker") != per_worker:
+        # batch semantics changed since the stored rows were measured (or
+        # first run): drop them — a resume must never mix timings of
+        # different per-worker batches under one "chip" label
+        chip.pop("compute_step_s", None)
+    chip["batch_per_worker"] = per_worker
+    chip["note"] = (
+        f"per-worker compute: batch {per_worker} on one chip = one worker's "
+        f"shard of the {N_WORKERS}-worker global batch 256"
+    )
     times = chip.setdefault("compute_step_s", {})
     for name, (reducer, algorithm) in _configs().items():
         if name not in CHIP_CONFIGS:
@@ -198,6 +197,42 @@ def phase_chip(steps: int = 10, init_timeout_s: int = 240) -> None:
         art["recorded_unix_chip"] = int(time.time())
         _save(art)  # persist after EVERY config — a dying tunnel keeps all
         print(f"# chip {name}: {times[name]*1e3:.2f} ms/step", flush=True)
+
+    # the scan rows too (local SGD / DiLoCo): without chip timing for them,
+    # the projection would compare chip-fed flat rows against CPU-fallback
+    # scan rows, and the headline speedup-vs-exact would cross tiers.
+    # Per inner step: one compiled ROUND = sync_every scanned steps.
+    # Builders AND names come from the structure phase's own module so the
+    # join keys cannot drift (see scan_round_builders' docstring).
+    from network_distributed_pytorch_tpu.experiments.bandwidth_study import (
+        SCAN_SYNC_EVERY,
+        scan_round_builders,
+    )
+
+    sync_every = SCAN_SYNC_EVERY
+    lbatches = tuple(
+        jnp.broadcast_to(b[None], (sync_every,) + b.shape) for b in batch
+    )
+    rounds = scan_round_builders(
+        loss_fn, variables["params"], mesh=mesh, seed=714,
+    )
+    n_rounds = max(1, steps // sync_every)
+    for name, round_ in rounds.items():
+        state = round_.init_state(
+            variables["params"],
+            model_state={"batch_stats": variables["batch_stats"]},
+        )
+        compiled = round_.fn.lower(state, lbatches).compile()
+        state, losses = compiled(state, lbatches)  # warmup
+        wait_result(losses)
+        t0 = time.perf_counter()
+        for _ in range(n_rounds):
+            state, losses = compiled(state, lbatches)
+        wait_result(losses)  # fetch-to-observe-completion, utils.timing
+        times[name] = (time.perf_counter() - t0) / (n_rounds * sync_every)
+        art["recorded_unix_chip"] = int(time.time())
+        _save(art)
+        print(f"# chip {name}: {times[name]*1e3:.2f} ms/inner-step", flush=True)
 
 
 def _full_preset_row(art: dict) -> dict | None:
@@ -276,6 +311,12 @@ def phase_project() -> None:
         if bits is None:  # scan rounds audit per-round; keep analytic per-step
             bits = rec["bits_per_step"]
         n_coll = sum(rec["hlo_collectives"].values())
+        if rec.get("sync_every"):
+            # scan rows: the audited HLO is one ROUND (sync_every inner
+            # steps). Amortize the latency term per step exactly the way
+            # the study harness does — the in-scan loss pmean appears once
+            # in HLO text but executes sync_every times per round
+            n_coll = (n_coll + rec["sync_every"] - 1) / rec["sync_every"]
         compute_s = chip_times.get(name)
         source = "chip"
         if compute_s is None:
@@ -313,6 +354,14 @@ def phase_project() -> None:
     speedups = {}
     for name, rec in table_json.items():
         if name == "exact" or not exact:
+            continue
+        if rec["compute_source"] != exact["compute_source"]:
+            # never ratio a chip-fed row against a CPU-fallback row (or
+            # vice versa) — a cross-tier "speedup" would be fabricated
+            speedups[name] = {
+                "skipped": f"compute_source {rec['compute_source']!r} != "
+                f"exact's {exact['compute_source']!r}"
+            }
             continue
         speedups[name] = {
             f: round(

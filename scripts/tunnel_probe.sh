@@ -1,0 +1,35 @@
+#!/bin/sh
+# Poll the axon TPU tunnel until backend init succeeds, then exit 0.
+# A wedged remote pool (e.g. after a SIGKILLed client mid-compile) recovers
+# on its own lease/compile completion; this just tells us WHEN.
+# Usage: scripts/tunnel_probe.sh [interval_s] [max_tries]
+INTERVAL="${1:-300}"
+TRIES="${2:-40}"
+i=0
+while [ "$i" -lt "$TRIES" ]; do
+    i=$((i+1))
+    if timeout 90 python - <<'EOF'
+import threading, sys
+box = {}
+def w():
+    try:
+        import jax
+        box["d"] = jax.devices()
+    except BaseException as e:
+        box["e"] = e
+t = threading.Thread(target=w, daemon=True)
+t.start(); t.join(75)
+if box.get("d"):
+    print("TUNNEL-OK", box["d"], flush=True)
+    sys.exit(0)
+sys.exit(1)
+EOF
+    then
+        echo "tunnel recovered after $i probes"
+        exit 0
+    fi
+    echo "probe $i: tunnel still wedged $(date -u +%H:%M:%S)"
+    sleep "$INTERVAL"
+done
+echo "gave up after $TRIES probes"
+exit 1
