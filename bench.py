@@ -38,6 +38,12 @@ handler can run): this file is now TWO programs.
   PJRT client), then runs its phases, printing one marker-prefixed JSON
   line per phase. One child runs many phases (backend init is paid once);
   only after a kill does a fresh child re-pay init for the remainder.
+  Each non-probe phase also self-deadlines in a daemon thread at its
+  budget minus a margin (``_run_with_deadline``): an overlong compile is
+  ABANDONED with an error marker instead of letting the parent SIGKILL the
+  child — a SIGKILL mid-compile wedges the tunnel's remote side for a long
+  time (observed >1 h), and abandoning keeps the initialized backend alive
+  for the remaining phases.
 
 If backend init fails twice in a row the parent degrades to the CPU smoke
 tier in clearly-labeled form (``"device": "cpu"``, ``"preset": "small"``)
@@ -73,7 +79,7 @@ PHASE_BUDGET_S = {
     "probe": int(os.environ.get("BENCH_PROBE_BUDGET_S", "300")),
     "flagship": int(os.environ.get("BENCH_FLAGSHIP_BUDGET_S", "330")),
     "baseline": int(os.environ.get("BENCH_BASELINE_BUDGET_S", "240")),
-    "gpt": int(os.environ.get("BENCH_GPT_BUDGET_S", "300")),
+    "gpt": int(os.environ.get("BENCH_GPT_BUDGET_S", "420")),
     "overlap": int(os.environ.get("BENCH_OVERLAP_BUDGET_S", "240")),
 }
 PHASES = ("probe", "flagship", "baseline", "gpt", "overlap")
@@ -496,17 +502,88 @@ _PHASE_FNS = {
 }
 
 
+class _PhaseAbandoned(TimeoutError):
+    """A phase blew its child-side deadline; its daemon thread may still be
+    draining on the device (relevant to later phases' timing honesty)."""
+
+
+def _run_with_deadline(name: str, fn, deadline_s: float) -> dict:
+    """Run one phase in a daemon thread; on deadline, raise instead of
+    letting the parent SIGKILL the child mid-compile.
+
+    The distinction matters beyond this process: a SIGKILLed client wedges
+    the one-shot TPU tunnel's remote side (observed: a kill inside the GPT
+    compile left backend init hanging for over an hour afterwards), and the
+    respawned child then re-pays — or fails — the wedge-prone init. A
+    child-side timeout instead reports the phase as an error marker and
+    keeps the SAME process (and its already-initialized backend) for the
+    remaining phases. The abandoned thread stays alive as a daemon; jax
+    dispatch is thread-safe, so the next phase can proceed while it drains.
+
+    The parent's per-event budget remains the backstop for true C-level
+    hangs that stall this thread's join return.
+    """
+    box: dict = {}
+
+    def worker():
+        try:
+            box["out"] = fn()
+        except BaseException as e:  # noqa: BLE001 — relayed to main thread
+            box["error"] = e
+
+    t = threading.Thread(target=worker, daemon=True, name=f"phase-{name}")
+    t.start()
+    t.join(deadline_s)
+    if t.is_alive():
+        raise _PhaseAbandoned(
+            f"phase {name} exceeded its child-side deadline of"
+            f" {int(deadline_s)}s (abandoned, child continues)"
+        )
+    if "error" in box:
+        e = box["error"]
+        raise e if isinstance(e, Exception) else RuntimeError(repr(e))
+    return box["out"]
+
+
 def child_main(phase_list: list) -> int:
     try:
         _init_backend()
     except BaseException as e:  # noqa: BLE001 — parent owns retry policy
         _child_emit("__init__", False, {"error": f"{type(e).__name__}: {e}"[:400]})
         return 1
+    # the parent's ABSOLUTE deadline (unix seconds): the child must finish —
+    # or abandon — each phase before the parent's own budget math
+    # (min(phase budget, global remaining)) would SIGKILL it mid-compile,
+    # which wedges the tunnel. Static phase budgets alone are not enough:
+    # near the end of the global window the parent's cap is the SMALLER
+    # `left() - 15`, so the child's deadline must track the same clock.
+    deadline_unix = float(os.environ.get("BENCH_DEADLINE_UNIX", "0")) or None
+    abandoned: list = []
     for name in phase_list:
         try:
-            _child_emit(name, True, _PHASE_FNS[name]())
+            budget = float(PHASE_BUDGET_S.get(name, 240)) - 45.0
+            if deadline_unix is not None:
+                budget = min(budget, deadline_unix - time.time() - 30.0)
+            if name == "probe":
+                data = _PHASE_FNS[name]()
+            elif budget <= 0:
+                raise TimeoutError(
+                    f"phase {name} skipped: global deadline reached"
+                )
+            else:
+                data = _run_with_deadline(
+                    name, _PHASE_FNS[name], max(30.0, budget)
+                )
+            if abandoned:
+                # an earlier abandoned phase's daemon thread may still be
+                # compiling/executing on the device — timed numbers from
+                # this phase shared the chip with that drain; say so
+                data["concurrent_abandoned"] = list(abandoned)
+            _child_emit(name, True, data)
         except Exception as e:  # noqa: BLE001 — a phase crash must not
             # take down the phases behind it
+            if isinstance(e, _PhaseAbandoned):
+                abandoned.append(name)
             _child_emit(name, False, {"error": f"{type(e).__name__}: {e}"[:400]})
     return 0
 
@@ -642,6 +719,11 @@ def _merge(
 
 def orchestrate() -> int:
     t_start = time.time()
+    # children self-deadline against the SAME absolute clock the parent
+    # kills by, so near the end of the window the child still reports (and
+    # survives) before the parent's `left() - 15` cap would SIGKILL it
+    # mid-compile — the tunnel-wedging outcome (_run_with_deadline)
+    os.environ["BENCH_DEADLINE_UNIX"] = str(t_start + TOTAL_DEADLINE_S)
 
     def left() -> float:
         return TOTAL_DEADLINE_S - (time.time() - t_start)

@@ -46,6 +46,7 @@ def run(
     steps_per_epoch: int = 20,
     max_steps_per_epoch: Optional[int] = None,
     remat: bool = False,
+    scan_layers: bool = False,
 ) -> Dict:
     config = config or ExperimentConfig(
         training_epochs=1, global_batch_size=32, learning_rate=0.1,
@@ -60,6 +61,7 @@ def run(
     model = make(
         vocab_size=vocab, max_position_embeddings=seq_len,
         dtype=jnp.dtype(config.compute_dtype), remat=remat,
+        scan_layers=scan_layers,
     )
     ids = jnp.zeros((1, seq_len), jnp.int32)
     params = model.init(jax.random.PRNGKey(config.seed), ids)["params"]

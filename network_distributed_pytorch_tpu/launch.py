@@ -89,6 +89,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="rematerialize transformer blocks in the backward pass"
              " (gpt_lm, powersgd_imdb)",
     )
+    p.add_argument(
+        "--scan-layers", action="store_true",
+        help="gpt_lm only: run decoder blocks as one lax.scan with stacked"
+             " params — ~n_layers× smaller HLO and compile time, same math",
+    )
     p.add_argument("--preset", choices=["small", "full"], default="small")
     p.add_argument("--data-dir", type=str, default="./data")
     p.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32")
@@ -212,6 +217,11 @@ def main(argv=None) -> dict:
             f"--remat is not supported by {args.experiment!r}"
             f" (supported: {', '.join(_REMAT_OK)})"
         )
+    if args.scan_layers and args.experiment != "gpt_lm":
+        raise ValueError(
+            f"--scan-layers is not supported by {args.experiment!r}"
+            " (supported: gpt_lm)"
+        )
 
     # multi-host rendezvous before any experiment touches devices
     # (the reference's setup() does the same before run_task())
@@ -254,7 +264,7 @@ def main(argv=None) -> dict:
     elif args.experiment in ("gpt_lm", "gpt_pp", "gpt_sp", "gpt_tp", "gpt_moe"):
         kwargs.update(preset=args.preset, max_steps_per_epoch=args.max_steps_per_epoch)
         if args.experiment == "gpt_lm":
-            kwargs.update(remat=args.remat)
+            kwargs.update(remat=args.remat, scan_layers=args.scan_layers)
         if args.experiment == "gpt_pp":
             kwargs.update(data_shards=args.data_shards, reducer=args.pp_reducer)
         if args.experiment == "gpt_tp":

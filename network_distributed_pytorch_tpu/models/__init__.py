@@ -29,4 +29,6 @@ from .gpt import (  # noqa: F401
     make_gpt_stage_fn,
     next_token_loss,
     split_gpt_params,
+    stack_gpt_layer_params,
+    unstack_gpt_layer_params,
 )

@@ -8,7 +8,7 @@ TRIES="${2:-40}"
 i=0
 while [ "$i" -lt "$TRIES" ]; do
     i=$((i+1))
-    if timeout 90 python - <<'EOF'
+    if timeout 90 "${PYTHON:-python3}" - <<'EOF'
 import threading, sys
 box = {}
 def w():
@@ -27,6 +27,12 @@ EOF
     then
         echo "tunnel recovered after $i probes"
         exit 0
+    else
+        rc=$?
+        if [ "$rc" -eq 127 ] || [ "$rc" -eq 126 ]; then
+            echo "probe interpreter failed (rc=$rc) — not a tunnel state; aborting"
+            exit 2
+        fi
     fi
     echo "probe $i: tunnel still wedged $(date -u +%H:%M:%S)"
     sleep "$INTERVAL"

@@ -61,6 +61,71 @@ def test_artifact_pointers_ride_the_line(monkeypatch):
     json.dumps(out)  # the line must stay serializable
 
 
+def test_merge_tier_guard(monkeypatch):
+    """A fallback-tier arm never silently pairs with a TPU arm: the headline
+    ratio is withheld on tier mismatch and the value carries value_tier."""
+    bench = _load_bench(monkeypatch)
+    out, status = {"value": 0.0, "vs_baseline": 0.0}, {}
+    bench._merge(out, "baseline", True, {"baseline_imgs_per_sec": 100.0}, status)
+    bench._merge(
+        out, "flagship", True, {"flagship_imgs_per_sec": 400.0}, status,
+        tier="cpu-smoke-fallback",
+    )
+    assert status["flagship"] == "ok [cpu-smoke-fallback]"
+    assert out["value"] == 400.0
+    assert out["value_tier"] == "cpu-smoke-fallback"  # self-describing headline
+    assert out["vs_baseline"] == 0.0  # cross-tier ratio never computed
+
+
+def test_midround_pointer_rejects_fallback_tiers(monkeypatch, tmp_path):
+    """The BENCH_MIDROUND republish gate: flagship must be plain-ok TPU, and
+    baseline-derived fields are dropped unless baseline was plain-ok too."""
+    bench = _load_bench(monkeypatch)
+    art_dir = tmp_path / "artifacts"
+    art_dir.mkdir()
+    mid = {
+        "platform": "tpu", "device": "TPU v5 lite", "recorded_unix": 1,
+        "flagship_imgs_per_sec": 22801.0, "baseline_imgs_per_sec": 40.0,
+        "vs_baseline": 570.0, "mfu": 0.005,
+        "phases": {"flagship": "ok", "baseline": "ok [cpu-smoke-fallback]"},
+    }
+    (art_dir / "BENCH_MIDROUND.json").write_text(json.dumps(mid))
+    monkeypatch.setattr(bench, "HERE", str(tmp_path))
+    out = {}
+    bench._artifact_pointers(out)
+    ptr = out["midround_chip_bench"]
+    assert ptr["flagship_imgs_per_sec"] == 22801.0
+    # the CPU-fallback baseline (and the ratio built on it) must NOT be
+    # re-exported under the chip label
+    assert "baseline_imgs_per_sec" not in ptr and "vs_baseline" not in ptr
+    # and a fallback-tier flagship disqualifies the pointer entirely
+    mid["phases"]["flagship"] = "ok [cpu-smoke-fallback]"
+    (art_dir / "BENCH_MIDROUND.json").write_text(json.dumps(mid))
+    out2 = {}
+    bench._artifact_pointers(out2)
+    assert "midround_chip_bench" not in out2
+
+
+def test_run_with_deadline(monkeypatch):
+    """The child-side phase deadline: a slow phase is abandoned with
+    TimeoutError (no SIGKILL needed — the tunnel-wedge prevention), a fast
+    one returns its data, and a crashing one relays its exception."""
+    import time as _time
+
+    import pytest
+
+    bench = _load_bench(monkeypatch)
+    assert bench._run_with_deadline("x", lambda: {"a": 1}, 5.0) == {"a": 1}
+    with pytest.raises(TimeoutError, match="abandoned"):
+        bench._run_with_deadline("slow", lambda: _time.sleep(30), 0.2)
+
+    def boom():
+        raise ValueError("inner")
+
+    with pytest.raises(ValueError, match="inner"):
+        bench._run_with_deadline("crash", boom, 5.0)
+
+
 def test_merge_builds_value_and_ratio(monkeypatch):
     bench = _load_bench(monkeypatch)
     out, status = {"value": 0.0, "vs_baseline": 0.0}, {}
