@@ -3,6 +3,14 @@
 # axon TPU plugin registration (PALLAS_AXON_POOL_IPS unset ⇒ sitecustomize
 # skips register(); otherwise a hung TPU tunnel can stall even CPU-only jax
 # at backend init).
+#
+# The collective-rendezvous deadlines (XLA:CPU default 20 s/40 s — low
+# enough that a heavy multi-device program's SERIALIZED per-device computes
+# on a 1-core host abort the whole pytest process, observed at
+# test_exact_cifar10_fsdp_strategy) are raised by tests/conftest.py via
+# hostenv.force_cpu_devices(collective_timeout_s=120), which strips and
+# re-appends those flags before jax init — setting them here would be dead
+# configuration.
 exec env -u PALLAS_AXON_POOL_IPS \
     JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \

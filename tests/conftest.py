@@ -17,7 +17,12 @@ import os
 # helper also covers the jax-already-imported case via jax.config.
 from network_distributed_pytorch_tpu.hostenv import force_cpu_devices  # noqa: E402
 
-force_cpu_devices(8, replace=False)
+# collective_timeout_s: XLA:CPU's default 40 s rendezvous-terminate
+# deadline aborts the whole process when a heavy multi-device program's
+# serialized per-device computes (8 devices, possibly 1 core) keep the
+# last participant away too long — observed on the full suite. 120 s/240 s
+# keeps a genuine deadlock fatal while letting legitimate slow steps join.
+force_cpu_devices(8, replace=False, collective_timeout_s=120)
 
 import jax  # noqa: E402
 
