@@ -579,7 +579,9 @@ def child_main(phase_list: list) -> int:
             # when the global window is already spent.
             if budget <= (0 if name == "probe" else 30.0):
                 raise TimeoutError(
-                    f"phase {name} skipped: global deadline reached"
+                    f"phase {name} skipped: under 30s of budget left "
+                    "(global deadline near, or a static BENCH_*_BUDGET_S "
+                    "under 75s)"
                 )
             if name == "probe":
                 data = _PHASE_FNS[name]()

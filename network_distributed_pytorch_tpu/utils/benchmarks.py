@@ -50,7 +50,8 @@ def time_gpt_train_step(
     XLA compiles (the lever that matters when compiles travel the slow
     remote-compile link: the unrolled 124M step blew an 855 s budget there,
     GPTConfig.scan_layers). Returns ``{model, seq_len, batch, attn_impl,
-    scan_layers, step_time_ms, tokens_per_sec, flops_per_step?}``.
+    scan_layers, step_time_ms, tokens_per_sec, n_params, flops_per_step,
+    flops_method, flops_per_step_hlo?}``.
     """
     import jax
     import jax.numpy as jnp
@@ -98,14 +99,13 @@ def time_gpt_train_step(
         sum(x.size for x in jax.tree_util.tree_leaves(params))
     )
     cfg = model.config
-    analytic_flops = gpt_analytic_train_flops(
-        n_params, cfg.n_layers, cfg.dim, seq_len, batch
-    )
     # MFU basis: the analytic number. Under scan_layers the HLO count is
     # wrong by ~n_layers (see gpt_analytic_train_flops); unscanned, the
     # analytic basis is what published MFU figures use, so one method
     # serves both paths. The raw HLO count still rides the record.
-    flops: Optional[float] = analytic_flops
+    analytic_flops = gpt_analytic_train_flops(
+        n_params, cfg.n_layers, cfg.dim, seq_len, batch
+    )
     state, l = compiled(state, batch_xy)  # warmup
     wait_result(l)
     t0 = time.perf_counter()
@@ -122,10 +122,9 @@ def time_gpt_train_step(
         "step_time_ms": round(1000.0 * dt, 3),
         "tokens_per_sec": round(batch * seq_len / dt, 1),
         "n_params": n_params,
+        "flops_per_step": analytic_flops,
+        "flops_method": "analytic_6N+12Lds (PaLM appendix)",
     }
-    if flops is not None:
-        out["flops_per_step"] = flops
-        out["flops_method"] = "analytic_6N+12Lds (PaLM appendix)"
     if hlo_flops is not None:
         out["flops_per_step_hlo"] = hlo_flops
     return out

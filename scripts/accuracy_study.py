@@ -11,16 +11,19 @@ trained to eval-accuracy plateau under (a) exact allreduce and (b)
 PowerSGD, on a REAL 8-worker data-parallel mesh (virtual CPU devices — the
 same `psum` code path as ICI).
 
-**The tasks are deliberately hard enough that neither arm can saturate**
+**The tasks are deliberately capped so neither arm can saturate**
 (round 3's class-separable set hit 1.000 by epoch 2 in both arms — a
-vacuous parity). CIFAR: class separation tuned so the nearest-mean
-(Bayes-optimal) classifier scores ≈0.85 on held-out data — the study
-computes and records that ceiling from the test split itself. IMDb: 12%
-symmetric label noise on train AND val — the flip is deterministic
-(``y -> 1-y`` for the noised fraction), so even a perfect classifier scores
-exactly ``1 - 0.12 = 0.88`` on the noised val split (the recorded
-``accuracy_ceiling``) — plus a reduced class-word rate. An arm that
-degrades under compression now has 10+ points of headroom to fall.
+vacuous parity). The binding lever on both tasks is SYMMETRIC LABEL NOISE
+on train AND eval: a ceiling that holds no matter how well the optimizer
+does, unlike separability tuning (tried first at ``class_sep=0.012``,
+Bayes ≈0.85 — but the model couldn't extract the signal at all and both
+arms sat at chance, vacuous in the other direction). CIFAR: the learnable
+blob task plus 15% label resampling (9/10 resamples land off-class ⇒
+effective flip 13.5%, ceiling ≈0.865 — recorded as the true-means
+nearest-mean (Bayes) rule scored on the noised eval split). IMDb: 12%
+deterministic flips (``y -> 1-y``), ceiling exactly 0.88, plus a reduced
+class-word rate. An arm that degrades under compression has 10+ points of
+headroom to fall below the other.
 
 Outputs ``artifacts/ACCURACY_STUDY.json``: per-epoch eval accuracy for both
 arms, final/best accuracy delta, the task's measured accuracy ceiling, and
@@ -107,7 +110,14 @@ def run_to_plateau(
     }
 
 
-CIFAR_CLASS_SEP = 0.012  # nearest-mean (Bayes) accuracy ≈ 0.85 at noise 0.25
+# CIFAR hardness: the generator-default separability (learnable — the
+# 0.012 Bayes-limited setting left BOTH arms at chance, see module doc)
+# with the ceiling enforced by label noise instead. 15% symmetric
+# resampling, 9/10 of resamples land off-class ⇒ effective flip 13.5%,
+# achievable ceiling ≈ 0.865 (measured per-draw by the true-means
+# nearest-mean rule on the noised eval labels and recorded).
+CIFAR_CLASS_SEP = 0.5
+CIFAR_LABEL_NOISE = 0.15
 IMDB_LABEL_NOISE = 0.12
 IMDB_CLASS_WORD_RATE = 0.25
 
@@ -127,8 +137,8 @@ def _nearest_mean_accuracy(x, y, true_means) -> float:
 
 
 def cifar_study(max_epochs: int, patience: int) -> dict:
-    """ResNet-18 on class-blob CIFAR at Bayes-limited separability
-    (``CIFAR_CLASS_SEP``): exact-SGD (C2 semantics) vs PowerSGD r=4
+    """ResNet-18 on class-blob CIFAR with a label-noise accuracy ceiling
+    (``CIFAR_LABEL_NOISE``): exact-SGD (C2 semantics) vs PowerSGD r=4
     EF-momentum (C3 semantics), same data/model/lr/schedule."""
     import jax
     import jax.numpy as jnp
@@ -152,7 +162,8 @@ def cifar_study(max_epochs: int, patience: int) -> dict:
     # ONE synthetic draw, split train/test: identical class means, disjoint
     # noise samples (a held-out set synthetic_cifar10 alone doesn't give)
     images, labels, true_means = synthetic_cifar10(
-        5120, seed=0, class_sep=CIFAR_CLASS_SEP, return_means=True
+        5120, seed=0, class_sep=CIFAR_CLASS_SEP,
+        label_noise=CIFAR_LABEL_NOISE, return_means=True,
     )
     train_x, train_y = images[:4096], labels[:4096]
     test_x, test_y = images[4096:], labels[4096:]
@@ -214,14 +225,18 @@ def cifar_study(max_epochs: int, patience: int) -> dict:
 
     exact, psgd = arms["exact"], arms["powersgd_r4"]
     return {
-        "task": "cifar10_synthetic_bayes_limited",
+        "task": "cifar10_synthetic_label_noise",
         "model": "resnet18_w16",
         "workers": mesh.size,
         "global_batch": batch_size,
         "lr": lr,
         "hardness": {
             "class_sep": CIFAR_CLASS_SEP,
-            "bayes_ceiling_nearest_mean": round(ceiling, 4),
+            "label_noise": CIFAR_LABEL_NOISE,
+            # the Bayes rule (true-means nearest-mean) scored on the
+            # noised eval labels — what a perfect learner of the CLEAN
+            # structure can reach on this draw
+            "accuracy_ceiling_nearest_mean": round(ceiling, 4),
         },
         "arms": arms,
         "accuracy_delta_pts": round(

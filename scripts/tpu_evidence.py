@@ -183,8 +183,14 @@ def main() -> int:
 
         out = {}
         for impl in ("einsum", "flash"):
-            r = time_gpt_train_step(attn_impl=impl, reps=5)
-            r.pop("flops_per_step", None)  # MFU is bench.py's column
+            # scan_layers: the unrolled full-shape compile never finishes
+            # over the remote-compile link (bench.py abandoned it at 855 s);
+            # the scanned program is bit-identical math at ~5.6x smaller HLO
+            r = time_gpt_train_step(attn_impl=impl, scan_layers=True, reps=5)
+            # MFU is bench.py's column — drop the whole flops record here
+            # (value, method label, and raw HLO count travel together)
+            for k in ("flops_per_step", "flops_method", "flops_per_step_hlo"):
+                r.pop(k, None)
             out[impl] = r
         out["flash_speedup"] = round(
             out["einsum"]["step_time_ms"] / out["flash"]["step_time_ms"], 3
