@@ -21,9 +21,12 @@ arms sat at chance, vacuous in the other direction). CIFAR: the learnable
 blob task plus 15% label resampling (9/10 resamples land off-class ⇒
 effective flip 13.5%, ceiling ≈0.865 — recorded as the true-means
 nearest-mean (Bayes) rule scored on the noised eval split). IMDb: 12%
-deterministic flips (``y -> 1-y``), ceiling exactly 0.88, plus a reduced
-class-word rate. An arm that degrades under compression has 10+ points of
-headroom to fall below the other.
+flips (``y -> 1-y`` under a binomial mask), nominal ceiling 0.88 — the
+REALIZED val-split flip fraction varies by draw, so the study measures it
+per seed (clean-draw diff) and records ``accuracy_ceiling_realized``
+alongside the nominal — plus a reduced class-word rate. An arm that
+degrades under compression has 10+ points of headroom to fall below the
+other.
 
 Outputs ``artifacts/ACCURACY_STUDY.json``: per-epoch eval accuracy for both
 arms, final/best accuracy delta, the task's measured accuracy ceiling, and
@@ -136,7 +139,7 @@ def _nearest_mean_accuracy(x, y, true_means) -> float:
     return float((logits.argmax(1) == y).mean())
 
 
-def cifar_study(max_epochs: int, patience: int) -> dict:
+def cifar_study(max_epochs: int, patience: int, data_seed: int = 0) -> dict:
     """ResNet-18 on class-blob CIFAR with a label-noise accuracy ceiling
     (``CIFAR_LABEL_NOISE``): exact-SGD (C2 semantics) vs PowerSGD r=4
     EF-momentum (C3 semantics), same data/model/lr/schedule."""
@@ -162,7 +165,7 @@ def cifar_study(max_epochs: int, patience: int) -> dict:
     # ONE synthetic draw, split train/test: identical class means, disjoint
     # noise samples (a held-out set synthetic_cifar10 alone doesn't give)
     images, labels, true_means = synthetic_cifar10(
-        5120, seed=0, class_sep=CIFAR_CLASS_SEP,
+        5120, seed=data_seed, class_sep=CIFAR_CLASS_SEP,
         label_noise=CIFAR_LABEL_NOISE, return_means=True,
     )
     train_x, train_y = images[:4096], labels[:4096]
@@ -248,7 +251,7 @@ def cifar_study(max_epochs: int, patience: int) -> dict:
     }
 
 
-def imdb_study(max_epochs: int, patience: int) -> dict:
+def imdb_study(max_epochs: int, patience: int, data_seed: int = 0) -> dict:
     """DistilBERT-tiny on class-separable synthetic reviews: exact vs
     PowerSGD r=16 (the reference's IMDb rank, ddp_init.py:38)."""
     import jax
@@ -276,10 +279,24 @@ def imdb_study(max_epochs: int, patience: int) -> dict:
     # is capped at ~1 - IMDB_LABEL_NOISE on val (its flipped labels are
     # simply wrong) — the arm separation the round-3 study lacked
     train, val, _ = prepare_imdb(
-        max_len=64, synthetic_n=2048, vocab_size=1024,
+        max_len=64, synthetic_n=2048, vocab_size=1024, seed=714 + data_seed,
         synthetic_kwargs=dict(
             class_word_rate=IMDB_CLASS_WORD_RATE, label_noise=IMDB_LABEL_NOISE
         ),
+    )
+    # realized ceiling for THIS draw: the flip mask is binomial
+    # (synthetic_imdb draws it AFTER content generation, so a label_noise=0
+    # call reproduces the identical clean draw), and the val split's
+    # realized flip fraction wanders ~±1.5 pts around the nominal 12% —
+    # an arm can legitimately score above 0.88 on a lucky draw
+    _, clean_val, _ = prepare_imdb(
+        max_len=64, synthetic_n=2048, vocab_size=1024, seed=714 + data_seed,
+        synthetic_kwargs=dict(
+            class_word_rate=IMDB_CLASS_WORD_RATE, label_noise=0.0
+        ),
+    )
+    realized_flip = float(
+        (val["labels"] != clean_val["labels"]).mean()
     )
     mesh = make_mesh()
     model = distilbert_tiny(num_labels=2)
@@ -338,6 +355,9 @@ def imdb_study(max_epochs: int, patience: int) -> dict:
             "label_noise": IMDB_LABEL_NOISE,
             "class_word_rate": IMDB_CLASS_WORD_RATE,
             "accuracy_ceiling": round(1.0 - IMDB_LABEL_NOISE, 4),
+            # 1 - the measured flip fraction of THIS draw's val split (the
+            # binomial mask makes the nominal 0.88 only an expectation)
+            "accuracy_ceiling_realized": round(1.0 - realized_flip, 4),
         },
         "arms": arms,
         "accuracy_delta_pts": round(
@@ -349,11 +369,51 @@ def imdb_study(max_epochs: int, patience: int) -> dict:
     }
 
 
+def _slim(rec: dict, seed: int) -> dict:
+    """The per-seed summary row kept for every seed beyond the first (the
+    seed-0 run keeps the full per-epoch record at the task's top level)."""
+    return {
+        "seed": seed,
+        "accuracy_delta_pts": rec["accuracy_delta_pts"],
+        "exact_best": rec["arms"]["exact"]["best_accuracy"],
+        "compressed_best": min(
+            a["best_accuracy"] for k, a in rec["arms"].items() if k != "exact"
+        ),
+        "hardness": rec["hardness"],
+    }
+
+
+def _multi_seed(
+    study_fn, max_epochs: int, patience: int, seeds: int, save
+) -> dict:
+    """Seed-0 full record, plus slim rows and a delta spread over ``seeds``
+    independent data draws — one draw's parity could be luck; the spread
+    across draws is the claim's error bar. ``save(rec)`` persists after
+    EVERY seed: a crash at seed k (hours into 8-virtual-device CPU
+    training) costs that one seed, not the task."""
+    rec = study_fn(max_epochs, patience)
+    save(rec)
+    if seeds > 1:
+        runs = [_slim(rec, 0)]
+        for s in range(1, seeds):
+            runs.append(_slim(study_fn(max_epochs, patience, data_seed=s), s))
+            rec["seed_runs"] = list(runs)
+            deltas = [r["accuracy_delta_pts"] for r in runs]
+            rec["accuracy_delta_pts_per_seed"] = deltas
+            rec["accuracy_delta_pts_worst"] = max(deltas)
+            save(rec)
+    return rec
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--task", default="both", choices=["cifar", "imdb", "both"])
     ap.add_argument("--max-epochs", type=int, default=30)
     ap.add_argument("--patience", type=int, default=5)
+    ap.add_argument(
+        "--seeds", type=int, default=1,
+        help="independent data draws per task (seed 0 keeps the full record)",
+    )
     args = ap.parse_args()
 
     import jax
@@ -372,26 +432,46 @@ def main() -> int:
         ),
         "n_devices": len(jax.devices()),
     }
+    def _saver(task):
+        def save(rec):
+            out[task] = rec
+            _save(out)
+
+        return save
+
     if args.task in ("cifar", "both"):
-        out["cifar"] = cifar_study(args.max_epochs, args.patience)
-        _save(out)
+        _multi_seed(
+            cifar_study, args.max_epochs, args.patience, args.seeds,
+            _saver("cifar"),
+        )
     if args.task in ("imdb", "both"):
-        out["imdb"] = imdb_study(args.max_epochs, args.patience)
-        _save(out)
+        _multi_seed(
+            imdb_study, args.max_epochs, args.patience, args.seeds,
+            _saver("imdb"),
+        )
     # one slim machine-readable line (the full record is in the artifact)
+    def _line(rec: dict) -> dict:
+        row = {
+            "accuracy_delta_pts": rec["accuracy_delta_pts"],
+            "gradient_bytes_ratio": rec["gradient_bytes_ratio"],
+            "exact_best": rec["arms"]["exact"]["best_accuracy"],
+            "compressed_best": min(
+                a["best_accuracy"]
+                for k, a in rec["arms"].items()
+                if k != "exact"
+            ),
+        }
+        # multi-seed: the spread IS the claim's error bar — the slim line
+        # must not read as seed-0 parity when another draw disagrees
+        if "accuracy_delta_pts_worst" in rec:
+            row["accuracy_delta_pts_worst"] = rec["accuracy_delta_pts_worst"]
+            row["seeds"] = len(rec["seed_runs"])
+        return row
+
     print(
         json.dumps(
             {
-                task: {
-                    "accuracy_delta_pts": out[task]["accuracy_delta_pts"],
-                    "gradient_bytes_ratio": out[task]["gradient_bytes_ratio"],
-                    "exact_best": out[task]["arms"]["exact"]["best_accuracy"],
-                    "compressed_best": min(
-                        a["best_accuracy"]
-                        for k, a in out[task]["arms"].items()
-                        if k != "exact"
-                    ),
-                }
+                task: _line(out[task])
                 for task in ("cifar", "imdb")
                 if task in out
             }
