@@ -481,9 +481,24 @@ def main() -> int:
 
 
 def _save(out: dict) -> None:
+    """Merge-write: a --task cifar run must not clobber the artifact's
+    imdb record (or vice versa) — each task's record is replaced only by
+    a new run of THAT task. Atomic (tmp + os.replace, the
+    utils/failure.py checkpoint pattern): _save runs after every seed and
+    this codebase's orchestrators SIGKILL wedged processes, so a kill
+    landing mid-dump must not leave a truncated artifact that a later
+    run's load-failure fallback would silently reset to {}."""
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
-    with open(OUT, "w") as f:
-        json.dump(out, f, indent=1)
+    try:
+        with open(OUT) as f:
+            merged = json.load(f)
+    except FileNotFoundError:  # first run creates the artifact
+        merged = {}
+    merged.update(out)
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(merged, f, indent=1)
+    os.replace(tmp, OUT)
 
 
 if __name__ == "__main__":
