@@ -32,6 +32,7 @@ from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec
 
 from .comm import all_reduce_mean
@@ -161,11 +162,23 @@ def collapse_per_worker(model_state: PyTree, reduce: str = "mean") -> PyTree:
     into one copy for evaluation: ``"mean"`` averages the workers' stats
     (each saw a disjoint data shard, so the mean is the best single
     estimate); ``"first"`` takes worker 0's (what a torch rank-0 eval sees).
-    Shared by the DDP and FSDP steps' ``eval_model_state``."""
+    Shared by the DDP and FSDP steps' ``eval_model_state``.
+
+    Fetches to host before reducing (returns numpy leaves). An eager
+    reduction over device-sharded leaves compiles a FRESH auto-partitioned
+    multi-device program, and on hosts with fewer cores than devices its
+    collective rendezvous can genuinely deadlock and abort the process
+    (reproduced thrice at ``test_exact_cifar10_fsdp_strategy`` under CPU
+    contention, surviving even a 600 s terminate deadline). BN stats are a
+    few KB and eval prep is not a hot path, so the host round trip is the
+    robust choice on every backend."""
+    model_state = jax.device_get(model_state)
     if reduce == "first":
         return jax.tree_util.tree_map(lambda x: x[0], model_state)
     assert reduce == "mean", reduce
-    return jax.tree_util.tree_map(lambda x: jnp.mean(x, axis=0), model_state)
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x).mean(axis=0), model_state
+    )
 
 
 def stateless_loss(fn: Callable[[PyTree, Any], jax.Array]) -> LossFn:

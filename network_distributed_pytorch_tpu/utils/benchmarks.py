@@ -22,10 +22,18 @@ def gpt_analytic_train_flops(
     (forward ``2N`` + backward ``4N``) plus ``12·L·d·s`` for the attention
     einsums (QK^T and A·V, forward+backward). Embedding lookups are
     gathers (flop-free); the weight-tied LM head IS a matmul and is
-    already inside ``N``. Needed because XLA's ``cost_analysis`` counts a
-    ``scan``/while body ONCE regardless of trip count (measured: 2-layer
-    vs 4-layer scanned programs report near-identical flops), so a scanned
-    decoder's HLO flops understate the true work ~``n_layers``-fold."""
+    already inside ``N``.
+
+    Why not HLO cost analysis: loop-body flop accounting is
+    BACKEND-DEPENDENT. XLA:CPU counts a ``scan``/while body ONCE
+    regardless of trip count (measured: 2- vs 4-layer scanned programs
+    report near-identical flops, and chunk-1/2/8 scanned train steps
+    identical flops), while the TPU toolchain multiplies the body by the
+    trip count (measured: chip runs of the CHUNK-scanned flagship report
+    exactly CHUNK× one step's conv work — see bench.py's flagship phase,
+    which exploits that and divides back). The analytic basis is the one
+    number that is right on every backend — and it is what published MFU
+    figures use."""
     return (6.0 * n_params + 12.0 * n_layers * dim * seq_len) * batch * seq_len
 
 

@@ -54,9 +54,10 @@ if os.environ.get("ACCURACY_STUDY_PLATFORM", "cpu") == "cpu":
 
     # big-model steps on few cores serialize the 8 per-device computes, so
     # one step can exceed XLA:CPU's default 40 s collective-rendezvous kill
-    # deadline — raise it moderately (a genuinely-deadlocked run should
-    # still die fast enough to retry); correctness is unaffected
-    force_cpu_devices(8, replace=False, collective_timeout_s=120)
+    # deadline. 300 s (600 s terminate), matching tests/conftest.py: 120 s
+    # was observed to still abort when ANOTHER jax process shared the
+    # single core; a genuinely-deadlocked run still dies in ten minutes
+    force_cpu_devices(8, replace=False, collective_timeout_s=300)
 
 OUT = os.path.join(REPO, "artifacts", "ACCURACY_STUDY.json")
 
@@ -186,18 +187,10 @@ def cifar_study(max_epochs: int, patience: int, data_seed: int = 0) -> dict:
         )
 
     def evaluate(step, state):
-        # everything host-side first: step.eval_model_state's per-worker
-        # BN collapse runs on the 8-device state, and fresh multi-device
-        # programs intermittently deadlock their rendezvous on a 1-core
-        # host (the train step's collectives, compiled once and stepped
-        # repeatedly, are fine). device_get first, then the library's own
-        # collapse on host arrays — a single-device program.
-        from network_distributed_pytorch_tpu.parallel.trainer import (
-            collapse_per_worker,
-        )
-
-        host_ms = jax.device_get(state.model_state)
-        batch_stats = collapse_per_worker(host_ms, "mean")["batch_stats"]
+        # eval_model_state's collapse is host-side in the library now
+        # (collapse_per_worker device_gets first — the 1-core rendezvous
+        # deadlock defense this site used to hand-roll)
+        batch_stats = step.eval_model_state(state)["batch_stats"]
         return evaluate_image_classifier(
             model, jax.device_get(state.params), batch_stats, test_x, test_y
         )
@@ -426,14 +419,18 @@ def main() -> int:
         # the pipeline and removes the hazard (slower, but it finishes).
         jax.config.update("jax_cpu_enable_async_dispatch", False)
 
-    out = {
-        "device": getattr(
-            jax.devices()[0], "device_kind", jax.devices()[0].platform
-        ),
-        "n_devices": len(jax.devices()),
-    }
+    # provenance rides each TASK record: the artifact merges records across
+    # runs, and a later run on a different backend must not relabel a
+    # retained record's device (the merge keeps the record, so it must
+    # keep its own provenance too)
+    device = getattr(jax.devices()[0], "device_kind", jax.devices()[0].platform)
+    n_devices = len(jax.devices())
+    out: dict = {}
+
     def _saver(task):
         def save(rec):
+            rec["device"] = device
+            rec["n_devices"] = n_devices
             out[task] = rec
             _save(out)
 
@@ -493,6 +490,12 @@ def _save(out: dict) -> None:
         with open(OUT) as f:
             merged = json.load(f)
     except FileNotFoundError:  # first run creates the artifact
+        merged = {}
+    except json.JSONDecodeError:
+        # a pre-atomic-era truncated file must not crash THIS run's first
+        # save (losing hours of training); sideline it for forensics and
+        # start fresh
+        os.replace(OUT, OUT + ".corrupt")
         merged = {}
     merged.update(out)
     tmp = OUT + ".tmp"

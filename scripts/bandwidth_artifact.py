@@ -90,7 +90,9 @@ def phase_structure() -> None:
     audited collective structure (and the CPU timings, labeled as such)."""
     from network_distributed_pytorch_tpu.hostenv import force_cpu_devices
 
-    force_cpu_devices(8, replace=False, collective_timeout_s=120)
+    # 300 s/600 s rendezvous deadlines, matching tests/conftest.py: 120 s
+    # still aborted under a concurrent jax process on the 1-core host
+    force_cpu_devices(8, replace=False, collective_timeout_s=300)
     import jax
 
     jax.config.update("jax_cpu_enable_async_dispatch", False)  # 1-core host

@@ -20,9 +20,12 @@ from network_distributed_pytorch_tpu.hostenv import force_cpu_devices  # noqa: E
 # collective_timeout_s: XLA:CPU's default 40 s rendezvous-terminate
 # deadline aborts the whole process when a heavy multi-device program's
 # serialized per-device computes (8 devices, possibly 1 core) keep the
-# last participant away too long — observed on the full suite. 120 s/240 s
-# keeps a genuine deadlock fatal while letting legitimate slow steps join.
-force_cpu_devices(8, replace=False, collective_timeout_s=120)
+# last participant away too long — observed on the full suite at
+# test_exact_cifar10_fsdp_strategy. 120 s sufficed for the suite alone
+# but still aborted when ANOTHER jax process shared the single core
+# (reproduced twice with a concurrent TPU-tunnel probe); 300 s/600 s
+# absorbs that while a genuine deadlock still dies in ten minutes.
+force_cpu_devices(8, replace=False, collective_timeout_s=300)
 
 import jax  # noqa: E402
 
