@@ -497,6 +497,10 @@ def _save(out: dict) -> None:
         # start fresh
         os.replace(OUT, OUT + ".corrupt")
         merged = {}
+    # migrate pre-per-record artifacts: provenance now rides each task
+    # record; stale top-level keys would contradict the per-record stamps
+    merged.pop("device", None)
+    merged.pop("n_devices", None)
     merged.update(out)
     tmp = OUT + ".tmp"
     with open(tmp, "w") as f:

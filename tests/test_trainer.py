@@ -206,3 +206,30 @@ def test_max_grad_norm_clips_like_torch(devices):
         state.params["b"]
     ).ravel().tolist()
     assert np.linalg.norm(np.asarray(upd)) <= 0.05 * max_norm * 1.001
+
+
+def test_collapse_per_worker_is_host_side(devices):
+    """The eval collapse must produce host (numpy) leaves from a
+    device-sharded model_state WITHOUT compiling a fresh multi-device
+    program — an eager cross-device reduction here deadlock-aborted whole
+    processes on hosts with fewer cores than devices (see
+    collapse_per_worker's docstring). Pins the semantics: "mean" averages
+    the per-worker axis, "first" takes worker 0, both on host arrays."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from network_distributed_pytorch_tpu.parallel.trainer import (
+        collapse_per_worker,
+    )
+
+    mesh = make_mesh()
+    w = mesh.size
+    stats = np.arange(w * 3, dtype=np.float32).reshape(w, 3)
+    sharded = jax.device_put(
+        stats, NamedSharding(mesh, PartitionSpec("data", None))
+    )
+    mean = collapse_per_worker({"bn": sharded}, "mean")
+    first = collapse_per_worker({"bn": sharded}, "first")
+    assert isinstance(mean["bn"], np.ndarray)
+    assert isinstance(first["bn"], np.ndarray)
+    np.testing.assert_allclose(mean["bn"], stats.mean(axis=0))
+    np.testing.assert_allclose(first["bn"], stats[0])
