@@ -533,6 +533,15 @@ class _PhaseAbandoned(TimeoutError):
     draining on the device (relevant to later phases' timing honesty)."""
 
 
+# threads of abandoned phases, by phase name — the child must try to DRAIN
+# these before exiting: daemon threads die with the process, and dying
+# inside an in-flight remote compile wedges the tunnel's remote side the
+# same way a SIGKILL does (observed: the 03:37 run abandoned the GPT
+# compile, finished its remaining phases, exited — and backend init hung
+# for 8+ hours afterwards)
+_ABANDONED_THREADS: dict = {}
+
+
 def _run_with_deadline(name: str, fn, deadline_s: float) -> dict:
     """Run one phase in a daemon thread; on deadline, raise instead of
     letting the parent SIGKILL the child mid-compile.
@@ -561,6 +570,7 @@ def _run_with_deadline(name: str, fn, deadline_s: float) -> dict:
     t.start()
     t.join(deadline_s)
     if t.is_alive():
+        _ABANDONED_THREADS[name] = t
         raise _PhaseAbandoned(
             f"phase {name} exceeded its child-side deadline of"
             f" {int(deadline_s)}s (abandoned, child continues)"
@@ -618,6 +628,24 @@ def child_main(phase_list: list) -> int:
             if isinstance(e, _PhaseAbandoned):
                 abandoned.append(name)
             _child_emit(name, False, {"error": f"{type(e).__name__}: {e}"[:400]})
+    if _ABANDONED_THREADS:
+        # drain abandoned compiles before exiting: daemon threads die with
+        # the process, and dying inside an in-flight remote compile wedges
+        # the tunnel exactly like a SIGKILL (see _ABANDONED_THREADS). Spend
+        # whatever remains of the global window on the join; report what
+        # drained so the parent's line records the residual wedge risk.
+        grace_until = (
+            deadline_unix - 10.0
+            if deadline_unix is not None
+            else time.time() + float(os.environ.get("BENCH_DRAIN_GRACE_S", "120"))
+        )
+        drained, still_alive = [], []
+        for name, t in _ABANDONED_THREADS.items():
+            t.join(max(0.0, grace_until - time.time()))
+            (still_alive if t.is_alive() else drained).append(name)
+        _child_emit(
+            "__drain__", True, {"drained": drained, "still_alive": still_alive}
+        )
     return 0
 
 
@@ -750,6 +778,28 @@ def _merge(
         out["vs_baseline"] = round(flag / base, 3)
 
 
+def _await_child_exit(child, out: dict, left) -> None:
+    """After every phase has reported, wait (within the global window) for
+    the child to drain abandoned compiles and exit by itself, recording its
+    ``__drain__`` report if one arrives. See the caller's comment: killing
+    a child mid-remote-compile is the tunnel-wedge failure mode."""
+    while True:
+        budget = min(left() - 10.0, 300.0)
+        if budget <= 0:
+            return  # window truly spent — the backstop kill may fire
+        try:
+            ev = child.next_event(budget)
+        except Exception:  # noqa: BLE001 — queue.Empty is a POLL timeout,
+            # not the window: keep waiting until left() runs out (returning
+            # here would kill mid-drain with window remaining — the wedge)
+            continue
+        if ev is None:  # child exited cleanly
+            return
+        if ev.get("phase") == "__drain__":
+            out["abandoned_drain"] = ev.get("data")
+            _emit(out)
+
+
 def orchestrate() -> int:
     t_start = time.time()
     # children self-deadline against the SAME absolute clock the parent
@@ -781,6 +831,10 @@ def orchestrate() -> int:
     while pending and left() > 45:
         child = _ChildProc(pending)
         child_events = 0
+        gave_up = False  # parent-side timeout: the child is WEDGED — the
+        # kill backstop must fire immediately, not after a drain wait
+        window_spent = False  # global window ran out with phases pending:
+        # the child may be mid-drain; give it the last few seconds
         try:
             while pending:
                 budget = min(
@@ -789,12 +843,14 @@ def orchestrate() -> int:
                     left() - 15,
                 )
                 if budget <= 0:
+                    window_spent = True
                     break
                 try:
                     ev = child.next_event(budget)
                 except Exception:  # queue.Empty — child wedged (compile hang)
                     status[pending[0]] = f"timeout after {int(budget)}s"
                     pending.pop(0)
+                    gave_up = True
                     break
                 if ev is None:  # child exited
                     if child_events == 0:
@@ -817,6 +873,12 @@ def orchestrate() -> int:
                     init_failures += 1
                     out["tpu_error"] = str(ev["data"].get("error", "?"))[:300]
                     break
+                if ev["phase"] == "__drain__":
+                    # the child's end-of-run report on abandoned-compile
+                    # drains — informational, not a measurement phase
+                    out["abandoned_drain"] = ev["data"]
+                    _emit(out)
+                    continue
                 init_failures = 0
                 if ev["phase"] in pending:
                     pending.remove(ev["phase"])
@@ -826,6 +888,16 @@ def orchestrate() -> int:
                 )
                 _emit(out)
         finally:
+            if (not pending and not gave_up) or window_spent:
+                # normal completion (or window exhaustion with the child
+                # possibly mid-drain): let the child drain + exit on its
+                # own. Killing it while an abandoned phase's daemon thread
+                # is mid-remote-compile wedges the tunnel for HOURS (the
+                # 03:37 run's GPT compile did exactly that) — the kill
+                # below must only ever be a no-op or a backstop. On
+                # window exhaustion _await_child_exit self-bounds to the
+                # last ~left()-10 seconds.
+                _await_child_exit(child, out, left)
             child.kill()
         if init_failures >= 2 and not cpu_fallback:
             if os.environ.get("BENCH_NO_CPU_FALLBACK") == "1":

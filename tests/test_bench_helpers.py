@@ -361,3 +361,74 @@ def test_cpu_fallback_gets_fresh_init_failure_budget(monkeypatch):
     assert tail["phases"]["flagship"].startswith("timeout")
     assert tail["phases"]["overlap"] == "ok [cpu-smoke-fallback]"
     os.environ.pop("BENCH_PLATFORM", None)  # orchestrate mutated real env
+
+
+def test_orchestrator_waits_for_abandoned_drain(monkeypatch):
+    """After the last phase reports, the parent must NOT kill the child
+    immediately: an abandoned phase's daemon thread may still be inside a
+    remote compile, and killing the process mid-request wedges the
+    tunnel's remote side for hours (the 03:37 r4 run). The parent waits
+    for the child's __drain__ report + EOF; the kill is a no-op backstop."""
+    bench = _load_bench(monkeypatch)
+    all_phases = list(bench.PHASES)
+    lines = _run_orchestrator(bench, [(all_phases, [
+        _ok("probe", device="TPU v5e", platform="tpu", n_devices=1),
+        _ok("flagship", flagship_imgs_per_sec=1000.0, step_time_ms=2.56,
+            preset="full"),
+        _ok("baseline", baseline_imgs_per_sec=100.0),
+        {"phase": "gpt", "ok": False,
+         "data": {"error": "_PhaseAbandoned: phase gpt exceeded ..."}},
+        _ok("overlap", overlap={"combiner_merged": True}),
+        {"phase": "__drain__", "ok": True,
+         "data": {"drained": ["gpt"], "still_alive": []}},
+        None,  # child exits on its own AFTER draining
+    ])])
+    tail = lines[-1]
+    assert tail["abandoned_drain"] == {"drained": ["gpt"], "still_alive": []}
+    assert tail["phases"]["gpt"].startswith("error")
+    assert _FakeChild.killed == [True]  # backstop fired once, after EOF
+
+
+def test_orchestrator_kills_immediately_on_giveup(monkeypatch):
+    """A parent-side timeout means the child is WEDGED — the kill backstop
+    must fire without a drain wait (waiting on a wedged child would burn
+    the remaining window for nothing)."""
+    bench = _load_bench(monkeypatch)
+    lines = _run_orchestrator(bench, [
+        (list(bench.PHASES), [
+            _ok("probe", device="TPU v5e", platform="tpu", n_devices=1),
+            _ok("flagship", flagship_imgs_per_sec=1000.0, preset="full"),
+            _ok("baseline", baseline_imgs_per_sec=100.0),
+            _ok("gpt", gpt={"step_time_ms": 50.0}),
+            "hang",  # overlap wedged — the LAST pending phase
+        ]),
+    ])
+    tail = lines[-1]
+    assert tail["phases"]["overlap"].startswith("timeout")
+    assert _FakeChild.killed == [True]
+
+
+def test_run_with_deadline_registers_abandoned_thread(monkeypatch):
+    """An abandoned phase's thread lands in _ABANDONED_THREADS so the
+    child's end-of-run drain can join it before process exit."""
+    import threading as _threading
+
+    bench = _load_bench(monkeypatch)
+    bench._ABANDONED_THREADS.clear()
+    release = _threading.Event()
+
+    def slow():
+        release.wait(10.0)
+        return {}
+
+    try:
+        bench._run_with_deadline("gpt", slow, 0.05)
+    except bench._PhaseAbandoned:
+        pass
+    else:  # pragma: no cover - the deadline must fire
+        raise AssertionError("expected _PhaseAbandoned")
+    t = bench._ABANDONED_THREADS.get("gpt")
+    assert t is not None and t.is_alive()
+    release.set()  # the "compile" finishes; the drain join must succeed
+    t.join(5.0)
+    assert not t.is_alive()
