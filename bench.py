@@ -923,8 +923,57 @@ def orchestrate() -> int:
         status.setdefault(p, "skipped: out of budget")
     out["partial"] = False
     out["wall_s"] = round(time.time() - t_start, 1)
+    _persist_midround(out, status)
     _emit(out)
     return 0
+
+
+def _persist_midround(out: dict, status: dict) -> None:
+    """A fully-successful TPU run self-persists as the midround artifact —
+    the chip record every LATER bench line points at (_artifact_pointers),
+    so a wedged end-of-round tunnel can't erase the round's measurement.
+    Round 4's artifact was hand-assembled from stdout; this closes that
+    manual step. The bar mirrors the pointer's republication gate: TPU
+    platform, FULL preset (a small-preset chip smoke must not overwrite
+    the flagship record), plain-ok flagship; baseline may have failed —
+    the pointer already withholds baseline-derived fields in that case —
+    but a flagship-only record never overwrites an existing record that
+    has BOTH arms plain-ok (no downgrading richer evidence)."""
+    if (
+        out.get("platform") != "tpu"
+        or out.get("preset") != "full"
+        or status.get("flagship") != "ok"
+        or not out.get("flagship_imgs_per_sec")
+    ):
+        return
+    path = os.path.join(HERE, "artifacts", "BENCH_MIDROUND.json")
+    if status.get("baseline") != "ok":
+        try:
+            with open(path) as f:
+                prev = json.load(f)
+            if (
+                prev.get("phases", {}).get("flagship") == "ok"
+                and prev.get("phases", {}).get("baseline") == "ok"
+            ):
+                return  # keep the two-arm record over a flagship-only one
+        except (OSError, ValueError):
+            pass  # nothing readable to preserve — persist what we have
+    rec = dict(out)
+    rec.pop("midround_chip_bench", None)  # no self-reference chains
+    rec["recorded_unix"] = int(time.time())
+    rec["note"] = (
+        "Self-persisted by bench.py after a fully-successful TPU run "
+        "(plain-ok flagship+baseline); later bench lines carry this as "
+        "midround_chip_bench so a wedged tunnel cannot erase it."
+    )
+    try:
+        os.makedirs(os.path.join(HERE, "artifacts"), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f, indent=1)
+        os.replace(tmp, path)
+    except OSError:  # persistence is best-effort; the line already printed
+        pass
 
 
 def main() -> int:

@@ -172,24 +172,28 @@ def _ok(phase, **data):
     return {"phase": phase, "ok": True, "data": data}
 
 
-def _run_orchestrator(bench, spawns):
+def _run_orchestrator(bench, tmp_path, spawns):
     lines = []
     _FakeChild.spawns = spawns
     _FakeChild.killed = []
     _FakeChild.timeouts = []
     bench._ChildProc = _FakeChild
     bench._emit = lambda payload: lines.append(json.loads(json.dumps(payload)))
+    # a successful fake TPU run self-persists artifacts/BENCH_MIDROUND.json
+    # (_persist_midround) — point HERE at pytest's managed tmp dir so
+    # orchestrator tests can never overwrite the repo's committed record
+    bench.HERE = str(tmp_path)
     assert bench.orchestrate() == 0
     assert not _FakeChild.spawns, "orchestrator under-spawned"
     return lines
 
 
-def test_orchestrator_happy_path(monkeypatch):
+def test_orchestrator_happy_path(monkeypatch, tmp_path):
     """One child serves every phase; a cumulative line lands after each;
     the tail line is the richest and is final (partial=False)."""
     bench = _load_bench(monkeypatch)
     all_phases = list(bench.PHASES)
-    lines = _run_orchestrator(bench, [(all_phases, [
+    lines = _run_orchestrator(bench, tmp_path, [(all_phases, [
         _ok("probe", device="TPU v5e", platform="tpu", n_devices=1),
         _ok("flagship", flagship_imgs_per_sec=1000.0, step_time_ms=2.56,
             mfu=0.41, preset="full"),
@@ -210,12 +214,12 @@ def test_orchestrator_happy_path(monkeypatch):
     assert len(lines) == 2 + len(bench.PHASES)
 
 
-def test_orchestrator_survives_hang_and_respawns(monkeypatch):
+def test_orchestrator_survives_hang_and_respawns(monkeypatch, tmp_path):
     """A child wedged mid-flagship (the round-3 killer) costs exactly that
     phase: the parent kills it, respawns for the remainder, and the tail
     line still carries everything else."""
     bench = _load_bench(monkeypatch)
-    lines = _run_orchestrator(bench, [
+    lines = _run_orchestrator(bench, tmp_path, [
         (list(bench.PHASES), [
             _ok("probe", device="TPU v5e", platform="tpu", n_devices=1),
             "hang",  # flagship compile wedged in C++
@@ -235,14 +239,14 @@ def test_orchestrator_survives_hang_and_respawns(monkeypatch):
     assert _FakeChild.killed  # the wedged child was hard-killed
 
 
-def test_orchestrator_cpu_fallback_after_two_init_failures(monkeypatch):
+def test_orchestrator_cpu_fallback_after_two_init_failures(monkeypatch, tmp_path):
     """Two consecutive init failures degrade to the clearly-labeled CPU
     smoke tier; the TPU error stays on the line."""
     bench = _load_bench(monkeypatch)
     init_fail = [{"phase": "__init__", "ok": False,
                   "data": {"error": "TimeoutError: init exceeded 240s"}}]
     all_phases = list(bench.PHASES)
-    lines = _run_orchestrator(bench, [
+    lines = _run_orchestrator(bench, tmp_path, [
         (all_phases, list(init_fail)),
         (all_phases, list(init_fail)),
         (all_phases, [  # post-fallback child, now on cpu
@@ -261,14 +265,14 @@ def test_orchestrator_cpu_fallback_after_two_init_failures(monkeypatch):
     os.environ.pop("BENCH_PLATFORM", None)  # orchestrate mutated real env
 
 
-def test_orchestrator_no_cpu_fallback_env(monkeypatch):
+def test_orchestrator_no_cpu_fallback_env(monkeypatch, tmp_path):
     """BENCH_NO_CPU_FALLBACK=1 restores fail-hard: two init failures end
     the run with the error on the line and every phase unresolved."""
     bench = _load_bench(monkeypatch, BENCH_NO_CPU_FALLBACK="1")
     init_fail = [{"phase": "__init__", "ok": False,
                   "data": {"error": "RuntimeError: UNAVAILABLE"}}]
     all_phases = list(bench.PHASES)
-    lines = _run_orchestrator(bench, [
+    lines = _run_orchestrator(bench, tmp_path, [
         (all_phases, list(init_fail)),
         (all_phases, list(init_fail)),
     ])
@@ -279,14 +283,14 @@ def test_orchestrator_no_cpu_fallback_env(monkeypatch):
     assert os.environ.get("BENCH_PLATFORM") is None
 
 
-def test_orchestrator_counts_silent_child_death_as_init_failure(monkeypatch):
+def test_orchestrator_counts_silent_child_death_as_init_failure(monkeypatch, tmp_path):
     """A child that dies before emitting ANY marker line (native crash in
     the PJRT client during backend init — no Python exception, no __init__
     report) must count toward the init-failure fallback policy instead of
     burning one phase per crash."""
     bench = _load_bench(monkeypatch)
     all_phases = list(bench.PHASES)
-    lines = _run_orchestrator(bench, [
+    lines = _run_orchestrator(bench, tmp_path, [
         (all_phases, [None]),  # EOF with zero events
         (all_phases, [None]),  # again → 2 init failures → CPU fallback
         (all_phases, [
@@ -307,14 +311,14 @@ def test_orchestrator_counts_silent_child_death_as_init_failure(monkeypatch):
     os.environ.pop("BENCH_PLATFORM", None)  # orchestrate mutated real env
 
 
-def test_first_event_budget_includes_init_grace(monkeypatch):
+def test_first_event_budget_includes_init_grace(monkeypatch, tmp_path):
     """A child's FIRST event window covers process start + jax import + the
     backend-init watchdog; later phases in the same child get the bare
     phase budget. A respawned child's first phase gets the grace again —
     otherwise an init hang after a mid-run kill would be misclassified as
     a per-phase timeout and never count toward the CPU fallback."""
     bench = _load_bench(monkeypatch)
-    lines = _run_orchestrator(bench, [
+    lines = _run_orchestrator(bench, tmp_path, [
         (list(bench.PHASES), [
             _ok("probe", device="TPU v5e", platform="tpu", n_devices=1),
             "hang",  # flagship wedged -> kill -> respawn
@@ -335,7 +339,7 @@ def test_first_event_budget_includes_init_grace(monkeypatch):
     assert lines[-1]["phases"]["baseline"] == "ok"
 
 
-def test_cpu_fallback_gets_fresh_init_failure_budget(monkeypatch):
+def test_cpu_fallback_gets_fresh_init_failure_budget(monkeypatch, tmp_path):
     """After the fallback engages, init_failures is reset: one CPU-child
     hiccup (timeout/early exit) must trigger a respawn, not abort the whole
     orchestration."""
@@ -343,7 +347,7 @@ def test_cpu_fallback_gets_fresh_init_failure_budget(monkeypatch):
     init_fail = [{"phase": "__init__", "ok": False,
                   "data": {"error": "TimeoutError: init exceeded 240s"}}]
     all_phases = list(bench.PHASES)
-    lines = _run_orchestrator(bench, [
+    lines = _run_orchestrator(bench, tmp_path, [
         (all_phases, list(init_fail)),
         (all_phases, list(init_fail)),       # -> CPU fallback
         (all_phases, [
@@ -363,7 +367,7 @@ def test_cpu_fallback_gets_fresh_init_failure_budget(monkeypatch):
     os.environ.pop("BENCH_PLATFORM", None)  # orchestrate mutated real env
 
 
-def test_orchestrator_waits_for_abandoned_drain(monkeypatch):
+def test_orchestrator_waits_for_abandoned_drain(monkeypatch, tmp_path):
     """After the last phase reports, the parent must NOT kill the child
     immediately: an abandoned phase's daemon thread may still be inside a
     remote compile, and killing the process mid-request wedges the
@@ -371,7 +375,7 @@ def test_orchestrator_waits_for_abandoned_drain(monkeypatch):
     for the child's __drain__ report + EOF; the kill is a no-op backstop."""
     bench = _load_bench(monkeypatch)
     all_phases = list(bench.PHASES)
-    lines = _run_orchestrator(bench, [(all_phases, [
+    lines = _run_orchestrator(bench, tmp_path, [(all_phases, [
         _ok("probe", device="TPU v5e", platform="tpu", n_devices=1),
         _ok("flagship", flagship_imgs_per_sec=1000.0, step_time_ms=2.56,
             preset="full"),
@@ -389,12 +393,12 @@ def test_orchestrator_waits_for_abandoned_drain(monkeypatch):
     assert _FakeChild.killed == [True]  # backstop fired once, after EOF
 
 
-def test_orchestrator_kills_immediately_on_giveup(monkeypatch):
+def test_orchestrator_kills_immediately_on_giveup(monkeypatch, tmp_path):
     """A parent-side timeout means the child is WEDGED — the kill backstop
     must fire without a drain wait (waiting on a wedged child would burn
     the remaining window for nothing)."""
     bench = _load_bench(monkeypatch)
-    lines = _run_orchestrator(bench, [
+    lines = _run_orchestrator(bench, tmp_path, [
         (list(bench.PHASES), [
             _ok("probe", device="TPU v5e", platform="tpu", n_devices=1),
             _ok("flagship", flagship_imgs_per_sec=1000.0, preset="full"),
@@ -432,3 +436,42 @@ def test_run_with_deadline_registers_abandoned_thread(monkeypatch):
     release.set()  # the "compile" finishes; the drain join must succeed
     t.join(5.0)
     assert not t.is_alive()
+
+
+def test_midround_self_persists_on_full_tpu_run(monkeypatch, tmp_path):
+    """A fully-successful TPU run writes artifacts/BENCH_MIDROUND.json
+    (in the scratch HERE) so later bench lines can point at it; a
+    CPU-tier or partial run must NOT (same bar as the pointer guard)."""
+    bench = _load_bench(monkeypatch)
+    all_phases = list(bench.PHASES)
+    _run_orchestrator(bench, tmp_path, [(all_phases, [
+        _ok("probe", device="TPU v5e", platform="tpu", n_devices=1),
+        _ok("flagship", flagship_imgs_per_sec=1000.0, step_time_ms=2.56,
+            preset="full"),
+        _ok("baseline", baseline_imgs_per_sec=100.0),
+        _ok("gpt", gpt={"step_time_ms": 50.0}),
+        _ok("overlap", overlap={"combiner_merged": True}),
+        None,
+    ])])
+    path = os.path.join(bench.HERE, "artifacts", "BENCH_MIDROUND.json")
+    with open(path) as f:
+        rec = json.load(f)
+    assert rec["flagship_imgs_per_sec"] == 1000.0
+    assert rec["platform"] == "tpu" and rec["phases"]["baseline"] == "ok"
+    assert "midround_chip_bench" not in rec  # no self-reference chains
+
+    # CPU probe (smoke tier): nothing persisted
+    bench2 = _load_bench(monkeypatch)
+    cpu_dir = tmp_path / "cpu-run"
+    cpu_dir.mkdir()
+    _run_orchestrator(bench2, cpu_dir, [(all_phases, [
+        _ok("probe", device="cpu", platform="cpu", n_devices=8),
+        _ok("flagship", flagship_imgs_per_sec=60.0, preset="small"),
+        _ok("baseline", baseline_imgs_per_sec=30.0),
+        _ok("gpt", gpt={"step_time_ms": 50.0}),
+        _ok("overlap", overlap={"combiner_merged": True}),
+        None,
+    ])])
+    assert not os.path.exists(
+        os.path.join(str(cpu_dir), "artifacts", "BENCH_MIDROUND.json")
+    )
