@@ -92,9 +92,13 @@ PHASE_BUDGET_S = {
     "flagship": int(os.environ.get("BENCH_FLAGSHIP_BUDGET_S", "330")),
     "baseline": int(os.environ.get("BENCH_BASELINE_BUDGET_S", "240")),
     "gpt": int(os.environ.get("BENCH_GPT_BUDGET_S", "420")),
+    "fp32arm": int(os.environ.get("BENCH_FP32ARM_BUDGET_S", "240")),
     "overlap": int(os.environ.get("BENCH_OVERLAP_BUDGET_S", "240")),
 }
-PHASES = ("probe", "flagship", "baseline", "gpt", "overlap")
+# priority order under the global deadline: the headline pair first, then
+# the GPT MFU row (verdict item), then the decomposition arm, then the
+# AOT-only overlap evidence
+PHASES = ("probe", "flagship", "baseline", "gpt", "fp32arm", "overlap")
 # extra wait on a child's FIRST event only: process start + jax import +
 # the backend-init watchdog (BENCH_INIT_TIMEOUT_S, default 240 s) all
 # precede it. Without this, a respawned child that hangs at init would be
@@ -261,20 +265,27 @@ def _phase_probe() -> dict:
     }
 
 
-def _phase_flagship() -> dict:
-    """bf16 MXU compute + scanned epoch runner, AOT-compiled so the MFU
-    numerator is the cost analysis of the EXACT executable timed."""
+def _median(xs):
+    import statistics
+
+    return statistics.median(xs)
+
+
+def _scanned_cifar_setup(dtype):
+    """Build + AOT-compile the CHUNK-scanned CIFAR train step — ONE scaffold
+    shared by the flagship (bf16) and fp32 decomposition arms, so the pair
+    differs in nothing but dtype and the comparison isolates exactly that.
+    Returns ``(scanned, state, chunk_batch, compiled, batch_size, small)``."""
     import jax
     import jax.numpy as jnp
 
     from network_distributed_pytorch_tpu.experiments.common import image_classifier_loss
     from network_distributed_pytorch_tpu.parallel import ExactReducer, make_mesh
     from network_distributed_pytorch_tpu.parallel.trainer import make_scanned_train_fn
-    from network_distributed_pytorch_tpu.utils.timing import wait_result
 
     small = _small_preset()
     batch_size = 32 if small else 256  # reference global batch — ddp_init.py:49
-    model = _make_model(jnp.bfloat16, small)
+    model = _make_model(dtype, small)
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=True)
     loss_fn = image_classifier_loss(model, has_batch_stats=True)
     scanned = make_scanned_train_fn(
@@ -290,6 +301,37 @@ def _phase_flagship() -> dict:
         jnp.broadcast_to(batch[1][None], (CHUNK,) + batch[1].shape),
     )
     compiled = scanned.fn.lower(state, chunk_batch).compile()
+    return scanned, state, chunk_batch, compiled, batch_size, small
+
+
+def _timed_dispatches(compiled, state, chunk_batch, reps):
+    """Warmup + ``reps`` fetch-to-observe timed CHUNK-step dispatches.
+    Returns ``(state, sorted_times_s)`` (round-4 verdict weak #1: one-shot
+    timings through a contended tunnel showed a 54% spread across runs —
+    22.8k vs 35.0k imgs/sec; every published rate needs median + spread)."""
+    from network_distributed_pytorch_tpu.utils.timing import wait_result
+
+    state, losses = compiled(state, chunk_batch)  # warmup
+    wait_result(losses)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        state, losses = compiled(state, chunk_batch)
+        wait_result(losses)  # fetch-to-observe-completion, utils.timing
+        times.append(time.perf_counter() - t0)
+    return state, sorted(times)
+
+
+def _phase_flagship() -> dict:
+    """bf16 MXU compute + scanned epoch runner, AOT-compiled so the MFU
+    numerator is the cost analysis of the EXACT executable timed."""
+    import jax
+    import jax.numpy as jnp
+
+    t_phase0 = time.perf_counter()
+    scanned, state, chunk_batch, compiled, batch_size, small = (
+        _scanned_cifar_setup(jnp.bfloat16)
+    )
     flops_chunk = 0.0
     try:
         ca = compiled.cost_analysis()
@@ -297,16 +339,18 @@ def _phase_flagship() -> dict:
         flops_chunk = float(ca.get("flops", 0.0))
     except Exception:  # cost analysis is best-effort; MFU just goes unreported
         pass
-    state, losses = compiled(state, chunk_batch)  # warmup
-    wait_result(losses)
-    t0 = time.perf_counter()
-    state, losses = compiled(state, chunk_batch)
-    wait_result(losses)  # fetch-to-observe-completion, utils.timing
-    dt = time.perf_counter() - t0
+    reps = max(1, int(os.environ.get("BENCH_FLAGSHIP_REPS", "5")))
+    state, times = _timed_dispatches(compiled, state, chunk_batch, reps)
+    dt = _median(times)
     out = {
         "preset": "small" if small else "full",
         "flagship_imgs_per_sec": round(batch_size * CHUNK / dt, 2),
         "step_time_ms": round(1000.0 * dt / CHUNK, 4),
+        "flagship_reps": reps,
+        # min dispatch time -> max rate and vice versa
+        "flagship_imgs_per_sec_max": round(batch_size * CHUNK / times[0], 2),
+        "flagship_imgs_per_sec_min": round(batch_size * CHUNK / times[-1], 2),
+        "dispatch_times_ms": [round(1000.0 * t, 2) for t in times],
     }
     # flops_chunk ÷ CHUNK is only valid where the compiler's cost analysis
     # multiplies the scan body by its trip count. The TPU toolchain does
@@ -318,8 +362,97 @@ def _phase_flagship() -> dict:
     # must not publish a flops number known to be wrong by ~CHUNK×.
     peak = _peak_flops(jax.devices()[0])
     if flops_chunk > 0 and peak > 0:
-        out["mfu"] = round(flops_chunk / dt / peak, 4)
-        out["flops_per_step"] = flops_chunk / CHUNK
+        # advisor r4: don't trust the trip-count-multiplied semantic as a
+        # toolchain invariant — cross-check against a chunk-1 lowering of
+        # the SAME program each run (compile-only; cached after the first
+        # run). Ratio ~CHUNK confirms multiplied semantics; ~1 means the
+        # toolchain switched to count-once (then flops_chunk IS one step);
+        # anything else withholds MFU rather than publishing a number
+        # known to be wrong by up to CHUNK x.
+        per_step = None
+        # the cross-check costs one extra (cacheable) compile AFTER the
+        # timing is already measured — it must never cost the phase its
+        # headline number. Bound it by the REAL budget this phase has left
+        # (same clock as child_main: static budget minus 45, capped by the
+        # global deadline), run the compile in a daemon thread, and on
+        # timeout abandon it into _ABANDONED_THREADS (the child drains
+        # those before exit — an abandoned remote compile must never die
+        # with the process, that's the tunnel-wedge failure mode) and
+        # publish with the historically-validated division instead.
+        elapsed = time.perf_counter() - t_phase0
+        budget_left = PHASE_BUDGET_S.get("flagship", 330) - 45.0 - elapsed
+        deadline_unix = float(os.environ.get("BENCH_DEADLINE_UNIX", "0"))
+        if deadline_unix:
+            budget_left = min(budget_left, deadline_unix - time.time() - 45.0)
+        xcheck_s = min(
+            budget_left - 20.0,
+            float(os.environ.get("BENCH_CROSSCHECK_SOFT_S", "150")),
+        )
+        if xcheck_s < 20.0:
+            per_step = flops_chunk / CHUNK
+            out["flops_method"] = (
+                "hlo scan-trip-multiplied (cross-check skipped: "
+                f"{int(max(0, budget_left))}s of phase budget left)"
+            )
+            out["mfu"] = round(per_step / (dt / CHUNK) / peak, 4)
+            out["flops_per_step"] = per_step
+            return out
+        try:
+            one_batch = (
+                chunk_batch[0][:1],
+                chunk_batch[1][:1],
+            )
+            xbox: dict = {}
+
+            def _xcheck():
+                try:
+                    ca1 = scanned.fn.lower(state, one_batch).compile()
+                    xbox["ca"] = ca1.cost_analysis()
+                except BaseException as e:  # noqa: BLE001 — relayed
+                    xbox["error"] = e
+
+            xt = threading.Thread(
+                target=_xcheck, daemon=True, name="flagship-crosscheck"
+            )
+            xt.start()
+            xt.join(xcheck_s)
+            if xt.is_alive():
+                _ABANDONED_THREADS["flagship_crosscheck"] = xt
+                raise TimeoutError(f"chunk-1 compile exceeded {int(xcheck_s)}s")
+            if "error" in xbox:
+                raise xbox["error"]
+            ca1 = xbox["ca"]
+            ca1 = ca1[0] if isinstance(ca1, (list, tuple)) else ca1
+            flops_1 = float(ca1.get("flops", 0.0))
+            if flops_1 <= 0:
+                # the chunk-1 analysis returned no flops — the cross-check
+                # is UNAVAILABLE, not a mismatch (same best-effort caveat
+                # as the except path below)
+                raise ValueError("chunk-1 cost analysis returned no flops")
+            ratio = flops_chunk / flops_1
+            out["flops_chunk_ratio"] = round(ratio, 2)
+            if 0.5 * CHUNK <= ratio <= 2.0 * CHUNK:
+                per_step = flops_chunk / CHUNK
+                out["flops_method"] = "hlo scan-trip-multiplied (chunk-1 cross-checked)"
+            elif 0.5 <= ratio <= 2.0:
+                per_step = flops_chunk
+                out["flops_method"] = "hlo count-once (chunk-1 cross-checked)"
+        except Exception as e:  # noqa: BLE001 — cross-check is best-effort;
+            # an uncross-checked number keeps the historically-validated
+            # division but says so
+            per_step = flops_chunk / CHUNK
+            out["flops_method"] = (
+                "hlo scan-trip-multiplied (cross-check unavailable: "
+                f"{type(e).__name__}: {e})"[:160]
+            )
+        if per_step is not None:
+            out["mfu"] = round(per_step / (dt / CHUNK) / peak, 4)
+            out["flops_per_step"] = per_step
+        else:
+            out["mfu_withheld"] = (
+                f"flops_chunk/flops_1 ratio {out.get('flops_chunk_ratio')} "
+                f"matches neither ~{CHUNK} (trip-multiplied) nor ~1 (count-once)"
+            )
     return out
 
 
@@ -348,14 +481,52 @@ def _phase_baseline() -> dict:
     batch = _cifar_batch(batch_size)
     state, loss = step(state, batch)  # compile + warmup
     wait_result(loss)
-    t0 = time.perf_counter()
-    for _ in range(BASELINE_REPS):
-        state, loss = step(state, batch)
-    wait_result(loss)  # fetch-to-observe-completion, utils.timing
-    dt = time.perf_counter() - t0
+    # two independent timed passes (round-4 verdict weak #5: vs_baseline
+    # rested on a single unreplicated pair); each pass pays the host round
+    # trip every step by design — that is this arm's whole point
+    passes = max(1, int(os.environ.get("BENCH_BASELINE_PASSES", "2")))
+    rates = []
+    for _ in range(passes):
+        t0 = time.perf_counter()
+        for _ in range(BASELINE_REPS):
+            state, loss = step(state, batch)
+        wait_result(loss)  # fetch-to-observe-completion, utils.timing
+        rates.append(batch_size * BASELINE_REPS / (time.perf_counter() - t0))
+    med = _median(rates)
     return {
-        "baseline_imgs_per_sec": round(batch_size * BASELINE_REPS / dt, 2),
-        "baseline_step_time_ms": round(1000.0 * dt / BASELINE_REPS, 4),
+        "baseline_imgs_per_sec": round(med, 2),
+        "baseline_step_time_ms": round(1000.0 * batch_size / med, 4),
+        "baseline_passes": [round(r, 2) for r in sorted(rates)],
+    }
+
+
+def _phase_fp32arm() -> dict:
+    """fp32 + scanned dispatch: the decomposition arm (round-4 verdict weak
+    #5). The flagship/baseline pair differs in BOTH dtype (bf16 vs fp32) and
+    dispatch structure (one scanned CHUNK-step dispatch vs one host dispatch
+    per step); this arm holds the scanned dispatch fixed and swaps only the
+    dtype, so  fp32arm/baseline  isolates dispatch amortization and
+    flagship/fp32arm  isolates bf16-on-MXU. Identical protocol to the
+    flagship by construction (``_scanned_cifar_setup``/``_timed_dispatches``
+    are the same code)."""
+    import jax.numpy as jnp
+
+    _, state, chunk_batch, compiled, batch_size, small = _scanned_cifar_setup(
+        jnp.float32
+    )
+    reps = max(1, int(os.environ.get("BENCH_FP32ARM_REPS", "3")))
+    state, times = _timed_dispatches(compiled, state, chunk_batch, reps)
+    dt = _median(times)
+    return {
+        # same tier-labeling contract as the flagship: a small-preset rate
+        # must never be readable as the full ResNet-50/batch-256 number
+        "preset": "small" if small else "full",
+        "fp32_scanned_imgs_per_sec": round(batch_size * CHUNK / dt, 2),
+        "fp32_scanned_step_time_ms": round(1000.0 * dt / CHUNK, 4),
+        "fp32_scanned_reps": reps,
+        "fp32_scanned_imgs_per_sec_max": round(batch_size * CHUNK / times[0], 2),
+        "fp32_scanned_imgs_per_sec_min": round(batch_size * CHUNK / times[-1], 2),
+        "fp32_dispatch_times_ms": [round(1000.0 * t, 2) for t in times],
     }
 
 
@@ -524,6 +695,7 @@ _PHASE_FNS = {
     "flagship": _phase_flagship,
     "baseline": _phase_baseline,
     "gpt": _phase_gpt,
+    "fp32arm": _phase_fp32arm,
     "overlap": _phase_overlap,
 }
 
@@ -594,7 +766,6 @@ def child_main(phase_list: list) -> int:
     # near the end of the global window the parent's cap is the SMALLER
     # `left() - 15`, so the child's deadline must track the same clock.
     deadline_unix = float(os.environ.get("BENCH_DEADLINE_UNIX", "0")) or None
-    abandoned: list = []
     for name in phase_list:
         try:
             budget = float(PHASE_BUDGET_S.get(name, 240)) - 45.0
@@ -613,20 +784,27 @@ def child_main(phase_list: list) -> int:
                     "(global deadline near, or a static BENCH_*_BUDGET_S "
                     "under 75s)"
                 )
+            # an earlier abandoned thread — a whole phase's, or an intra-
+            # phase one like the flagship FLOPs cross-check compile — may
+            # still be compiling/executing on the device while THIS phase
+            # runs: its timed numbers shared the chip with that drain; say
+            # so. _ABANDONED_THREADS (filtered to alive at phase START) is
+            # the one registry both kinds land in; the liveness filter
+            # keeps threads that finished draining before this phase — and
+            # a phase's own late-abandoned helper, which never overlapped
+            # its timing — off the label.
+            live = sorted(
+                n for n, t in _ABANDONED_THREADS.items() if t.is_alive()
+            )
             if name == "probe":
                 data = _PHASE_FNS[name]()
             else:
                 data = _run_with_deadline(name, _PHASE_FNS[name], budget)
-            if abandoned:
-                # an earlier abandoned phase's daemon thread may still be
-                # compiling/executing on the device — timed numbers from
-                # this phase shared the chip with that drain; say so
-                data["concurrent_abandoned"] = list(abandoned)
+            if live:
+                data["concurrent_abandoned"] = live
             _child_emit(name, True, data)
         except Exception as e:  # noqa: BLE001 — a phase crash must not
             # take down the phases behind it
-            if isinstance(e, _PhaseAbandoned):
-                abandoned.append(name)
             _child_emit(name, False, {"error": f"{type(e).__name__}: {e}"[:400]})
     if _ABANDONED_THREADS:
         # drain abandoned compiles before exiting: daemon threads die with
@@ -678,7 +856,7 @@ def _artifact_pointers(out: dict) -> None:
                 "accuracy_delta_pts": st[t].get("accuracy_delta_pts"),
                 "gradient_bytes_ratio": st[t].get("gradient_bytes_ratio"),
             }
-            for t in ("cifar", "imdb")
+            for t in ("cifar", "imdb", "imdb_wide")
             if t in st
         }
     except Exception:  # noqa: BLE001 — pointer only
@@ -694,15 +872,31 @@ def _artifact_pointers(out: dict) -> None:
             and mid.get("flagship_imgs_per_sec")
             and mid.get("phases", {}).get("flagship") == "ok"
         ):
-            keys = ["device", "recorded_unix", "flagship_imgs_per_sec", "mfu"]
+            keys = [
+                "device", "recorded_unix", "flagship_imgs_per_sec", "mfu",
+                "flagship_imgs_per_sec_min", "flagship_imgs_per_sec_max",
+                "flagship_reps",
+            ]
             if mid.get("phases", {}).get("baseline") == "ok":
                 # baseline-derived fields only when THAT phase was also
                 # plain-ok TPU — a fallback-tier baseline must not be
                 # re-exported under the chip label either
-                keys += ["baseline_imgs_per_sec", "vs_baseline"]
-            out["midround_chip_bench"] = {
-                k: mid.get(k) for k in keys if mid.get(k) is not None
-            }
+                keys += ["baseline_imgs_per_sec", "baseline_passes", "vs_baseline"]
+            if mid.get("phases", {}).get("fp32arm") == "ok":
+                keys += ["fp32_scanned_imgs_per_sec"]
+            rec = {k: mid.get(k) for k in keys if mid.get(k) is not None}
+            if mid.get("phases", {}).get("gpt") == "ok" and isinstance(
+                mid.get("gpt"), dict
+            ):
+                # re-export WITH the model/shape label: an unlabeled toy-
+                # tier MFU under this key would read as the 124M chip MFU
+                g = mid["gpt"]
+                rec["gpt"] = {
+                    k: g.get(k)
+                    for k in ("model", "seq_len", "mfu", "tokens_per_sec")
+                    if g.get(k) is not None
+                }
+            out["midround_chip_bench"] = rec
     except Exception:  # noqa: BLE001 — pointer only
         pass
 
@@ -783,16 +977,23 @@ def _await_child_exit(child, out: dict, left) -> None:
     the child to drain abandoned compiles and exit by itself, recording its
     ``__drain__`` report if one arrives. See the caller's comment: killing
     a child mid-remote-compile is the tunnel-wedge failure mode."""
+    import queue
+
     while True:
         budget = min(left() - 10.0, 300.0)
         if budget <= 0:
             return  # window truly spent — the backstop kill may fire
         try:
             ev = child.next_event(budget)
-        except Exception:  # noqa: BLE001 — queue.Empty is a POLL timeout,
-            # not the window: keep waiting until left() runs out (returning
-            # here would kill mid-drain with window remaining — the wedge)
+        except queue.Empty:  # a POLL timeout, not the window: keep waiting
+            # until left() runs out (returning here would kill mid-drain
+            # with window remaining — the wedge)
             continue
+        except Exception:  # noqa: BLE001 — advisor r4: a persistent
+            # non-Empty error (broken queue after reader-thread death)
+            # means the child is effectively gone; looping on it would
+            # burn the whole remaining window before the backstop kill
+            return
         if ev is None:  # child exited cleanly
             return
         if ev.get("phase") == "__drain__":
@@ -802,6 +1003,19 @@ def _await_child_exit(child, out: dict, left) -> None:
 
 def orchestrate() -> int:
     t_start = time.time()
+    # advisor r4: a statically configured BENCH_*_BUDGET_S below 75 s means
+    # the child-side skip rule (budget - 45 <= 30) suppresses that phase on
+    # EVERY run — surface the misconfiguration instead of letting it read
+    # as a mysterious per-run timeout
+    for _name, _b in PHASE_BUDGET_S.items():
+        # child-side skip: budget-45 must EXCEED 30, so 75 itself skips
+        if _name != "probe" and _b <= 75:
+            print(
+                f"# bench: WARNING: {_name} budget {_b}s <= 75s implies a "
+                "permanent skip (child-side rule: budget-45 must exceed "
+                "30s); raise BENCH_" + _name.upper() + "_BUDGET_S",
+                file=sys.stderr, flush=True,
+            )
     # children self-deadline against the SAME absolute clock the parent
     # kills by, so near the end of the window the child still reports (and
     # survives) before the parent's `left() - 15` cap would SIGKILL it

@@ -116,11 +116,20 @@ def time_gpt_train_step(
     )
     state, l = compiled(state, batch_xy)  # warmup
     wait_result(l)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        state, l = compiled(state, batch_xy)
-    wait_result(l)  # fetch-to-observe-completion, utils.timing
-    dt = (time.perf_counter() - t0) / reps
+    # 3 independent timed bursts of ``reps`` steps each; the published step
+    # time is the MEDIAN burst (round-4 verdict: one-shot timings through a
+    # contended tunnel carry a large spread — error bars or it didn't happen)
+    import statistics
+
+    bursts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            state, l = compiled(state, batch_xy)
+        wait_result(l)  # fetch-to-observe-completion, utils.timing
+        bursts.append((time.perf_counter() - t0) / reps)
+    bursts.sort()
+    dt = statistics.median(bursts)
     out = {
         "model": "gpt_tiny" if small else "gpt2_small_124M",
         "seq_len": seq_len,
@@ -128,6 +137,7 @@ def time_gpt_train_step(
         "attn_impl": attn_impl,
         "scan_layers": scan_layers,
         "step_time_ms": round(1000.0 * dt, 3),
+        "step_time_ms_bursts": [round(1000.0 * b, 3) for b in bursts],
         "tokens_per_sec": round(batch * seq_len / dt, 1),
         "n_params": n_params,
         "flops_per_step": analytic_flops,

@@ -199,6 +199,7 @@ def test_orchestrator_happy_path(monkeypatch, tmp_path):
             mfu=0.41, preset="full"),
         _ok("baseline", baseline_imgs_per_sec=100.0),
         _ok("gpt", gpt={"step_time_ms": 50.0, "mfu": 0.35}),
+        _ok("fp32arm", fp32_scanned_imgs_per_sec=300.0),
         _ok("overlap", overlap={"combiner_merged": True}),
         None,
     ])])
@@ -224,9 +225,10 @@ def test_orchestrator_survives_hang_and_respawns(monkeypatch, tmp_path):
             _ok("probe", device="TPU v5e", platform="tpu", n_devices=1),
             "hang",  # flagship compile wedged in C++
         ]),
-        (["baseline", "gpt", "overlap"], [
+        (["baseline", "gpt", "fp32arm", "overlap"], [
             _ok("baseline", baseline_imgs_per_sec=100.0),
             _ok("gpt", gpt={"step_time_ms": 50.0}),
+            _ok("fp32arm", fp32_scanned_imgs_per_sec=300.0),
             _ok("overlap", overlap={"combiner_merged": True}),
             None,
         ]),
@@ -323,9 +325,10 @@ def test_first_event_budget_includes_init_grace(monkeypatch, tmp_path):
             _ok("probe", device="TPU v5e", platform="tpu", n_devices=1),
             "hang",  # flagship wedged -> kill -> respawn
         ]),
-        (["baseline", "gpt", "overlap"], [
+        (["baseline", "gpt", "fp32arm", "overlap"], [
             _ok("baseline", baseline_imgs_per_sec=100.0),
             _ok("gpt", gpt={}),
+            _ok("fp32arm", fp32_scanned_imgs_per_sec=300.0),
             _ok("overlap", overlap={}),
             None,
         ]),
@@ -354,9 +357,10 @@ def test_cpu_fallback_gets_fresh_init_failure_budget(monkeypatch, tmp_path):
             _ok("probe", device="cpu", platform="cpu", n_devices=8),
             "hang",                           # CPU child wedges on flagship
         ]),
-        (["baseline", "gpt", "overlap"], [   # ...and is respawned, not aborted
+        (["baseline", "gpt", "fp32arm", "overlap"], [  # respawned, not aborted
             _ok("baseline", baseline_imgs_per_sec=25.0),
             _ok("gpt", gpt={}),
+            _ok("fp32arm", fp32_scanned_imgs_per_sec=30.0),
             _ok("overlap", overlap={}),
             None,
         ]),
@@ -404,6 +408,7 @@ def test_orchestrator_kills_immediately_on_giveup(monkeypatch, tmp_path):
             _ok("flagship", flagship_imgs_per_sec=1000.0, preset="full"),
             _ok("baseline", baseline_imgs_per_sec=100.0),
             _ok("gpt", gpt={"step_time_ms": 50.0}),
+            _ok("fp32arm", fp32_scanned_imgs_per_sec=300.0),
             "hang",  # overlap wedged — the LAST pending phase
         ]),
     ])
