@@ -14,6 +14,7 @@ from .distilbert import (  # noqa: F401
     DistilBertForSequenceClassification,
     distilbert_base,
     distilbert_tiny,
+    distilbert_wide,
 )
 from .gpt import (  # noqa: F401
     GPTConfig,

@@ -244,9 +244,19 @@ def cifar_study(max_epochs: int, patience: int, data_seed: int = 0) -> dict:
     }
 
 
-def imdb_study(max_epochs: int, patience: int, data_seed: int = 0) -> dict:
-    """DistilBERT-tiny on class-separable synthetic reviews: exact vs
-    PowerSGD r=16 (the reference's IMDb rank, ddp_init.py:38)."""
+def imdb_study(
+    max_epochs: int, patience: int, data_seed: int = 0, wide: bool = False
+) -> dict:
+    """DistilBERT on class-separable synthetic reviews: exact vs PowerSGD
+    r=16 (the reference's IMDb rank, ddp_init.py:38).
+
+    Two tiers. ``tiny`` (dim 32): the historical row — its 1.5× measured
+    byte ratio is BY CONSTRUCTION (r=16 meets min(n,m)=32 at half rank), so
+    it cannot carry the compression claim. ``wide`` (dim 256, depth 1,
+    round-4 verdict weak #4): r=16 ≪ min(n,m)=256, so the measured ratio is
+    algorithmic (≥8×) and a Δ≈0 result makes the reference's flagship text
+    claim (``ddp_powersgd_distillBERT_IMDb/ddp_init.py:163``) non-vacuous
+    in text as in vision. Same label-noise-ceiling protocol either way."""
     import jax
     import jax.numpy as jnp
 
@@ -254,7 +264,10 @@ def imdb_study(max_epochs: int, patience: int, data_seed: int = 0) -> dict:
     from network_distributed_pytorch_tpu.experiments.common import (
         evaluate_text_classifier,
     )
-    from network_distributed_pytorch_tpu.models import distilbert_tiny
+    from network_distributed_pytorch_tpu.models import (
+        distilbert_tiny,
+        distilbert_wide,
+    )
     from network_distributed_pytorch_tpu.parallel import (
         ExactReducer,
         PowerSGDReducer,
@@ -267,12 +280,15 @@ def imdb_study(max_epochs: int, patience: int, data_seed: int = 0) -> dict:
 
     from network_distributed_pytorch_tpu.utils.losses import cross_entropy_loss
 
-    # distilbert_tiny's fixed vocab/positions (vocab 1024, max_len 64);
-    # symmetric label noise rides BOTH splits, so even a perfect classifier
-    # is capped at ~1 - IMDB_LABEL_NOISE on val (its flipped labels are
-    # simply wrong) — the arm separation the round-3 study lacked
+    # fixed vocab 1024; max_len 32 on the wide tier keeps the 1-core step
+    # affordable at dim 256 (tokens/step halves, the matrices — where the
+    # compression claim lives — stay full width). Symmetric label noise
+    # rides BOTH splits, so even a perfect classifier is capped at
+    # ~1 - IMDB_LABEL_NOISE on val (its flipped labels are simply wrong) —
+    # the arm separation the round-3 study lacked
+    max_len = 32 if wide else 64
     train, val, _ = prepare_imdb(
-        max_len=64, synthetic_n=2048, vocab_size=1024, seed=714 + data_seed,
+        max_len=max_len, synthetic_n=2048, vocab_size=1024, seed=714 + data_seed,
         synthetic_kwargs=dict(
             class_word_rate=IMDB_CLASS_WORD_RATE, label_noise=IMDB_LABEL_NOISE
         ),
@@ -283,7 +299,7 @@ def imdb_study(max_epochs: int, patience: int, data_seed: int = 0) -> dict:
     # realized flip fraction wanders ~±1.5 pts around the nominal 12% —
     # an arm can legitimately score above 0.88 on a lucky draw
     _, clean_val, _ = prepare_imdb(
-        max_len=64, synthetic_n=2048, vocab_size=1024, seed=714 + data_seed,
+        max_len=max_len, synthetic_n=2048, vocab_size=1024, seed=714 + data_seed,
         synthetic_kwargs=dict(
             class_word_rate=IMDB_CLASS_WORD_RATE, label_noise=0.0
         ),
@@ -292,10 +308,10 @@ def imdb_study(max_epochs: int, patience: int, data_seed: int = 0) -> dict:
         (val["labels"] != clean_val["labels"]).mean()
     )
     mesh = make_mesh()
-    model = distilbert_tiny(num_labels=2)
+    model = (distilbert_wide if wide else distilbert_tiny)(num_labels=2)
     sample = (
-        jnp.zeros((1, 64), jnp.int32),
-        jnp.ones((1, 64), jnp.int32),
+        jnp.zeros((1, max_len), jnp.int32),
+        jnp.ones((1, max_len), jnp.int32),
     )
     params = model.init(
         jax.random.PRNGKey(0), *sample, deterministic=True
@@ -306,7 +322,9 @@ def imdb_study(max_epochs: int, patience: int, data_seed: int = 0) -> dict:
         logits = model.apply({"params": p}, ids, mask, deterministic=True)
         return cross_entropy_loss(logits, y)
 
-    batch_size, lr = 128, 0.005
+    # wider model -> smaller stable lr; both arms share whichever is used,
+    # so the parity comparison is unaffected by the choice
+    batch_size, lr = 128, (0.002 if wide else 0.005)
 
     def epoch_batches(epoch):
         return iterate_batches(
@@ -339,8 +357,9 @@ def imdb_study(max_epochs: int, patience: int, data_seed: int = 0) -> dict:
 
     exact, psgd = arms["exact"], arms["powersgd_r16"]
     return {
-        "task": "imdb_synthetic_label_noise",
-        "model": "distilbert_tiny",
+        "task": "imdb_synthetic_label_noise" + ("_wide" if wide else ""),
+        "model": "distilbert_wide_d256" if wide else "distilbert_tiny",
+        "max_len": max_len,
         "workers": mesh.size,
         "global_batch": batch_size,
         "lr": lr,
@@ -400,7 +419,10 @@ def _multi_seed(
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--task", default="both", choices=["cifar", "imdb", "both"])
+    ap.add_argument(
+        "--task", default="both",
+        choices=["cifar", "imdb", "imdb_wide", "both", "all"],
+    )
     ap.add_argument("--max-epochs", type=int, default=30)
     ap.add_argument("--patience", type=int, default=5)
     ap.add_argument(
@@ -436,15 +458,23 @@ def main() -> int:
 
         return save
 
-    if args.task in ("cifar", "both"):
+    import functools
+
+    if args.task in ("cifar", "both", "all"):
         _multi_seed(
             cifar_study, args.max_epochs, args.patience, args.seeds,
             _saver("cifar"),
         )
-    if args.task in ("imdb", "both"):
+    if args.task in ("imdb", "both", "all"):
         _multi_seed(
             imdb_study, args.max_epochs, args.patience, args.seeds,
             _saver("imdb"),
+        )
+    if args.task in ("imdb_wide", "all"):
+        _multi_seed(
+            functools.partial(imdb_study, wide=True),
+            args.max_epochs, args.patience, args.seeds,
+            _saver("imdb_wide"),
         )
     # one slim machine-readable line (the full record is in the artifact)
     def _line(rec: dict) -> dict:
@@ -469,7 +499,7 @@ def main() -> int:
         json.dumps(
             {
                 task: _line(out[task])
-                for task in ("cifar", "imdb")
+                for task in ("cifar", "imdb", "imdb_wide")
                 if task in out
             }
         )
