@@ -171,7 +171,14 @@ def collapse_per_worker(model_state: PyTree, reduce: str = "mean") -> PyTree:
     (reproduced thrice at ``test_exact_cifar10_fsdp_strategy`` under CPU
     contention, surviving even a 600 s terminate deadline). BN stats are a
     few KB and eval prep is not a hot path, so the host round trip is the
-    robust choice on every backend."""
+    robust choice on every backend.
+
+    Size assumption: every caller's per-worker model_state today is BN
+    running stats (KBs). A future LARGE per-worker state (e.g. EMA params)
+    would pay a full device->host transfer per eval through this path —
+    at that point add a device-side reduction escape hatch rather than
+    growing this function; the host round trip is deliberate for the
+    deadlock reason above, not a perf choice."""
     model_state = jax.device_get(model_state)
     if reduce == "first":
         return jax.tree_util.tree_map(lambda x: x[0], model_state)

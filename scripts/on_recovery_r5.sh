@@ -1,0 +1,41 @@
+#!/bin/sh
+# Round-5 recovery pipeline: poll the wedged axon tunnel; the moment
+# backend init answers, bank the round's chip evidence in priority order
+# (round-4 verdict items 1-4) before anything else can wedge it again:
+#   1. full bench.py orchestration (flagship error-bars + baseline x2 +
+#      full-shape scanned GPT-124M MFU + fp32 decomposition arm + overlap)
+#      under a generous window so nothing is skipped and the compile cache
+#      is warmed for the driver's own end-of-round run;
+#   2. bandwidth chip compute rows + re-projection (BANDWIDTH.json all-chip).
+# CPU-heavy accuracy studies are stopped first: they're re-runnable per
+# seed, chip timing on the 1-core host is not honest under contention.
+# Leaves /tmp/TUNNEL_RECOVERED + /tmp/R5_CHIP_DONE sentinels.
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/r5_recovery_pipeline.log
+echo "== recovery pipeline armed $(date -u) ==" >> "$LOG"
+
+sh scripts/tunnel_probe.sh "${1:-180}" "${2:-220}" >> "$LOG" 2>&1 || {
+    echo "== probe gave up $(date -u) ==" >> "$LOG"
+    exit 1
+}
+date -u > /tmp/TUNNEL_RECOVERED
+echo "== tunnel recovered $(date -u) — starting chip evidence ==" >> "$LOG"
+
+# clear the 1-core host for honest fetch-to-observe timing (studies persist
+# per-seed and are re-runnable; chip access is the scarce resource)
+pkill -f accuracy_study.py 2>/dev/null
+sleep 2
+
+BENCH_TOTAL_DEADLINE_S=3000 BENCH_GPT_BUDGET_S=900 \
+    python bench.py > /tmp/r5_bench_midround.out 2>> "$LOG"
+echo "== bench rc=$? $(date -u) ==" >> "$LOG"
+tail -1 /tmp/r5_bench_midround.out >> "$LOG"
+
+python scripts/bandwidth_artifact.py chip >> "$LOG" 2>&1
+echo "== bandwidth chip rc=$? $(date -u) ==" >> "$LOG"
+python scripts/bandwidth_artifact.py project >> "$LOG" 2>&1
+echo "== bandwidth project rc=$? $(date -u) ==" >> "$LOG"
+
+date -u > /tmp/R5_CHIP_DONE
+echo "== chip evidence pipeline complete $(date -u) ==" >> "$LOG"
