@@ -1095,8 +1095,15 @@ def orchestrate() -> int:
                     break
                 child_events += 1
                 if ev["phase"] == "__init__":
-                    init_failures += 1
-                    out["tpu_error"] = str(ev["data"].get("error", "?"))[:300]
+                    err = str(ev["data"].get("error", "?"))[:300]
+                    # an init HANG (_InitTimeout after the 240 s watchdog)
+                    # is the wedged-tunnel signature and is decisive: a
+                    # second probe would hang the same way and burn another
+                    # 240 s of the driver's window for the same verdict.
+                    # Transient errors (UNAVAILABLE etc.) return fast and
+                    # keep the two-strike budget.
+                    init_failures += 2 if "_InitTimeout" in err else 1
+                    out["tpu_error"] = err
                     break
                 if ev["phase"] == "__drain__":
                     # the child's end-of-run report on abandoned-compile

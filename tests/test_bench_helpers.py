@@ -480,3 +480,30 @@ def test_midround_self_persists_on_full_tpu_run(monkeypatch, tmp_path):
     assert not os.path.exists(
         os.path.join(str(cpu_dir), "artifacts", "BENCH_MIDROUND.json")
     )
+
+
+def test_init_hang_is_decisive_one_probe_engages_fallback(monkeypatch, tmp_path):
+    """An init HANG (_InitTimeout) is the wedged-tunnel signature: ONE
+    probe engages the CPU fallback — a second 240 s hang would burn the
+    driver's window for the same verdict. Transient errors keep the
+    two-strike budget (see test_orchestrator_cpu_fallback_after_two...)."""
+    bench = _load_bench(monkeypatch)
+    hang = [{"phase": "__init__", "ok": False,
+             "data": {"error": "_InitTimeout: jax backend init exceeded 240s"}}]
+    all_phases = list(bench.PHASES)
+    lines = _run_orchestrator(bench, tmp_path, [
+        (all_phases, list(hang)),  # one hang -> decisive, no second probe
+        (all_phases, [
+            _ok("probe", device="cpu", platform="cpu", n_devices=8),
+            _ok("flagship", flagship_imgs_per_sec=50.0, preset="small"),
+            _ok("baseline", baseline_imgs_per_sec=25.0),
+            _ok("gpt", gpt={"step_time_ms": 400.0}),
+            _ok("fp32arm", fp32_scanned_imgs_per_sec=30.0),
+            _ok("overlap", overlap={"combiner_merged": True}),
+            None,
+        ]),
+    ])
+    tail = lines[-1]
+    assert tail["tpu_error"].startswith("_InitTimeout")
+    assert tail["device"] == "cpu" and tail["value"] == 50.0
+    os.environ.pop("BENCH_PLATFORM", None)  # orchestrate mutated real env
