@@ -1134,10 +1134,13 @@ def orchestrate() -> int:
         if init_failures >= 2 and not cpu_fallback:
             if os.environ.get("BENCH_NO_CPU_FALLBACK") == "1":
                 break
-            # TPU unreachable twice (e.g. a wedged tunnel): degrade to the
-            # CPU smoke tier, clearly labeled; the TPU error stays on the line
+            # TPU init budget spent — one decisive hang, or two transient
+            # failures: degrade to the CPU smoke tier, clearly labeled;
+            # the TPU error stays on the line
             print(
-                "# bench: TPU init failed twice; falling back to CPU smoke tier",
+                "# bench: TPU init failure budget exhausted (a hang is "
+                "decisive; transient errors take two); falling back to CPU "
+                "smoke tier",
                 file=sys.stderr, flush=True,
             )
             os.environ["BENCH_PLATFORM"] = "cpu"
