@@ -1,0 +1,79 @@
+"""The dryrun golden-parity gate itself (``__graft_entry__._expect``):
+tolerance math, drift rejection, the record mode, and the n_devices scoping
+— pure-host checks, no mesh needed. The end-to-end use (every strategy
+path's loss/checksum against ``_GOLDEN_8``) runs in the driver's
+``dryrun_multichip(8)``."""
+
+import importlib.util
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_entry():
+    # import by path: __graft_entry__ lives at the repo root, not in the
+    # package. The instance is shared module-scoped across these tests —
+    # safe because _expect reads os.environ at call time, not import time.
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry_under_test", os.path.join(REPO, "__graft_entry__.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def entry_mod():
+    return _load_entry()
+
+
+def test_expect_accepts_golden_within_tolerance(entry_mod, monkeypatch):
+    monkeypatch.delenv("GRAFT_RECORD_GOLDEN", raising=False)
+    name = next(iter(entry_mod._GOLDEN_8))
+    want = entry_mod._GOLDEN_8[name]
+    entry_mod._expect(name, want, 8)
+    entry_mod._expect(name, want * (1 + 1e-6), 8)  # fp jitter passes
+
+
+def test_expect_rejects_numeric_drift(entry_mod, monkeypatch):
+    monkeypatch.delenv("GRAFT_RECORD_GOLDEN", raising=False)
+    name = next(iter(entry_mod._GOLDEN_8))
+    want = entry_mod._GOLDEN_8[name]
+    with pytest.raises(AssertionError, match="numeric drift"):
+        entry_mod._expect(name, want * 1.001, 8)  # 0.1% is real drift
+
+
+def test_expect_rejects_nonfinite_everywhere(entry_mod, monkeypatch):
+    monkeypatch.delenv("GRAFT_RECORD_GOLDEN", raising=False)
+    with pytest.raises(AssertionError):
+        entry_mod._expect("anything", float("nan"), 4)  # even off-golden n
+
+
+def test_expect_scopes_goldens_to_eight_devices(entry_mod, monkeypatch):
+    monkeypatch.delenv("GRAFT_RECORD_GOLDEN", raising=False)
+    name = next(iter(entry_mod._GOLDEN_8))
+    # wildly wrong value passes at n != 8: goldens are shape-specific
+    entry_mod._expect(name, 1e9, 4)
+
+
+def test_expect_record_mode_prints_instead_of_asserting(
+    entry_mod, monkeypatch, capsys
+):
+    monkeypatch.setenv("GRAFT_RECORD_GOLDEN", "1")
+    name = next(iter(entry_mod._GOLDEN_8))
+    entry_mod._expect(name, 123.456, 8)  # would fail hard in assert mode
+    assert f'"{name}": 123.456' in capsys.readouterr().out
+
+
+def test_golden_table_is_well_formed(entry_mod):
+    """Every golden is a finite float with a healthy magnitude: a value
+    cancelling toward zero would make the relative tolerance meaningless
+    (the abs-sum checksum convention exists to prevent exactly that)."""
+    import math
+
+    assert len(entry_mod._GOLDEN_8) >= 15
+    for name, v in entry_mod._GOLDEN_8.items():
+        assert math.isfinite(v), name
+        assert abs(v) > 1e-3, f"{name}: near-cancelled golden {v}"
