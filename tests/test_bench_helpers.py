@@ -2,12 +2,17 @@
 pointers that ride the line, the phase-result merge, and the parent
 orchestrator's resilience policy (hard per-phase timeouts, child respawn,
 CPU fallback, cumulative emission) — driven by scripted fake children, no
-backend and no subprocess needed."""
+backend and no subprocess needed. One exception: the slow-marked
+``test_child_phases_real_jax_smoke`` at the bottom spawns the REAL
+measurement child (subprocess + jax on one CPU device) to pin the phase
+internals the fakes can't see."""
 
 import importlib.util
 import json
 import os
 import queue
+
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -507,3 +512,49 @@ def test_init_hang_is_decisive_one_probe_engages_fallback(monkeypatch, tmp_path)
     assert tail["tpu_error"].startswith("_InitTimeout")
     assert tail["device"] == "cpu" and tail["value"] == 50.0
     os.environ.pop("BENCH_PLATFORM", None)  # orchestrate mutated real env
+
+
+@pytest.mark.slow
+def test_child_phases_real_jax_smoke(tmp_path):
+    """The real measurement child (subprocess, real jax on CPU, tiny chunk):
+    the flagship publishes median + spread + per-dispatch times, the fp32arm
+    mirrors the protocol with its preset label — the phase INTERNALS the
+    scripted-children orchestrator tests can't see."""
+    import subprocess
+    import sys as _sys
+
+    env = {k: v for k, v in os.environ.items() if not k.startswith("BENCH_")}
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel here
+    # the harness exports an 8-virtual-device XLA_FLAGS (conftest); the
+    # child must compile for ONE device or two cold 8-way shard_map
+    # compiles serialize on the 1-core host and blow the timeout
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.update(
+        BENCH_PLATFORM="cpu", BENCH_CHUNK="2", BENCH_FLAGSHIP_REPS="2",
+        BENCH_FP32ARM_REPS="1",
+    )
+    proc = subprocess.run(
+        [_sys.executable, os.path.join(REPO, "bench.py"),
+         "--phases", "probe,flagship,fp32arm"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
+    )
+    # phase/init errors ride stdout as @BENCH@ JSON lines, not stderr
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    events = {}
+    for line in proc.stdout.splitlines():
+        if line.startswith("@BENCH@ "):
+            ev = json.loads(line[len("@BENCH@ "):])
+            events[ev["phase"]] = ev
+    flag = events["flagship"]
+    assert flag["ok"], flag
+    d = flag["data"]
+    assert d["flagship_reps"] == 2
+    assert len(d["dispatch_times_ms"]) == 2
+    assert (
+        d["flagship_imgs_per_sec_min"]
+        <= d["flagship_imgs_per_sec"]
+        <= d["flagship_imgs_per_sec_max"]
+    )
+    arm = events["fp32arm"]["data"]
+    assert arm["preset"] == d["preset"] == "small"
+    assert arm["fp32_scanned_imgs_per_sec"] > 0
