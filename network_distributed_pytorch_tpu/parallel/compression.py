@@ -35,7 +35,7 @@ compressors apply uniformly, so the whole gradient rides one flat buffer.
 
 from __future__ import annotations
 
-from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+from typing import Any, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp

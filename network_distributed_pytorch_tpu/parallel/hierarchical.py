@@ -44,7 +44,7 @@ sharding specs work unchanged over both axes.)
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple, Union
+from typing import Any, Tuple, Union
 
 import jax
 

@@ -19,8 +19,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .comm import n_bits
-
 
 class TensorPacker:
     """Pack/unpack a fixed list of array shapes into one flat vector.

@@ -44,6 +44,10 @@ def test_cli_drives_experiment_end_to_end():
     import sys
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel
+    # launch.py defaults rank/world-size from these (the mpirun path the
+    # tests above pin); inherited values would make the child rendezvous
+    env.pop("OMPI_COMM_WORLD_RANK", None)
+    env.pop("OMPI_COMM_WORLD_SIZE", None)
     env["JAX_PLATFORMS"] = "cpu"
     # INHERIT the harness XLA_FLAGS (conftest's hostenv already put the
     # 8-device count AND the raised collective-rendezvous deadlines in
