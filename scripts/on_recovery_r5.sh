@@ -22,6 +22,17 @@ sh scripts/tunnel_probe.sh "${1:-180}" "${2:-220}" >> "$LOG" 2>&1 || {
 date -u > /tmp/TUNNEL_RECOVERED
 echo "== tunnel recovered $(date -u) — starting chip evidence ==" >> "$LOG"
 
+# no-heavy-compile freeze (round-4 postmortem: chip work late in the round
+# caused the wedge that ate the driver's window). If recovery lands after
+# the cutoff, touch NOTHING — a healthy untouched tunnel lets the driver's
+# own bench capture the platform=tpu row directly, which is categorically
+# stronger evidence than anything we could bank in the remaining minutes.
+if [ -n "${R5_FREEZE_UNIX:-}" ] && [ "$(date +%s)" -gt "$R5_FREEZE_UNIX" ]; then
+    echo "== recovery after freeze cutoff — leaving the chip untouched for the driver's window $(date -u) ==" >> "$LOG"
+    date -u > /tmp/R5_CHIP_DONE
+    exit 0
+fi
+
 # clear the 1-core host for honest fetch-to-observe timing (studies persist
 # per-seed and are re-runnable; chip access is the scarce resource)
 pkill -f accuracy_study.py 2>/dev/null
