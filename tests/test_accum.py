@@ -3,7 +3,6 @@ match one big-batch step exactly (mean loss, equal microbatch sizes), keep
 BN-style model_state threading, and leave the wire cost at ONE reduction per
 step."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

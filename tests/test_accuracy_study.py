@@ -5,8 +5,6 @@ the plateaued flag reflects the break, not the curve length."""
 
 import importlib.util
 import os
-import sys
-import types
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

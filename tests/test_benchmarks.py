@@ -7,7 +7,6 @@ therefore reports MFU from the analytic PaLM-appendix accounting
 (``gpt_analytic_train_flops``) and carries the raw HLO count alongside.
 """
 
-import jax
 import pytest
 
 from network_distributed_pytorch_tpu.utils.benchmarks import (

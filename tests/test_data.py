@@ -2,7 +2,6 @@
 parsing (reference ``read_imdb_split`` semantics), tokenizer determinism,
 batch iteration static shapes."""
 
-import os
 import pickle
 
 import numpy as np
@@ -16,7 +15,6 @@ from network_distributed_pytorch_tpu.data import (
     read_imdb_split,
     steps_per_epoch,
     synthetic_cifar10,
-    synthetic_imdb,
 )
 
 

@@ -8,7 +8,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from network_distributed_pytorch_tpu.parallel import (
-    ExactReducer,
     PowerSGDReducer,
     make_diloco_train_fn,
     make_local_sgd_train_fn,

@@ -8,12 +8,10 @@ correctly when available on disk."""
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 torch = pytest.importorskip("torch")
 
-from network_distributed_pytorch_tpu.models import resnet18, resnet50
 from network_distributed_pytorch_tpu.models.distilbert import (
     DistilBertConfig,
     DistilBertForSequenceClassification,

@@ -11,7 +11,6 @@ from jax.sharding import PartitionSpec as P
 from network_distributed_pytorch_tpu.parallel import make_mesh
 from network_distributed_pytorch_tpu.parallel.pipeline import (
     make_pipeline_fn,
-    pipeline_apply,
     stacked_stage_params,
 )
 

@@ -1,7 +1,6 @@
 """Tensor-parallel dense/MLP over an 8-device model mesh ≡ single-device."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 

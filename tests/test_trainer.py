@@ -13,7 +13,6 @@ from network_distributed_pytorch_tpu.parallel import (
     make_mesh,
 )
 from network_distributed_pytorch_tpu.parallel.trainer import (
-    init_train_state,
     make_train_step,
     stateless_loss,
 )
