@@ -124,8 +124,23 @@ _PEAK_BF16_FLOPS = {
 }
 
 
+# Driver-facing JSON lines flow through the observe sinks (the same event
+# model the experiments log through). observe is jax-free by design, so the
+# parent orchestrator still imports no jax. RawEvent keeps each payload
+# verbatim — no "event" wrapper, no timestamp — so the driver's tail parser
+# sees byte-identical lines.
+from network_distributed_pytorch_tpu.observe import (  # noqa: E402
+    RawEvent,
+    StreamJsonSink,
+    Telemetry,
+)
+
+_PARENT_TELEMETRY = Telemetry([StreamJsonSink(sys.stdout)])
+_CHILD_TELEMETRY = Telemetry([StreamJsonSink(sys.stdout, prefix=MARKER)])
+
+
 def _emit(payload: dict) -> None:
-    print(json.dumps(payload), flush=True)
+    _PARENT_TELEMETRY.emit(RawEvent(payload))
 
 
 # ---------------------------------------------------------------------------
@@ -134,7 +149,7 @@ def _emit(payload: dict) -> None:
 
 
 def _child_emit(phase: str, ok: bool, data: dict) -> None:
-    print(MARKER + json.dumps({"phase": phase, "ok": ok, "data": data}), flush=True)
+    _CHILD_TELEMETRY.emit(RawEvent({"phase": phase, "ok": ok, "data": data}))
 
 
 class _InitTimeout(BaseException):

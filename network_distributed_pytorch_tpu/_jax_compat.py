@@ -1,0 +1,62 @@
+"""Shims for jax APIs this package uses that older installed jax versions
+lack. Imported for its side effects by the subpackage ``__init__``s, so any
+entry point (tests, experiments, launch, bench children) gets them before
+the first step function is built.
+
+On jax >= 0.7 every ``hasattr`` below is true and this module is a no-op.
+On the 0.4.x line:
+
+- ``jax.shard_map`` lives at ``jax.experimental.shard_map.shard_map``. The
+  old implementation's replication checker (``check_rep=True``) inserts an
+  automatic psum when differentiating replicated params — which would hand
+  the reducers pre-synchronized gradients and defeat the hand-rolled
+  compress-then-communicate sync that is the reference's core design. The
+  new API solves this with varying-types + explicit ``pcast``; the old
+  API's equivalent is ``check_rep=False``, so the shim pins that.
+- ``jax.lax.pcast(x, axis, to="varying")`` only exists in the varying-types
+  world. With ``check_rep=False`` there is no replication tracking, so the
+  cast is correctly a no-op.
+- ``jax.lax.axis_size(axis)`` is newer; the 0.4.x equivalent is
+  ``psum(1, axis)``, which jax folds statically for non-tracer operands, so
+  it stays a Python int (no collective compiled).
+- ``jax.typeof(x)`` is the public spelling of ``jax.core.get_aval`` (used
+  here only to read a ``vma`` attribute that pre-varying-types avals don't
+  carry — callers already default it to the empty set).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.lax
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None, **kwargs):
+        kwargs.pop("check_vma", None)
+        kwargs.setdefault("check_rep", False)
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+
+    jax.shard_map = shard_map
+
+if not hasattr(jax.lax, "pcast"):
+
+    def pcast(x, axis_name, *, to):
+        del axis_name, to
+        return x
+
+    jax.lax.pcast = pcast
+
+if not hasattr(jax.lax, "axis_size"):
+
+    def axis_size(axis_name):
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = axis_size
+
+if not hasattr(jax, "typeof"):
+    import jax.core
+
+    jax.typeof = jax.core.get_aval

@@ -272,8 +272,16 @@ def run(
             **extra,
         }
 
-    print(f"\nBandwidth study — {n_workers} workers, global batch {global_batch}")
-    print(format_table(tables))
+    from ..observe import NoteEvent, telemetry_from_config
+
+    telemetry = telemetry_from_config(config)
+    telemetry.emit(
+        NoteEvent(
+            f"\nBandwidth study — {n_workers} workers, global batch {global_batch}"
+        )
+    )
+    telemetry.emit(NoteEvent(format_table(tables)))
+    telemetry.close()
     exact_bits = results["exact"]["bits_per_step"]
     for name, r in results.items():
         if name != "exact":

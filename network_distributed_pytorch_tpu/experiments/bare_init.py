@@ -13,6 +13,7 @@ from typing import Dict, Optional
 import jax
 import numpy as np
 
+from ..observe import NoteEvent, telemetry_from_config
 from ..parallel.mesh import (
     DistributedConfig,
     initialize_distributed,
@@ -26,24 +27,29 @@ def run(config: Optional[ExperimentConfig] = None) -> Dict:
     config = config or ExperimentConfig(training_epochs=0)
     np.random.seed(config.seed + config.process_id)  # ddp_guide/ddp_init.py:20-21
 
-    print("==============================")
-    print(">>>>> Distributed Initialization (TPU/XLA) <<<<<")
-    print(
-        f"Init: process {config.process_id}/{config.num_processes - 1} "
-        f"(total {config.num_processes}) - coordinator ({config.coordinator_address})"
-    )
-    initialize_distributed(
-        DistributedConfig(
-            seed=config.seed,
-            process_id=config.process_id,
-            num_processes=config.num_processes,
-            coordinator_address=config.coordinator_address,
-            timeout_seconds=config.timeout_seconds,
+    telemetry = telemetry_from_config(config)
+    note = lambda msg: telemetry.emit(NoteEvent(msg))
+    try:
+        note("==============================")
+        note(">>>>> Distributed Initialization (TPU/XLA) <<<<<")
+        note(
+            f"Init: process {config.process_id}/{config.num_processes - 1} "
+            f"(total {config.num_processes}) - coordinator ({config.coordinator_address})"
         )
-    )
-    mesh = make_mesh()
-    n = mesh.size
-    print(f"All processes initialized; mesh axes {mesh.axis_names}, {n} devices")
-    print("==============================\n")
-    shutdown_distributed()
+        initialize_distributed(
+            DistributedConfig(
+                seed=config.seed,
+                process_id=config.process_id,
+                num_processes=config.num_processes,
+                coordinator_address=config.coordinator_address,
+                timeout_seconds=config.timeout_seconds,
+            )
+        )
+        mesh = make_mesh()
+        n = mesh.size
+        note(f"All processes initialized; mesh axes {mesh.axis_names}, {n} devices")
+        note("==============================\n")
+        shutdown_distributed()
+    finally:
+        telemetry.close()
     return {"experiment": "bare_init", "num_devices": n, "process_id": config.process_id}

@@ -101,6 +101,10 @@ def train_loop(
     on_epoch_end: Optional[Callable[[int, TrainState], None]] = None,
     prefetch: int = 2,
     batch_sharding: Any = None,
+    telemetry: Any = None,
+    trace_dir: Optional[str] = None,
+    audit: bool = False,
+    run_name: str = "train",
 ) -> Tuple[TrainState, MetricsLogger]:
     """Run ``epochs`` passes, logging loss / step-time / cumulative bits
     (the reference's per-epoch banner + the bits it never reported).
@@ -109,6 +113,15 @@ def train_loop(
     (``data.device_prefetch``, placed with the step's batch sharding) so the
     host→device copy of batch N+1 overlaps the compute of batch N; 0
     disables.
+
+    Observability (all default-off): events flow through ``telemetry`` (an
+    ``observe.Telemetry``; None = the stdout-banner default); ``trace_dir``
+    wraps the whole loop in a ``jax.profiler`` trace with a
+    ``StepTraceAnnotation`` around every step (so Perfetto/XProf group ops
+    per step); ``audit=True`` reconciles the step's wire ledger against the
+    compiled HLO BEFORE the first execution (buffer donation invalidates
+    the example args afterwards) and emits the per-collective ledger + the
+    ``CompileEvent`` verdict.
 
     Optional hooks (all default-off; :func:`resilient_train_loop` wires
     them): a ``utils.failure.StepWatchdog`` around every step, a
@@ -119,7 +132,9 @@ def train_loop(
     import contextlib
 
     from ..data import device_prefetch
+    from ..observe import FailureEvent
     from ..parallel.mesh import DATA_AXIS, data_sharding
+    from ..utils.profiling import step_annotation, trace
 
     # prefetch needs the step's batch sharding; on a mesh without the
     # standard 'data' axis (e.g. the hierarchical ('dcn','ici') layout) the
@@ -135,27 +150,51 @@ def train_loop(
         else:
             prefetch = 0
 
-    logger = MetricsLogger(bits_per_step=step.bits_per_step, log_every=log_every)
-    for epoch in range(start_epoch, epochs):
-        batches = batches_for_epoch(epoch)
-        if prefetch:
-            batches = device_prefetch(batches, sharding, depth=prefetch)
-        for batch in batches:
-            logger.start_step()
-            ctx = (
-                watchdog.watch(f"epoch {epoch}")
-                if watchdog is not None
-                else contextlib.nullcontext()
-            )
-            with ctx:
-                state, loss = step(state, batch)
-                loss = jax.device_get(loss)
-            logger.end_step(epoch, loss)
-            if heartbeat is not None:
-                heartbeat.beat(epoch=epoch)
-        logger.end_epoch(epoch, rank=rank)
-        if on_epoch_end is not None:
-            on_epoch_end(epoch, state)
+    logger = MetricsLogger(
+        bits_per_step=step.bits_per_step, log_every=log_every, telemetry=telemetry
+    )
+    audit_pending = audit
+    trace_ctx = trace(trace_dir) if trace_dir else contextlib.nullcontext()
+    with trace_ctx:
+        for epoch in range(start_epoch, epochs):
+            batches = batches_for_epoch(epoch)
+            if prefetch:
+                batches = device_prefetch(batches, sharding, depth=prefetch)
+            for batch in batches:
+                if audit_pending:
+                    # must precede the first execution: donate_argnums
+                    # invalidates the state buffers the lowering would need
+                    audit_pending = False
+                    try:
+                        from ..observe.ledger import audit_compiled_step
+
+                        audit_compiled_step(
+                            step, state, batch, label=run_name, telemetry=telemetry
+                        )
+                    except Exception as e:  # audit is advisory, never fatal
+                        if telemetry is not None:
+                            telemetry.emit(
+                                FailureEvent(
+                                    kind="audit_error",
+                                    label=run_name,
+                                    message=f"{type(e).__name__}: {e}",
+                                )
+                            )
+                logger.start_step()
+                ctx = (
+                    watchdog.watch(f"epoch {epoch}")
+                    if watchdog is not None
+                    else contextlib.nullcontext()
+                )
+                with ctx, step_annotation(run_name, logger._step):
+                    state, loss = step(state, batch)
+                    loss = jax.device_get(loss)
+                logger.end_step(epoch, loss)
+                if heartbeat is not None:
+                    heartbeat.beat(epoch=epoch)
+            logger.end_epoch(epoch, rank=rank)
+            if on_epoch_end is not None:
+                on_epoch_end(epoch, state)
     return state, logger
 
 
@@ -168,19 +207,28 @@ def audited_carry_loop(
     rank: int = 0,
     log_every: int = 0,
     checkpoint_dir: Optional[str] = None,
+    telemetry: Any = None,
+    run_name: str = "carry_loop",
+    ledger_layer: str = "pipeline",
 ) -> Tuple[Any, MetricsLogger, Dict]:
     """Shared driver for hand-rolled ``(carry, *batch) -> (carry, loss)``
     steps (the pipeline/sequence-parallel experiments, whose wire traffic is
     activation collectives rather than reducer payloads): AOT-compile ONCE,
     audit that same executable's HLO for honest bits-per-step, then run the
-    epoch loop on it. With ``checkpoint_dir``, the carry is saved at every
-    epoch boundary and the newest checkpoint is resumed on entry
-    (deterministic per-epoch batch streams ⇒ a crash-restart converges to
-    the same state as an uninterrupted run, like ``resilient_train_loop``).
+    epoch loop on it. The audit doubles as the wire ledger here — one
+    ``CollectiveEvent`` per collective kind (attributed to ``ledger_layer``)
+    plus the ``CompileEvent`` verdict flow through ``telemetry``. With
+    ``checkpoint_dir``, the carry is saved at every epoch boundary and the
+    newest checkpoint is resumed on entry (deterministic per-epoch batch
+    streams ⇒ a crash-restart converges to the same state as an
+    uninterrupted run, like ``resilient_train_loop``).
     Returns ``(carry, logger, audit_summary)``."""
     import jax as _jax
 
+    from ..observe import CompileEvent
+    from ..observe.ledger import ledger_from_hlo_summary
     from ..utils.hlo_audit import collective_summary, hlo_text_of_compiled
+    from ..utils.overlap import overlap_report
 
     start_epoch = 0
     if checkpoint_dir is not None:
@@ -192,9 +240,41 @@ def audited_carry_loop(
             start_epoch = int(latest.rsplit("step_", 1)[1]) + 1
 
     compiled = jitted.lower(carry, *example_batch).compile()
-    audit = collective_summary(hlo_text_of_compiled(compiled))
+    hlo_text = hlo_text_of_compiled(compiled)
+    audit = collective_summary(hlo_text)
+    if telemetry is not None:
+        ledger = ledger_from_hlo_summary(audit, layer=ledger_layer)
+        for ce in ledger.collective_events(run_name):
+            telemetry.emit(ce)
+        rec = ledger.reconcile(hlo_text)  # exact by construction
+        ov = overlap_report(hlo_text)
+        telemetry.emit(
+            CompileEvent(
+                label=run_name,
+                analytic_bytes=rec["analytic_bytes"],
+                hlo_bytes=rec["hlo_bytes"],
+                delta_bytes=rec["delta_bytes"],
+                exact=rec["exact"],
+                hlo_collective_count=rec["hlo_collective_count"],
+                hlo_by_kind=rec["hlo_by_kind"],
+                overlap={
+                    k: ov[k]
+                    for k in (
+                        "scheduled",
+                        "n_async_collectives",
+                        "n_overlapped",
+                        "n_async_copy_windows",
+                        "n_copy_windows_with_compute",
+                        "collective_emitters",
+                    )
+                    if k in ov
+                },
+            )
+        )
     logger = MetricsLogger(
-        bits_per_step=8 * audit["total_payload_bytes"], log_every=log_every
+        bits_per_step=8 * audit["total_payload_bytes"],
+        log_every=log_every,
+        telemetry=telemetry,
     )
     for epoch in range(start_epoch, epochs):
         for batch in batches_for_epoch(epoch):
@@ -320,6 +400,10 @@ def resilient_train_loop(
     log_every: int = 0,
     watchdog_timeout_s: Optional[float] = None,
     heartbeat: Any = None,
+    telemetry: Any = None,
+    trace_dir: Optional[str] = None,
+    audit: bool = False,
+    run_name: str = "train",
 ) -> Tuple[TrainState, "MetricsLogger", int]:
     """:func:`train_loop` plus the survival kit the reference lacks entirely
     (SURVEY §5: no checkpointing, no retry; a failed init doesn't even exit):
@@ -362,5 +446,6 @@ def resilient_train_loop(
         on_epoch_end=lambda epoch, st: save_checkpoint(
             checkpoint_dir, st, step=epoch
         ),
+        telemetry=telemetry, trace_dir=trace_dir, audit=audit, run_name=run_name,
     )
     return state, logger, start_epoch

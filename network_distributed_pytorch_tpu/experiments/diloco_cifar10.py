@@ -109,7 +109,10 @@ def run(
     else:
         phase_bits = [diloco.bits_per_round]
         round_bits = diloco.bits_per_round
-    logger = MetricsLogger(log_every=config.log_every)
+    from ..observe import NoteEvent, telemetry_from_config
+
+    telemetry = telemetry_from_config(config)
+    logger = MetricsLogger(log_every=config.log_every, telemetry=telemetry)
     import numpy as np
 
     # inner-step cap honored exactly: only whole rounds run, so the cap
@@ -152,10 +155,11 @@ def run(
         if pending and config.log_every:
             # same convention as the static-shape loader's ragged-batch drop,
             # but said out loud: a partial round cannot sync
-            print(
-                f"note: dropping {len(pending)} trailing batches"
-                f" (< sync_every={sync_every}) at epoch {epoch} end",
-                flush=True,
+            telemetry.emit(
+                NoteEvent(
+                    f"note: dropping {len(pending)} trailing batches"
+                    f" (< sync_every={sync_every}) at epoch {epoch} end"
+                )
             )
         logger.end_epoch(epoch, rank=config.process_id)
 
@@ -177,4 +181,5 @@ def run(
             model, params,
             diloco.eval_model_state(state)["batch_stats"], test_x, test_y,
         )
+    telemetry.close()
     return summarize("diloco_cifar10", logger, extra)

@@ -86,11 +86,21 @@ def run(
     batches = accumulated_batches(
         [images, labels], config, max_steps_per_epoch=max_steps_per_epoch
     )
-    state, logger = train_loop(
-        step, state, batches, config.training_epochs,
-        rank=config.process_id, log_every=config.log_every,
-        batch_sharding=accum_batch_sharding(mesh, config.accum_steps),
-    )
+    from ..observe import audit_from_config, telemetry_from_config
+
+    telemetry = telemetry_from_config(config)
+    try:
+        state, logger = train_loop(
+            step, state, batches, config.training_epochs,
+            rank=config.process_id, log_every=config.log_every,
+            batch_sharding=accum_batch_sharding(mesh, config.accum_steps),
+            telemetry=telemetry,
+            trace_dir=config.trace_dir,
+            audit=audit_from_config(config),
+            run_name="powersgd_cifar10",
+        )
+    finally:
+        telemetry.close()
     extra = {
         "preset": preset,
         "real_data": is_real,

@@ -21,6 +21,36 @@ _TIMEOUT_FLAGS = (
 )
 
 
+def _xla_flag_supported(flag_name: str) -> bool:
+    """Whether this jaxlib registers ``flag_name`` — unknown names in
+    ``XLA_FLAGS`` are FATAL (``parse_flags_from_env.cc`` aborts the process
+    at first backend init), so optional flags must be probed, not guessed.
+
+    There is no query API, but every registered flag's name string is
+    embedded in the jaxlib binary; a substring scan of ``xla_extension`` is
+    cheap (one mmap'd pass) and errs on the safe side: a flag the scan
+    can't find is never appended.
+    """
+    try:
+        import importlib.util
+        import mmap
+
+        spec = importlib.util.find_spec("jaxlib")
+        if spec is None or not spec.submodule_search_locations:
+            return False
+        root = spec.submodule_search_locations[0]
+        for fname in os.listdir(root):
+            if not fname.startswith("xla_extension"):
+                continue
+            with open(os.path.join(root, fname), "rb") as f:
+                with mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ) as mm:
+                    if mm.find(flag_name.encode()) != -1:
+                        return True
+        return False
+    except (OSError, ValueError, ImportError):
+        return False
+
+
 def force_cpu_devices(
     n: Optional[int] = 8,
     replace: bool = True,
@@ -54,7 +84,9 @@ def force_cpu_devices(
     elif replace or not had_count:
         flags = re.sub(_COUNT_FLAG, "", flags).strip()
         flags += f" --xla_force_host_platform_device_count={n}"
-    if collective_timeout_s is not None:
+    if collective_timeout_s is not None and _xla_flag_supported(
+        "xla_cpu_collective_call_warn_stuck_timeout_seconds"
+    ):
         flags = re.sub(_TIMEOUT_FLAGS, "", flags).strip()  # no duplicates
         flags += (
             f" --xla_cpu_collective_call_warn_stuck_timeout_seconds={collective_timeout_s}"

@@ -16,7 +16,7 @@ Usage::
 from __future__ import annotations
 
 import argparse
-import json
+import sys
 
 from .experiments import (
     bandwidth_study,
@@ -33,6 +33,7 @@ from .experiments import (
     powersgd_cifar10,
     powersgd_imdb,
 )
+from .observe import RawEvent, StreamJsonSink, Telemetry
 from .parallel.mesh import DistributedConfig, initialize_distributed
 from .utils.config import ExperimentConfig
 
@@ -165,6 +166,20 @@ def build_parser() -> argparse.ArgumentParser:
         help="gpt_generate only: 0 = greedy",
     )
     p.add_argument("--json", action="store_true", help="print the summary as JSON")
+    p.add_argument(
+        "--event-log", type=str, default=None,
+        help="append structured JSONL telemetry (steps, wire ledger, compile"
+             " audits) to this path; read it back with scripts/report.py",
+    )
+    p.add_argument(
+        "--trace-dir", type=str, default=None,
+        help="capture a jax.profiler trace of the run under this directory",
+    )
+    p.add_argument(
+        "--audit-wire", action="store_true", default=None,
+        help="force the compile-time analytic-vs-HLO wire audit (default:"
+             " on whenever --event-log is set)",
+    )
     return p
 
 
@@ -191,6 +206,9 @@ def config_from_args(args) -> ExperimentConfig:
         cfg.accum_steps = args.accum_steps
     if args.max_grad_norm is not None:
         cfg.max_grad_norm = args.max_grad_norm
+    cfg.event_log = args.event_log
+    cfg.trace_dir = args.trace_dir
+    cfg.audit_wire = args.audit_wire
     return cfg
 
 
@@ -278,7 +296,9 @@ def main(argv=None) -> dict:
 
     result = fn(**kwargs)
     if args.json:
-        print(json.dumps(result, default=str))
+        # driver-facing contract: RawEvent keeps the payload verbatim, so the
+        # line is byte-identical to the historical print(json.dumps(...))
+        Telemetry([StreamJsonSink(sys.stdout)]).emit(RawEvent(result))
     return result
 
 

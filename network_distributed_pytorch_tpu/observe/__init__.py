@@ -1,0 +1,56 @@
+"""observe — the unified telemetry subsystem.
+
+The reference's defining feature is bytes-on-wire accounting at every
+collective, yet it never ships the reporting loop (SURVEY C9:
+``bits_communicated`` accumulated but never printed). This package closes
+that loop as a first-class subsystem instead of scattered fragments:
+
+- :mod:`observe.events`    — ONE typed event model (``StepEvent``,
+  ``CollectiveEvent``, ``CompileEvent``, ``EpochEvent``, ``FailureEvent``)
+  shared by the trainer, the reducers, the experiment drivers, the failure
+  machinery, and ``bench.py``.
+- :mod:`observe.sinks`     — pluggable outputs: the stdout banner sink (the
+  only sanctioned ``print`` site in the package, lint-enforced), a JSONL
+  file sink for run logs, a raw-JSON stream sink for driver-facing
+  contracts (bench/launch), and an in-memory sink for tests.
+- :mod:`observe.telemetry` — the process-local registry events flow
+  through; experiments build theirs from ``ExperimentConfig.event_log``.
+- :mod:`observe.ledger`    — the per-collective **wire ledger**: every
+  collective a compiled step issues, tagged with (layer, op, axis, dtype,
+  payload bytes), reconciled byte-exactly against the compiled HLO via
+  ``utils.hlo_audit`` at trainer-compile time.
+
+``scripts/report.py`` turns a JSONL run log back into a human report
+(step-time percentiles, bytes/step by tag, compression ratio,
+analytic-vs-HLO delta, overlap stats).
+
+Everything imported here is jax-free, so the bench parent orchestrator
+(which deliberately imports no jax) can use the same sinks.
+"""
+
+from .events import (  # noqa: F401
+    SCHEMA_VERSION,
+    CollectiveEvent,
+    CompileEvent,
+    EpochEvent,
+    Event,
+    FailureEvent,
+    NoteEvent,
+    RawEvent,
+    StepEvent,
+)
+from .ledger import LedgerEntry, WireLedger  # noqa: F401
+from .sinks import (  # noqa: F401
+    JsonlSink,
+    MemorySink,
+    Sink,
+    StdoutSink,
+    StreamJsonSink,
+)
+from .telemetry import (  # noqa: F401
+    Telemetry,
+    audit_from_config,
+    default_telemetry,
+    telemetry_for_run,
+    telemetry_from_config,
+)

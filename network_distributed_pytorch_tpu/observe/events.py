@@ -1,0 +1,200 @@
+"""Typed telemetry events — the shared vocabulary of the observe subsystem.
+
+Every observability fragment (per-step metrics, the wire ledger, compile
+audits, epoch banners, failure reports, bench phases) emits one of these
+through a :class:`observe.telemetry.Telemetry`. An event knows two
+renderings of itself:
+
+- ``record()`` — the structured JSONL form (``{"event": <kind>, ...}``),
+  what :class:`observe.sinks.JsonlSink` persists and ``scripts/report.py``
+  reads back;
+- ``banner()`` — the optional human one-liner for
+  :class:`observe.sinks.StdoutSink` (None = silent on stdout). The step and
+  epoch banners reproduce the reference's print format byte-for-byte
+  (``ddp_powersgd_guide_cifar10/ddp_init.py:183``).
+
+This module must stay jax-free: the bench parent orchestrator imports it
+before (and without) any jax backend init.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import ClassVar, Dict, Optional, Tuple
+
+SCHEMA_VERSION = 1
+
+
+@dataclass
+class Event:
+    """Base event: ``record()`` for structured sinks, ``banner()`` for the
+    stdout sink. ``_not_recorded`` lists presentation-only fields kept out
+    of the JSONL record; ``STAMP_TS`` lets the telemetry add an emit-time
+    timestamp (off for :class:`RawEvent`, whose payload is a verbatim
+    driver-facing contract)."""
+
+    KIND: ClassVar[str] = "event"
+    STAMP_TS: ClassVar[bool] = True
+    _not_recorded: ClassVar[Tuple[str, ...]] = ()
+
+    def record(self) -> Dict:
+        out: Dict = {"event": self.KIND}
+        for f in dataclasses.fields(self):
+            if f.name in self._not_recorded:
+                continue
+            out[f.name] = getattr(self, f.name)
+        return out
+
+    def banner(self) -> Optional[str]:
+        return None
+
+
+@dataclass
+class StepEvent(Event):
+    """One training step: loss, wall-clock, cumulative wire bits.
+
+    ``valid=False`` marks a record whose timing origin is missing
+    (``end_step`` without ``start_step``) — persisted rather than silently
+    recorded as ~0 s. ``verbose`` is presentation-only: the metrics logger
+    sets it on every ``log_every``-th step to request a stdout banner."""
+
+    KIND: ClassVar[str] = "step"
+    _not_recorded: ClassVar[Tuple[str, ...]] = ("verbose",)
+
+    step: int
+    epoch: int
+    loss: float
+    step_time_s: float
+    bits_cumulative: int
+    valid: bool = True
+    verbose: bool = False
+
+    def banner(self) -> Optional[str]:
+        if not self.verbose:
+            return None
+        timing = f"{self.step_time_s * 1e3:.1f} ms" if self.valid else "untimed"
+        return (
+            f"step {self.step}: loss {self.loss:.4f}, {timing}, "
+            f"{self.bits_cumulative / 8e6:.2f} MB on wire"
+        )
+
+
+@dataclass
+class EpochEvent(Event):
+    """Per-epoch mean loss in the reference's banner style
+    (``ddp_powersgd_guide_cifar10/ddp_init.py:183``)."""
+
+    KIND: ClassVar[str] = "epoch"
+
+    epoch: int
+    rank: int
+    mean_loss: float
+    bits_cumulative: int
+
+    def banner(self) -> str:
+        return (
+            f">>>>> Rank {self.rank}, epoch {self.epoch}: "
+            f"mean loss {self.mean_loss:.4f}, "
+            f"{self.bits_cumulative / 8e6:.2f} MB communicated"
+        )
+
+
+@dataclass
+class CollectiveEvent(Event):
+    """One wire-ledger line: a collective (or a batch of ``count`` identical
+    ones) a compiled step issues, attributed to its originating layer
+    (reducer / trainer loss-sync / fsdp / pipeline). ``payload_bytes`` is
+    the TOTAL across all ``count`` collectives of the entry."""
+
+    KIND: ClassVar[str] = "collective"
+
+    label: str  # which compiled step (e.g. "exact_cifar10")
+    tag: str  # e.g. "grads", "powersgd.P", "loss-sync", "fsdp.param-gather"
+    layer: str  # reducer | trainer | fsdp | pipeline
+    op: str  # all-reduce | all-gather | reduce-scatter | ...
+    axis: str  # mesh axis the collective rides ("data", "pipe", ...)
+    dtype: str
+    payload_bytes: int
+    count: int = 1
+
+
+@dataclass
+class CompileEvent(Event):
+    """Trainer-compile-time reconciliation of the analytic wire ledger
+    against the post-optimization HLO (``utils.hlo_audit``): the honesty
+    check SURVEY §7 asks for, emitted where it happens instead of living
+    only in tests. The delta is REPORTED, never hidden — byte-exact for the
+    exact-DDP step, and an explicit signed number wherever XLA's combiner
+    or a compressed payload makes the two models differ."""
+
+    KIND: ClassVar[str] = "compile"
+
+    label: str
+    analytic_bytes: int  # the wire ledger's total (reference n_bits model)
+    hlo_bytes: int  # what the compiled executable actually moves
+    delta_bytes: int  # hlo - analytic, signed
+    exact: bool
+    hlo_collective_count: int
+    hlo_by_kind: Dict[str, int] = field(default_factory=dict)
+    dense_grad_bytes: Optional[int] = None  # uncompressed gradient size
+    compression_ratio: Optional[float] = None  # dense / reducer payload
+    overlap: Dict = field(default_factory=dict)  # utils.overlap extract
+
+    def banner(self) -> str:
+        tail = "byte-exact" if self.exact else f"delta {self.delta_bytes:+d} B"
+        ratio = (
+            f", {self.compression_ratio:.1f}x compression"
+            if self.compression_ratio is not None
+            else ""
+        )
+        return (
+            f"[observe] {self.label}: analytic {self.analytic_bytes} B/step "
+            f"vs compiled HLO {self.hlo_bytes} B/step ({tail}){ratio}"
+        )
+
+
+@dataclass
+class FailureEvent(Event):
+    """A detected failure (watchdog timeout, audit error, stale peer).
+    The banner is the record itself as JSON — impossible to miss AND
+    machine-parseable, like the watchdog's original structured report."""
+
+    KIND: ClassVar[str] = "failure"
+
+    kind: str
+    label: str = ""
+    message: str = ""
+
+    def banner(self) -> str:
+        return json.dumps(self.record(), default=str)
+
+
+@dataclass
+class NoteEvent(Event):
+    """A free-form human banner (init lifecycle, dropped-batch notes,
+    study tables) that should also land in the structured log."""
+
+    KIND: ClassVar[str] = "note"
+
+    message: str
+
+    def banner(self) -> str:
+        return self.message
+
+
+@dataclass
+class RawEvent(Event):
+    """A verbatim payload for driver-facing JSON contracts (bench phase
+    lines, the launcher's ``--json`` summary): ``record()`` IS the payload,
+    with no ``event`` wrapper and no timestamp stamping, so existing
+    parsers see identical bytes."""
+
+    KIND: ClassVar[str] = "raw"
+    STAMP_TS: ClassVar[bool] = False
+
+    payload: Dict
+
+    def record(self) -> Dict:
+        return dict(self.payload)

@@ -1,0 +1,90 @@
+"""Pluggable telemetry sinks.
+
+A sink receives every event emitted through a
+:class:`observe.telemetry.Telemetry` as ``(event, record)`` — the typed
+event for presentation decisions (``banner()``) and the already-built
+JSONL record so each sink doesn't re-serialize.
+
+``StdoutSink`` is the ONLY place in the package allowed to call bare
+``print()`` (``scripts/lint_no_print.py`` enforces this): every banner the
+framework shows a human goes through it, so a run's console output and its
+structured log can never drift apart.
+
+jax-free by design (the bench parent orchestrator imports no jax).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, TextIO
+
+from .events import Event
+
+
+class Sink:
+    def emit(self, event: Event, record: Dict) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class StdoutSink(Sink):
+    """Human banners: prints ``event.banner()`` when the event has one.
+    The package's single sanctioned ``print`` site."""
+
+    def __init__(self, stream: Optional[TextIO] = None):
+        self.stream = stream  # None = sys.stdout at call time (capsys-safe)
+
+    def emit(self, event: Event, record: Dict) -> None:
+        text = event.banner()
+        if text is not None:
+            print(text, file=self.stream, flush=True)
+
+
+class StreamJsonSink(Sink):
+    """One JSON object per line onto an open stream, optionally prefixed
+    (bench's ``@BENCH@`` child-marker protocol). Flushes per line so the
+    driver's tail is always complete."""
+
+    def __init__(self, stream: TextIO, prefix: str = ""):
+        self.stream = stream
+        self.prefix = prefix
+
+    def emit(self, event: Event, record: Dict) -> None:
+        self.stream.write(self.prefix + json.dumps(record, default=str) + "\n")
+        self.stream.flush()
+
+
+class JsonlSink(StreamJsonSink):
+    """Append-mode JSONL run log. Creates the parent directory; append is
+    the default so multi-epoch / resumed runs extend one log instead of
+    clobbering it."""
+
+    def __init__(self, path: str, append: bool = True):
+        self.path = path
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        super().__init__(open(path, "a" if append else "w"))
+
+    def close(self) -> None:
+        if not self.stream.closed:
+            self.stream.close()
+
+
+class MemorySink(Sink):
+    """In-memory capture for tests: both the typed events and their
+    records, with a kind filter."""
+
+    def __init__(self):
+        self.events: List[Event] = []
+        self.records: List[Dict] = []
+
+    def emit(self, event: Event, record: Dict) -> None:
+        self.events.append(event)
+        self.records.append(record)
+
+    def of_kind(self, kind: str) -> List[Dict]:
+        return [r for r in self.records if r.get("event") == kind]

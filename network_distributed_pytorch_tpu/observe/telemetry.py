@@ -1,0 +1,92 @@
+"""The process-local telemetry registry.
+
+One :class:`Telemetry` per run: events flow in (``emit``), every attached
+sink sees each one. The module-level :func:`default_telemetry` is a
+stdout-banner-only singleton — the zero-configuration path that preserves
+the framework's historical console behavior (step/epoch banners) with no
+structured log. Experiments build a real registry from their config via
+:func:`telemetry_from_config` (``ExperimentConfig.event_log`` → JSONL
+sink alongside stdout).
+
+jax-free by design.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterable, Optional
+
+from .events import Event
+from .sinks import JsonlSink, Sink, StdoutSink
+
+
+class Telemetry:
+    """Sink registry. ``emit`` builds the event's record once, stamps the
+    emit time (unless the event opts out, e.g. :class:`events.RawEvent`'s
+    verbatim driver contract), and fans it out to every sink."""
+
+    def __init__(self, sinks: Iterable[Sink] = ()):
+        self.sinks = list(sinks)
+
+    def add_sink(self, sink: Sink) -> Sink:
+        self.sinks.append(sink)
+        return sink
+
+    def emit(self, event: Event) -> Event:
+        record = event.record()
+        if event.STAMP_TS:
+            record.setdefault("ts", time.time())
+        for sink in self.sinks:
+            sink.emit(event, record)
+        return event
+
+    def close(self) -> None:
+        for sink in self.sinks:
+            sink.close()
+
+    def __enter__(self) -> "Telemetry":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+_DEFAULT: Optional[Telemetry] = None
+
+
+def default_telemetry() -> Telemetry:
+    """The process-local stdout-banner registry (created on first use).
+    Never ``close()``d — it owns no files."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = Telemetry([StdoutSink()])
+    return _DEFAULT
+
+
+def telemetry_for_run(
+    event_log: Optional[str] = None,
+    stdout: bool = True,
+    append: bool = True,
+) -> Telemetry:
+    """A fresh registry for one run: stdout banners plus (when
+    ``event_log`` is set) a JSONL sink at that path."""
+    sinks: list = [StdoutSink()] if stdout else []
+    if event_log:
+        sinks.append(JsonlSink(event_log, append=append))
+    return Telemetry(sinks)
+
+
+def telemetry_from_config(config) -> Telemetry:
+    """Registry from an ``ExperimentConfig`` (``event_log`` field; absent
+    attribute = stdout only, so any config-like object works)."""
+    return telemetry_for_run(event_log=getattr(config, "event_log", None))
+
+
+def audit_from_config(config) -> bool:
+    """Whether a run under this config should pay the compile-time wire
+    audit: explicitly via ``audit_wire``, else whenever a structured event
+    log is being written (recorded runs get the reconciliation verdict)."""
+    audit_wire = getattr(config, "audit_wire", None)
+    if audit_wire is None:
+        return bool(getattr(config, "event_log", None))
+    return bool(audit_wire)

@@ -1,5 +1,6 @@
 """TPU ops: Gram-Schmidt orthogonalization (XLA fori_loop + Pallas variants)
 and Pallas flash attention."""
 
+from .. import _jax_compat  # noqa: F401  (jax API shims, must load first)
 from .orthogonalize import orthogonalize  # noqa: F401
 from .flash_attention import flash_attention  # noqa: F401

@@ -27,12 +27,25 @@ runs in interpret mode (the test path), on TPU it compiles with Mosaic.
 from __future__ import annotations
 
 import functools
+import inspect
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (TPU lowering)
+
+# pre-varying-types jax has no vma on avals (shard_map check_rep=False does
+# no replication tracking), so out_shape structs must not mention it there
+_STRUCT_HAS_VMA = (
+    "vma" in inspect.signature(jax.ShapeDtypeStruct.__init__).parameters
+)
+
+
+def _out_struct(shape, dtype, vma):
+    if _STRUCT_HAS_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 _NEG_INF = float(-1e30)  # finite stand-in: -inf breaks the m-correction math
 _LSE_EMPTY = float(1e30)  # lse for fully-masked rows: exp(s - 1e30) == 0
@@ -239,8 +252,8 @@ def flash_attention(
                 pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((b * h, t, d), q.dtype, vma=vma),
-                jax.ShapeDtypeStruct((b * h, t), jnp.float32, vma=vma),
+                _out_struct((b * h, t, d), q.dtype, vma),
+                _out_struct((b * h, t), jnp.float32, vma),
             ],
             interpret=interpret,
         )(qf, kf, vf, mask)

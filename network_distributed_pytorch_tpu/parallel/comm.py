@@ -74,7 +74,13 @@ def all_gather_replicated(x: jax.Array, axis_name: Optional[str]) -> jax.Array:
     try:
         from jax.lax import all_gather_invariant  # newer jax exports it
     except ImportError:
-        from jax._src.lax.parallel import all_gather_invariant
+        try:
+            from jax._src.lax.parallel import all_gather_invariant
+        except ImportError:
+            # pre-varying-types jax has no invariant gather; without
+            # replication tracking (check_rep=False) plain all_gather is
+            # the identical op — same wire cost, same stacked result
+            all_gather_invariant = jax.lax.all_gather
     return all_gather_invariant(x, axis_name)
 
 

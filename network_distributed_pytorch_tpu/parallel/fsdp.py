@@ -82,7 +82,12 @@ class FSDPState(NamedTuple):
 
 
 class CompiledFSDPStep(NamedTuple):
-    """A jitted FSDP step plus its static wire cost and (de)sharding helpers."""
+    """A jitted FSDP step plus its static wire cost and (de)sharding helpers.
+
+    ``ledger`` itemizes ``bits_per_step`` (one ``observe.ledger.LedgerEntry``
+    per collective family: param all-gather, gradient reduce-scatter, loss
+    pmean), with ``ledger.total_bits() == bits_per_step`` asserted at
+    construction."""
 
     fn: Callable[[FSDPState, Any], Tuple[FSDPState, jax.Array]]
     bits_per_step: int
@@ -91,6 +96,7 @@ class CompiledFSDPStep(NamedTuple):
     params_template: PyTree
     opt_specs: PyTree
     optimizer: Any = None
+    ledger: Any = None
 
     def __call__(self, state, batch):
         return self.fn(state, batch)
@@ -285,11 +291,42 @@ def make_fsdp_train_step(
     # plus the scalar loss pmean (trainer.LOSS_SYNC_BITS convention)
     from .trainer import LOSS_SYNC_BITS
 
-    bits = (
-        sum(
-            2 * 8 * world * _chunk_size(int(t.size), world) * t.dtype.itemsize
-            for t in jax.tree_util.tree_leaves(templates)
-        )
-        + LOSS_SYNC_BITS
+    leaves = jax.tree_util.tree_leaves(templates)
+    gather_bits = sum(
+        8 * world * _chunk_size(int(t.size), world) * t.dtype.itemsize
+        for t in leaves
     )
-    return CompiledFSDPStep(fn, bits, mesh, axis_name, templates, opt_specs, optimizer)
+    bits = 2 * gather_bits + LOSS_SYNC_BITS
+
+    from ..observe.ledger import LedgerEntry, WireLedger, loss_sync_entry
+
+    dtypes = {str(t.dtype) for t in leaves}
+    dtype = dtypes.pop() if len(dtypes) == 1 else "mixed"
+    ledger = WireLedger(
+        [
+            LedgerEntry(
+                tag="fsdp.param-gather",
+                layer="fsdp",
+                op="all-gather",
+                axis=axis_name,
+                dtype=dtype,
+                payload_bytes=gather_bits // 8,
+                count=len(leaves),
+            ),
+            LedgerEntry(
+                tag="fsdp.grad-scatter",
+                layer="fsdp",
+                op="reduce-scatter",
+                axis=axis_name,
+                dtype=dtype,
+                payload_bytes=gather_bits // 8,
+                count=len(leaves),
+            ),
+            loss_sync_entry(axis_name),
+        ],
+        dense_grad_bits=sum(8 * int(t.size) * t.dtype.itemsize for t in leaves),
+    )
+    assert ledger.total_bits() == bits
+    return CompiledFSDPStep(
+        fn, bits, mesh, axis_name, templates, opt_specs, optimizer, ledger
+    )

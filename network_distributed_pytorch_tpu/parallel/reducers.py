@@ -105,6 +105,30 @@ class ExactReducer:
         new_memory = jax.tree_util.tree_map(jnp.zeros_like, send)
         return state, out, new_memory, bits
 
+    def ledger_entries(self, grads_template: PyTree, axis: str = "", n_workers: int = 1):
+        """Wire-ledger itemization of one exact reduction: the whole gradient
+        as one flat-packed all-reduce (or, unpacked, one per-tensor all-reduce
+        batch). Sums to ``reduce``'s analytic ``bits``."""
+        from ..observe.ledger import LedgerEntry
+
+        leaves = jax.tree_util.tree_leaves(grads_template)
+        if not leaves:
+            return []
+        dtypes = {str(l.dtype) for l in leaves}
+        return [
+            LedgerEntry(
+                tag="grads",
+                layer="reducer",
+                op="all-reduce",
+                axis=axis,
+                dtype=dtypes.pop() if len(dtypes) == 1 else "mixed",
+                # per-leaf analytic bytes (the trainer's bits_per_step model);
+                # equals the packed flat buffer for uniform-dtype params
+                payload_bytes=sum(n_bits(l) for l in leaves) // 8,
+                count=1 if self.packed else len(leaves),
+            )
+        ]
+
 
 class _MatrixMeta(NamedTuple):
     """Static per-tensor compression layout (reference ``reducer.py:74-98``)."""
@@ -403,3 +427,33 @@ class PowerSGDReducer:
         p_packer, q_packer, rank1_packer = self._packers(leaves, metas)
         rounds = 1 + self.n_power_iterations
         return rounds * (p_packer.bits() + q_packer.bits()) + rank1_packer.bits()
+
+    def ledger_entries(self, grads_template: PyTree, axis: str = "", n_workers: int = 1):
+        """Wire-ledger itemization of one compressed reduction: the P and Q
+        factor all-reduces (one each per power-iteration round) and the
+        uncompressed rank-1 payload. Sums to :meth:`bits_per_step`."""
+        from ..observe.ledger import LedgerEntry
+
+        leaves = jax.tree_util.tree_leaves(grads_template)
+        metas = self._metas(leaves)
+        p_packer, q_packer, rank1_packer = self._packers(leaves, metas)
+        rounds = 1 + self.n_power_iterations
+        entries = []
+        for tag, packer, count in (
+            ("powersgd.P", p_packer, rounds),
+            ("powersgd.Q", q_packer, rounds),
+            ("powersgd.rank1", rank1_packer, 1),
+        ):
+            if packer.bits():
+                entries.append(
+                    LedgerEntry(
+                        tag=tag,
+                        layer="reducer",
+                        op="all-reduce",
+                        axis=axis,
+                        dtype=str(packer.dtype),
+                        payload_bytes=count * packer.bits() // 8,
+                        count=count,
+                    )
+                )
+        return entries

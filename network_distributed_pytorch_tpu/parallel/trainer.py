@@ -357,13 +357,19 @@ def make_step_fn(
 
 
 class CompiledStep(NamedTuple):
-    """A jitted distributed step plus its static per-step wire cost."""
+    """A jitted distributed step plus its static per-step wire cost.
+
+    ``ledger`` is the itemization of ``bits_per_step``: one
+    ``observe.ledger.LedgerEntry`` per collective the step issues, built at
+    construction time with the guarantee that ``ledger.total_bits() ==
+    bits_per_step`` (asserted in ``observe.ledger.step_ledger``)."""
 
     fn: Callable[[TrainState, Any], Tuple[TrainState, jax.Array]]
     bits_per_step: int
     mesh: Optional[Mesh]
     reducer: Any
     optimizer: Any = None
+    ledger: Any = None
 
     def __call__(self, state, batch):
         return self.fn(state, batch)
@@ -429,8 +435,10 @@ def make_scanned_train_fn(
 
     if mesh is None:
         fn = jax.jit(scan_steps, donate_argnums=(0,) if donate_state else ())
+        bits = _reducer_bits(reducer, params_template)
         return CompiledStep(
-            fn, _reducer_bits(reducer, params_template), None, reducer, optimizer
+            fn, bits, None, reducer, optimizer,
+            _step_ledger(reducer, params_template, None, axis_name, bits),
         )
 
     def sharded_body(state: TrainState, batches):
@@ -468,12 +476,14 @@ def make_scanned_train_fn(
         out_specs=(state_specs, PartitionSpec()),
     )
     fn = jax.jit(sharded, donate_argnums=(0,) if donate_state else ())
+    bits = _reducer_bits(reducer, params_template, mesh.size) + LOSS_SYNC_BITS
     return CompiledStep(
         fn,
-        _reducer_bits(reducer, params_template, mesh.size) + LOSS_SYNC_BITS,
+        bits,
         mesh,
         reducer,
         optimizer,
+        _step_ledger(reducer, params_template, mesh, axis_name, bits),
     )
 
 
@@ -486,6 +496,27 @@ def _reducer_bits(reducer, params_template: PyTree, n_workers: int = 1) -> int:
         return reducer.bits_per_step(params_template, n_workers=n_workers)
     leaves = jax.tree_util.tree_leaves(params_template)
     return sum(8 * int(l.size) * l.dtype.itemsize for l in leaves)
+
+
+def _step_ledger(
+    reducer,
+    params_template: PyTree,
+    mesh: Optional[Mesh],
+    axis_name: str,
+    bits_per_step: int,
+):
+    """Itemized wire ledger for a step with the given analytic cost; the
+    single-process (mesh-less) step has no loss-sync collective."""
+    from ..observe.ledger import step_ledger
+
+    return step_ledger(
+        reducer,
+        params_template,
+        axis=axis_name if mesh is not None else "",
+        n_workers=mesh.size if mesh is not None else 1,
+        expected_bits=bits_per_step,
+        include_loss_sync=mesh is not None,
+    )
 
 
 def make_train_step(
@@ -522,8 +553,10 @@ def make_train_step(
             max_grad_norm=max_grad_norm,
         )
         fn = jax.jit(body, donate_argnums=(0,) if donate_state else ())
+        bits = _reducer_bits(reducer, params_template)
         return CompiledStep(
-            fn, _reducer_bits(reducer, params_template), None, reducer, optimizer
+            fn, bits, None, reducer, optimizer,
+            _step_ledger(reducer, params_template, None, axis_name, bits),
         )
 
     body = make_step_fn(
@@ -565,10 +598,12 @@ def make_train_step(
         out_specs=(state_specs, PartitionSpec()),
     )
     fn = jax.jit(sharded, donate_argnums=(0,) if donate_state else ())
+    bits = _reducer_bits(reducer, params_template, mesh.size) + LOSS_SYNC_BITS
     return CompiledStep(
         fn,
-        _reducer_bits(reducer, params_template, mesh.size) + LOSS_SYNC_BITS,
+        bits,
         mesh,
         reducer,
         optimizer,
+        _step_ledger(reducer, params_template, mesh, axis_name, bits),
     )

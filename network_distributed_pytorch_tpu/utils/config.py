@@ -40,3 +40,12 @@ class ExperimentConfig:
     log_every: int = 10
     accum_steps: int = 1  # gradient accumulation microbatches per step
     max_grad_norm: Optional[float] = None  # global-norm gradient clipping
+
+    # observability (observe/): structured JSONL run log, jax.profiler trace
+    # directory, and the compile-time wire-ledger-vs-HLO audit. audit_wire
+    # None = audit iff an event log is being written (the audit costs one
+    # extra XLA compile, so it follows the "this run is being recorded"
+    # signal unless forced).
+    event_log: Optional[str] = None
+    trace_dir: Optional[str] = None
+    audit_wire: Optional[bool] = None

@@ -68,12 +68,9 @@ class StepWatchdog:
     def _default_report(label: str) -> None:
         # structured version of the reference's failure banner
         # (ddp_guide_cifar10/ddp_init.py:98) — but impossible to miss
-        print(
-            json.dumps(
-                {"event": "watchdog_timeout", "label": label, "ts": time.time()}
-            ),
-            flush=True,
-        )
+        from ..observe import FailureEvent, default_telemetry
+
+        default_telemetry().emit(FailureEvent(kind="watchdog_timeout", label=label))
 
     def _monitor(self) -> None:
         while True:

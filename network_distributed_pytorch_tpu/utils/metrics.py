@@ -5,16 +5,21 @@ accumulates ``bits_communicated`` per step
 (``ddp_powersgd_guide_cifar10/ddp_init.py:123,161``) but never prints or
 persists it, and it imports ``time`` without ever measuring anything
 (``ddp_guide/ddp_init.py:4``). Here every step logs loss / step-time /
-cumulative bits, epochs print the reference's per-epoch mean-loss banner
-(``ddp_init.py:183``), and everything can be dumped as JSON lines.
+cumulative bits, epochs emit the reference's per-epoch mean-loss banner
+(``ddp_init.py:183``), and everything flows through the ``observe``
+telemetry — the stdout banners and the structured JSONL log are two sinks
+on the same events, so they cannot drift apart.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
+
+from ..observe import EpochEvent, StepEvent, Telemetry, default_telemetry
 
 
 @dataclass
@@ -24,20 +29,29 @@ class StepRecord:
     loss: float
     step_time_s: float
     bits_cumulative: int
+    # False = end_step without a matching start_step: there is no timing
+    # origin, so step_time_s is meaningless. Persisted (not silently ~0 s)
+    # so downstream percentiles can exclude it.
+    valid: bool = True
 
 
 @dataclass
 class MetricsLogger:
     """Host-side accumulator; bits/step is static so the Python-int tally is
-    exact (no device traffic)."""
+    exact (no device traffic). Events are emitted through ``telemetry``
+    (default: the process-wide stdout-banner registry)."""
 
     bits_per_step: int = 0
     log_every: int = 0  # 0 = silent per-step
     records: List[StepRecord] = field(default_factory=list)
+    telemetry: Optional[Telemetry] = None
     _epoch_losses: List[float] = field(default_factory=list)
     _step: int = 0
     _bits: int = 0
     _t_last: Optional[float] = None
+
+    def _telemetry(self) -> Telemetry:
+        return self.telemetry if self.telemetry is not None else default_telemetry()
 
     def start_step(self) -> None:
         self._t_last = time.perf_counter()
@@ -45,28 +59,45 @@ class MetricsLogger:
     def end_step(
         self, epoch: int, loss: float, bits: Optional[int] = None
     ) -> StepRecord:
-        dt = time.perf_counter() - (self._t_last or time.perf_counter())
+        if self._t_last is None:
+            valid, dt = False, 0.0
+        else:
+            valid, dt = True, time.perf_counter() - self._t_last
+        # one timing origin per step: a second end_step without a new
+        # start_step must not silently reuse (or double-count) the old one
+        self._t_last = None
         # `bits` overrides the static per-step cost for callers whose steps
         # have varying wire cost (e.g. streaming DiLoCo's per-fragment phases)
         self._bits += self.bits_per_step if bits is None else bits
-        rec = StepRecord(self._step, epoch, float(loss), dt, self._bits)
+        rec = StepRecord(self._step, epoch, float(loss), dt, self._bits, valid)
         self.records.append(rec)
         self._epoch_losses.append(float(loss))
         self._step += 1
-        if self.log_every and self._step % self.log_every == 0:
-            print(
-                f"step {rec.step}: loss {rec.loss:.4f}, "
-                f"{rec.step_time_s * 1e3:.1f} ms, "
-                f"{rec.bits_cumulative / 8e6:.2f} MB on wire"
+        self._telemetry().emit(
+            StepEvent(
+                step=rec.step,
+                epoch=rec.epoch,
+                loss=rec.loss,
+                step_time_s=rec.step_time_s,
+                bits_cumulative=rec.bits_cumulative,
+                valid=rec.valid,
+                verbose=bool(self.log_every) and self._step % self.log_every == 0,
             )
+        )
         return rec
 
     def end_epoch(self, epoch: int, rank: int = 0) -> float:
-        """Per-epoch mean loss, printed in the reference's banner style
+        """Per-epoch mean loss, emitted in the reference's banner style
         (``ddp_powersgd_guide_cifar10/ddp_init.py:183``)."""
         mean = sum(self._epoch_losses) / max(len(self._epoch_losses), 1)
-        print(f">>>>> Rank {rank}, epoch {epoch}: mean loss {mean:.4f}, "
-              f"{self.bits_communicated / 8e6:.2f} MB communicated")
+        self._telemetry().emit(
+            EpochEvent(
+                epoch=epoch,
+                rank=rank,
+                mean_loss=mean,
+                bits_cumulative=self._bits,
+            )
+        )
         self._epoch_losses = []
         return mean
 
@@ -75,7 +106,8 @@ class MetricsLogger:
         return self._bits
 
     def summary(self) -> Dict:
-        times = [r.step_time_s for r in self.records[1:]]  # drop compile step
+        # steady-state step time: drop the compile step and untimed records
+        times = [r.step_time_s for r in self.records[1:] if r.valid]
         return {
             "steps": len(self.records),
             "first_loss": self.records[0].loss if self.records else None,
@@ -85,7 +117,10 @@ class MetricsLogger:
             "bytes_communicated": self._bits // 8,
         }
 
-    def dump_jsonl(self, path: str) -> None:
-        with open(path, "w") as f:
+    def dump_jsonl(self, path: str, append: bool = False) -> None:
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(path, "a" if append else "w") as f:
             for r in self.records:
                 f.write(json.dumps(r.__dict__) + "\n")

@@ -11,7 +11,16 @@
 # hostenv.force_cpu_devices(collective_timeout_s=120), which strips and
 # re-appends those flags before jax init — setting them here would be dead
 # configuration.
+set -e
+cd "$(dirname "$0")"
+
+# observability lint: no bare print() outside the observe stdout sink —
+# every human banner must flow through telemetry so the console and the
+# structured JSONL log cannot drift apart
+python scripts/lint_no_print.py
+
+mkdir -p artifacts
 exec env -u PALLAS_AXON_POOL_IPS \
     JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
-    python -m pytest tests/ "$@"
+    python -m pytest tests/ --junitxml=artifacts/junit.xml "$@"

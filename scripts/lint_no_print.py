@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Lint: no bare ``print()`` inside the package outside the stdout sink.
+
+Every human-facing line the framework emits must flow through
+``observe.sinks.StdoutSink`` so the console and the structured JSONL log
+can never drift apart. This walks the package AST and fails (exit 1) on
+any other ``print`` call site.
+
+Usage::
+
+    python scripts/lint_no_print.py            # lint the package
+    python scripts/lint_no_print.py path [..]  # lint specific trees
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+# the one sanctioned print site (see observe/sinks.py docstring)
+ALLOWED = {os.path.join("observe", "sinks.py")}
+
+PACKAGE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "network_distributed_pytorch_tpu",
+)
+
+
+def print_calls(path: str):
+    with open(path, "rb") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+        ):
+            yield node.lineno
+
+
+def lint(roots) -> int:
+    violations = []
+    for root in roots:
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                rel = os.path.relpath(path, root)
+                if rel in ALLOWED:
+                    continue
+                for lineno in print_calls(path):
+                    violations.append(f"{path}:{lineno}")
+    if violations:
+        sys.stderr.write(
+            "bare print() outside observe/sinks.py — route it through an "
+            "observe event/sink instead:\n"
+        )
+        for v in violations:
+            sys.stderr.write(f"  {v}\n")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(lint(sys.argv[1:] or [PACKAGE]))

@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""Run report from a telemetry JSONL event log.
+
+Reads the log a ``observe.JsonlSink`` wrote (``ExperimentConfig.event_log`` /
+``launch.py --event-log``) and renders the numbers the bandwidth study is
+about: step-time percentiles, bytes/step itemized by wire-ledger tag,
+compression ratio, the analytic-vs-compiled-HLO reconciliation, and the
+overlap evidence from the scheduled HLO.
+
+stdlib-only and jax-free — runs anywhere the log file can be copied.
+
+Usage::
+
+    python scripts/report.py runs/exact.jsonl
+    python scripts/report.py runs/*.jsonl      # one report per file
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def load_events(path: str) -> List[Dict]:
+    """Parse a JSONL event log, skipping lines that are not JSON objects
+    (a log interleaved with foreign stdout stays readable)."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                events.append(rec)
+    return events
+
+
+def percentile(values: List[float], p: float) -> float:
+    """Nearest-rank percentile (exact for the small samples a run log has)."""
+    if not values:
+        return float("nan")
+    ordered = sorted(values)
+    k = max(0, min(len(ordered) - 1, round(p / 100 * (len(ordered) - 1))))
+    return ordered[int(k)]
+
+
+def _fmt_bytes(n: float) -> str:
+    if abs(n) >= 1e6:
+        return f"{n / 1e6:.2f} MB"
+    if abs(n) >= 1e3:
+        return f"{n / 1e3:.2f} KB"
+    return f"{n:.0f} B"
+
+
+def render_report(events: List[Dict], name: str = "") -> str:
+    by_kind: Dict[str, List[Dict]] = {}
+    for e in events:
+        by_kind.setdefault(e.get("event", "raw"), []).append(e)
+
+    lines: List[str] = []
+    title = f"run report{': ' + name if name else ''}"
+    lines.append(title)
+    lines.append("=" * len(title))
+    kinds = ", ".join(f"{k}={len(v)}" for k, v in sorted(by_kind.items()))
+    lines.append(f"{len(events)} events ({kinds})")
+
+    steps = by_kind.get("step", [])
+    valid = [s for s in steps if s.get("valid", True)]
+    times = [s["step_time_s"] for s in valid if "step_time_s" in s]
+    if steps:
+        lines.append("")
+        lines.append("steps")
+        lines.append("-----")
+        lines.append(
+            f"  {len(steps)} steps recorded, {len(valid)} with valid timing"
+        )
+        if times:
+            # the first timed step pays jit compilation; steady-state excludes it
+            steady = times[1:] if len(times) > 1 else times
+            lines.append(
+                f"  step time   p50 {percentile(steady, 50) * 1e3:8.1f} ms   "
+                f"p95 {percentile(steady, 95) * 1e3:8.1f} ms   "
+                f"(steady-state, n={len(steady)}; "
+                f"first step {times[0] * 1e3:.1f} ms incl. compile)"
+            )
+        losses = [s["loss"] for s in steps if "loss" in s]
+        if losses:
+            lines.append(
+                f"  loss        first {losses[0]:.4f} -> last {losses[-1]:.4f}"
+            )
+        bits = [s["bits_cumulative"] for s in steps if "bits_cumulative" in s]
+        if bits and len(steps) > 0:
+            per_step = (bits[-1] - bits[0]) / max(1, len(steps) - 1) / 8 if len(steps) > 1 else bits[0] / 8
+            lines.append(
+                f"  wire        {_fmt_bytes(bits[-1] / 8)} total, "
+                f"{_fmt_bytes(per_step)}/step"
+            )
+
+    collectives = by_kind.get("collective", [])
+    if collectives:
+        lines.append("")
+        lines.append("wire ledger (bytes/step by tag)")
+        lines.append("-------------------------------")
+        total = sum(c.get("payload_bytes", 0) for c in collectives)
+        for c in collectives:
+            pct = 100 * c.get("payload_bytes", 0) / total if total else 0
+            lines.append(
+                f"  {c.get('tag', '?'):<18} {c.get('layer', '?'):<8} "
+                f"{c.get('op', '?'):<14} x{c.get('count', 1):<3} "
+                f"{_fmt_bytes(c.get('payload_bytes', 0)):>12}  ({pct:4.1f}%)"
+            )
+        lines.append(f"  {'total':<18} {'':<8} {'':<14} {'':<4} {_fmt_bytes(total):>12}")
+
+    for comp in by_kind.get("compile", []):
+        lines.append("")
+        lines.append(f"compile audit: {comp.get('label', '?')}")
+        lines.append("-" * (15 + len(str(comp.get("label", "?")))))
+        delta = comp.get("delta_bytes", 0)
+        verdict = "byte-exact" if comp.get("exact") else f"delta {delta:+d} B"
+        lines.append(
+            f"  analytic {_fmt_bytes(comp.get('analytic_bytes', 0))}/step vs "
+            f"compiled HLO {_fmt_bytes(comp.get('hlo_bytes', 0))}/step -> {verdict}"
+        )
+        if comp.get("hlo_by_kind"):
+            kinds = ", ".join(
+                f"{k} x{v}" for k, v in sorted(comp["hlo_by_kind"].items())
+            )
+            lines.append(
+                f"  HLO collectives ({comp.get('hlo_collective_count', 0)}): {kinds}"
+            )
+        if comp.get("compression_ratio") is not None:
+            lines.append(
+                f"  compression {comp['compression_ratio']:.1f}x "
+                f"(dense gradient {_fmt_bytes(comp.get('dense_grad_bytes') or 0)})"
+            )
+        ov = comp.get("overlap") or {}
+        if ov:
+            if ov.get("scheduled"):
+                lines.append(
+                    f"  overlap: {ov.get('n_overlapped', 0)}/"
+                    f"{ov.get('n_async_collectives', 0)} async collectives "
+                    f"overlapped with compute; "
+                    f"{ov.get('n_copy_windows_with_compute', 0)}/"
+                    f"{ov.get('n_async_copy_windows', 0)} DMA copy windows "
+                    f"with compute inside"
+                )
+                if ov.get("collective_emitters"):
+                    lines.append(
+                        f"  emitters: {', '.join(sorted(set(ov['collective_emitters'])))}"
+                    )
+            else:
+                lines.append(
+                    "  overlap: HLO not scheduled (CPU backend) — async windows n/a"
+                )
+
+    epochs = by_kind.get("epoch", [])
+    if epochs:
+        lines.append("")
+        lines.append("epochs")
+        lines.append("------")
+        for e in epochs:
+            lines.append(
+                f"  epoch {e.get('epoch', '?')}: mean loss "
+                f"{e.get('mean_loss', float('nan')):.4f}, "
+                f"{_fmt_bytes(e.get('bits_cumulative', 0) / 8)} cumulative"
+            )
+
+    failures = by_kind.get("failure", [])
+    if failures:
+        lines.append("")
+        lines.append("failures")
+        lines.append("--------")
+        for f_ in failures:
+            lines.append(f"  {json.dumps(f_, default=str)}")
+
+    notes = by_kind.get("note", [])
+    if notes:
+        lines.append("")
+        lines.append(f"notes: {len(notes)}")
+
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("logs", nargs="+", help="telemetry JSONL file(s)")
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the aggregated per-kind event counts as JSON instead of text",
+    )
+    args = parser.parse_args(argv)
+    for path in args.logs:
+        events = load_events(path)
+        if args.json:
+            counts: Dict[str, int] = {}
+            for e in events:
+                k = e.get("event", "raw")
+                counts[k] = counts.get(k, 0) + 1
+            sys.stdout.write(json.dumps({"log": path, "events": counts}) + "\n")
+        else:
+            sys.stdout.write(render_report(events, name=path))
+            if len(args.logs) > 1:
+                sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -52,16 +52,19 @@ def test_exact_hlo_payload_matches_analytic(devices):
     # bits_per_step is the WHOLE step's wire cost (reducer payload + the
     # 4-byte loss pmean, trainer.LOSS_SYNC_BITS) — byte-exact vs compiled HLO
     assert s["total_payload_bytes"] == step.bits_per_step // 8
-    # combiner merges the gradient and loss all-reduces into ONE collective
-    assert s["by_kind"] == {"all-reduce": 1}
+    # only all-reduces, and at most 2 (the gradient + the loss pmean —
+    # whether the combiner merges them into one is toolchain-dependent)
+    assert set(s["by_kind"]) == {"all-reduce"}
+    assert 1 <= s["by_kind"]["all-reduce"] <= 2
 
 
 def test_powersgd_hlo_payload_matches_analytic(devices):
     step, s = _summary(PowerSGDReducer(compression_rank=2, matricize="last"), "ef_momentum")
     assert s["total_payload_bytes"] == step.bits_per_step // 8
-    # the P / rank-1 / Q / loss collectives compile to at most 3 (Q depends
-    # on allreduced-P so it cannot merge with it; the rest may combine)
-    assert 2 <= s["by_kind"]["all-reduce"] <= 3
+    # the P / rank-1 / Q / loss logical collectives compile to at most 4;
+    # Q depends on allreduced-P so at least 2 remain after the combiner
+    # (how much the rest merge is toolchain-dependent)
+    assert 2 <= s["by_kind"]["all-reduce"] <= 4
 
 
 def test_full_step_with_batch_stats_no_unaccounted_collectives(devices):
@@ -139,3 +142,60 @@ def test_audit_parses_tpu_layout_annotations():
     assert len(ops) == 2
     assert ops[0].payload_bytes == 4 * 219724
     assert ops[1].payload_bytes == 4 * (53130 + 106280 + 1)
+
+
+def test_audit_tuple_result_combiner_merged_mixed_dtypes():
+    """A combiner-merged collective is ONE tuple-result op whose payload
+    sums its components at each component's OWN dtype width — a bf16 buffer
+    merged with f32 buffers must not be billed at 4 bytes/elem."""
+    from network_distributed_pytorch_tpu.utils.hlo_audit import audit_hlo
+
+    hlo = (
+        "  %merged = (f32[100]{0}, bf16[50]{0}, f32[]) "
+        "all-reduce(%a, %b, %c), replica_groups={{0,1,2,3}}, to_apply=%add\n"
+    )
+    ops = audit_hlo(hlo)
+    assert len(ops) == 1
+    op = ops[0]
+    assert op.kind == "all-reduce"
+    assert op.payload_bytes == 4 * 100 + 2 * 50 + 4
+    assert op.dtype == "f32+bf16+f32"
+    assert op.shape == ((100,), (50,), ())
+    assert op.group == (0, 1, 2, 3) and op.group_size == 4
+
+
+def test_audit_tuple_result_reduce_scatter_scales_by_group():
+    """A tuple-result (combiner-merged) reduce-scatter's result is 1/N of
+    each reduced buffer — the audit scales the SUMMED components by the
+    replica-group size so the payload stays in the same convention as
+    all-reduce (the logical buffer moved)."""
+    from network_distributed_pytorch_tpu.utils.hlo_audit import audit_hlo
+
+    hlo = (
+        "  %rs = (f32[16]{0}, f32[8]{0}) reduce-scatter(%a, %b), "
+        "replica_groups={{0,1,2,3}}, dimensions={0}, to_apply=%add\n"
+    )
+    ops = audit_hlo(hlo)
+    assert len(ops) == 1
+    assert ops[0].payload_bytes == (4 * 16 + 4 * 8) * 4
+    assert ops[0].group_size == 4
+
+
+def test_audit_async_start_form_counted_once():
+    """The async `-start` form of a collective is audited like the sync op
+    (same result type), and its `-done` line — which repeats no collective
+    keyword with a payload — adds nothing."""
+    from network_distributed_pytorch_tpu.utils.hlo_audit import (
+        audit_hlo,
+        collective_summary,
+    )
+
+    hlo = (
+        "  %ar = f32[96]{0} all-reduce-start(%x), "
+        "replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add\n"
+        "  %ard = f32[96]{0} all-reduce-done(%ar)\n"
+    )
+    ops = audit_hlo(hlo)
+    assert len(ops) == 1
+    assert ops[0].payload_bytes == 4 * 96
+    assert collective_summary(hlo)["by_kind"] == {"all-reduce": 1}

@@ -52,13 +52,37 @@ def test_none_removes_count_and_drops_tunnel(monkeypatch):
 
 
 def test_collective_timeout_flags(monkeypatch):
+    """The timeout flags are appended only when the installed jaxlib
+    registers them — an unknown name in XLA_FLAGS aborts the process at
+    backend init, so on older jaxlibs suppression IS the correct output."""
     _clean(monkeypatch)
     import os
 
     hostenv.force_cpu_devices(8, collective_timeout_s=600)
     flags = os.environ["XLA_FLAGS"]
-    assert "--xla_cpu_collective_call_warn_stuck_timeout_seconds=600" in flags
-    assert "--xla_cpu_collective_call_terminate_timeout_seconds=1200" in flags
+    supported = hostenv._xla_flag_supported(
+        "xla_cpu_collective_call_warn_stuck_timeout_seconds"
+    )
+    assert (
+        "--xla_cpu_collective_call_warn_stuck_timeout_seconds=600" in flags
+    ) is supported
+    assert (
+        "--xla_cpu_collective_call_terminate_timeout_seconds=1200" in flags
+    ) is supported
+
+
+def test_collective_timeout_flags_forced_supported(monkeypatch):
+    """With the probe forced true, both deadlines are appended and
+    de-duplicated on re-entry."""
+    _clean(monkeypatch)
+    import os
+
+    monkeypatch.setattr(hostenv, "_xla_flag_supported", lambda name: True)
+    hostenv.force_cpu_devices(8, collective_timeout_s=600)
+    hostenv.force_cpu_devices(8, collective_timeout_s=600)
+    flags = os.environ["XLA_FLAGS"]
+    assert flags.count("warn_stuck_timeout_seconds=600") == 1
+    assert flags.count("terminate_timeout_seconds=1200") == 1
 
 
 def test_updates_config_when_jax_imported(monkeypatch):

@@ -1,0 +1,435 @@
+"""The observe subsystem: typed events, sinks, the telemetry registry, the
+wire ledger, the metrics logger's event emission, and scripts/report.py.
+
+Most tests here are jax-free on purpose — the bench parent orchestrator
+imports observe before (and without) any jax backend init, and the one
+subprocess test pins that property.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from network_distributed_pytorch_tpu.observe import (
+    CollectiveEvent,
+    CompileEvent,
+    EpochEvent,
+    FailureEvent,
+    JsonlSink,
+    LedgerEntry,
+    MemorySink,
+    NoteEvent,
+    RawEvent,
+    StdoutSink,
+    StepEvent,
+    StreamJsonSink,
+    Telemetry,
+    WireLedger,
+    audit_from_config,
+    telemetry_for_run,
+)
+from network_distributed_pytorch_tpu.observe.ledger import (
+    ledger_from_hlo_summary,
+    loss_sync_entry,
+    step_ledger,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# events
+# ---------------------------------------------------------------------------
+
+
+def test_step_event_record_excludes_presentation_fields():
+    ev = StepEvent(
+        step=3, epoch=0, loss=1.5, step_time_s=0.25, bits_cumulative=800,
+        valid=True, verbose=True,
+    )
+    rec = ev.record()
+    assert rec["event"] == "step"
+    assert rec["valid"] is True
+    assert "verbose" not in rec  # presentation-only
+    assert "0.2" not in ev.banner() or "250.0 ms" in ev.banner()
+
+
+def test_step_event_banner_gated_on_verbose_and_valid():
+    quiet = StepEvent(0, 0, 1.0, 0.1, 8, valid=True, verbose=False)
+    assert quiet.banner() is None
+    untimed = StepEvent(0, 0, 1.0, 0.0, 8, valid=False, verbose=True)
+    assert "untimed" in untimed.banner()
+
+
+def test_epoch_event_banner_reference_format():
+    ev = EpochEvent(epoch=2, rank=1, mean_loss=0.75, bits_cumulative=16_000_000)
+    assert ev.banner() == (
+        ">>>>> Rank 1, epoch 2: mean loss 0.7500, 2.00 MB communicated"
+    )
+
+
+def test_raw_event_record_is_verbatim_payload():
+    payload = {"metric": "imgs/sec", "value": 42}
+    rec = RawEvent(payload).record()
+    assert rec == payload
+    assert "event" not in rec
+
+
+def test_failure_event_banner_is_json():
+    ev = FailureEvent(kind="watchdog_timeout", label="step 9")
+    parsed = json.loads(ev.banner())
+    assert parsed["event"] == "failure"
+    assert parsed["kind"] == "watchdog_timeout"
+
+
+# ---------------------------------------------------------------------------
+# telemetry + sinks
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_stamps_ts_except_raw():
+    mem = MemorySink()
+    t = Telemetry([mem])
+    t.emit(NoteEvent("hello"))
+    t.emit(RawEvent({"value": 1}))
+    assert "ts" in mem.records[0]
+    assert "ts" not in mem.records[1]  # verbatim driver contract
+
+
+def test_telemetry_fans_out_to_all_sinks():
+    a, b = MemorySink(), MemorySink()
+    Telemetry([a, b]).emit(NoteEvent("x"))
+    assert len(a.records) == len(b.records) == 1
+    assert a.of_kind("note") and b.of_kind("note")
+
+
+def test_stdout_sink_prints_only_banners(capsys):
+    t = Telemetry([StdoutSink()])
+    t.emit(NoteEvent("visible"))
+    t.emit(StepEvent(0, 0, 1.0, 0.1, 8, verbose=False))  # banner() is None
+    out = capsys.readouterr().out
+    assert out == "visible\n"
+
+
+def test_stream_json_sink_prefix():
+    buf = io.StringIO()
+    Telemetry([StreamJsonSink(buf, prefix="@BENCH@ ")]).emit(
+        RawEvent({"phase": "probe", "ok": True})
+    )
+    line = buf.getvalue()
+    assert line.startswith("@BENCH@ {")
+    assert json.loads(line[len("@BENCH@ "):]) == {"phase": "probe", "ok": True}
+
+
+def test_jsonl_sink_creates_parent_and_appends(tmp_path):
+    path = str(tmp_path / "deep" / "nested" / "run.jsonl")
+    with telemetry_for_run(event_log=path, stdout=False) as t:
+        t.emit(NoteEvent("first"))
+    with telemetry_for_run(event_log=path, stdout=False) as t:
+        t.emit(NoteEvent("second"))  # append mode: the default
+    lines = [json.loads(l) for l in open(path)]
+    assert [l["message"] for l in lines] == ["first", "second"]
+
+
+def test_jsonl_sink_write_mode_truncates(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    for msg in ("old", "new"):
+        sink = JsonlSink(path, append=False)
+        with Telemetry([sink]) as t:
+            t.emit(NoteEvent(msg))
+    lines = [json.loads(l) for l in open(path)]
+    assert [l["message"] for l in lines] == ["new"]
+
+
+def test_audit_from_config_defaults_to_event_log():
+    class Cfg:
+        event_log = None
+        audit_wire = None
+
+    c = Cfg()
+    assert audit_from_config(c) is False
+    c.event_log = "runs/x.jsonl"
+    assert audit_from_config(c) is True
+    c.audit_wire = False  # explicit override wins
+    assert audit_from_config(c) is False
+    c.event_log = None
+    c.audit_wire = True
+    assert audit_from_config(c) is True
+
+
+def test_observe_package_is_jax_free():
+    """The bench parent imports observe with NO jax backend init — importing
+    the package must not pull jax into the process."""
+    code = (
+        "import sys\n"
+        "import network_distributed_pytorch_tpu.observe\n"
+        "assert 'jax' not in sys.modules, 'observe imported jax'\n"
+    )
+    subprocess.run([sys.executable, "-c", code], check=True, cwd=REPO)
+
+
+# ---------------------------------------------------------------------------
+# wire ledger
+# ---------------------------------------------------------------------------
+
+
+def _ledger():
+    return WireLedger(
+        [
+            LedgerEntry("powersgd.P", "reducer", "all-reduce", "data", "float32", 64),
+            LedgerEntry("powersgd.Q", "reducer", "all-reduce", "data", "float32", 32),
+            loss_sync_entry("data"),
+        ],
+        dense_grad_bits=8 * 960,
+    )
+
+
+def test_wire_ledger_totals_and_grouping():
+    led = _ledger()
+    assert led.total_bytes() == 100
+    assert led.total_bits() == 800
+    assert led.by_tag() == {"powersgd.P": 64, "powersgd.Q": 32, "loss-sync": 4}
+    assert led.by_layer() == {"reducer": 96, "trainer": 4}
+    # compression ratio divides by REDUCER bytes only (loss-sync is overhead)
+    assert led.compression_ratio() == pytest.approx(960 / 96)
+
+
+def test_wire_ledger_collective_events_carry_label():
+    evs = _ledger().collective_events("unit_test")
+    assert len(evs) == 3
+    assert all(e.label == "unit_test" for e in evs)
+    assert {e.tag for e in evs} == {"powersgd.P", "powersgd.Q", "loss-sync"}
+
+
+def test_wire_ledger_reconcile_reports_signed_delta():
+    led = _ledger()  # 100 analytic bytes
+    exact_hlo = (
+        "  %ar = (f32[24]{0}, f32[]) all-reduce(%a, %b), "
+        "replica_groups={{0,1}}, to_apply=%add\n"
+    )  # 4*24 + 4 = 100 bytes
+    rec = led.reconcile(exact_hlo)
+    assert rec["exact"] and rec["delta_bytes"] == 0
+    assert rec["hlo_by_kind"] == {"all-reduce": 1}
+    short_hlo = "  %ar = f32[20]{0} all-reduce(%a), to_apply=%add\n"
+    rec = led.reconcile(short_hlo)
+    assert not rec["exact"]
+    assert rec["delta_bytes"] == 80 - 100  # signed, never hidden
+
+
+def test_step_ledger_asserts_itemization_matches_model(devices):
+    import jax.numpy as jnp
+
+    from network_distributed_pytorch_tpu.parallel import ExactReducer
+
+    params = {"w": jnp.zeros((4, 3)), "b": jnp.zeros((3,))}
+    # exact DDP moves every gradient byte once, plus the 4-byte loss pmean
+    bits = 8 * 4 * (4 * 3 + 3) + 32
+    led = step_ledger(ExactReducer(), params, "data", 2, expected_bits=bits)
+    assert led.total_bits() == bits
+    with pytest.raises(AssertionError, match="itemizes"):
+        step_ledger(ExactReducer(), params, "data", 2, expected_bits=bits + 8)
+
+
+def test_powersgd_ledger_itemizes_bits_per_step(devices):
+    import jax.numpy as jnp
+
+    from network_distributed_pytorch_tpu.parallel import PowerSGDReducer
+
+    params = {"w": jnp.zeros((8, 4)), "b": jnp.zeros((4,))}
+    red = PowerSGDReducer(compression_rank=2, matricize="last")
+    led = step_ledger(
+        red, params, "data", 2,
+        expected_bits=red.bits_per_step(params, n_workers=2) + 32,
+    )
+    tags = led.by_tag()
+    assert "powersgd.P" in tags and "powersgd.Q" in tags
+    assert "loss-sync" in tags
+    assert led.compression_ratio() is not None and led.compression_ratio() > 1.0
+
+
+def test_ledger_from_hlo_summary_reconciles_exactly():
+    from network_distributed_pytorch_tpu.utils.hlo_audit import collective_summary
+
+    hlo = (
+        "  %ar = f32[100]{0} all-reduce(%a), replica_groups={{0,1}}, to_apply=%add\n"
+        "  %ag = f32[50]{0} all-gather(%b), dimensions={0}\n"
+    )
+    summary = collective_summary(hlo)
+    led = ledger_from_hlo_summary(summary, layer="pipeline", axis="pipe")
+    assert led.total_bytes() == summary["total_payload_bytes"]
+    rec = led.reconcile(hlo)
+    assert rec["exact"]  # exact by construction
+
+
+def test_compiled_step_carries_matching_ledger(devices):
+    """Trainer integration: every CompiledStep's ledger itemizes exactly its
+    own bits_per_step (the construction-time invariant, end to end)."""
+    import jax.numpy as jnp
+
+    from network_distributed_pytorch_tpu.parallel import ExactReducer, make_mesh
+    from network_distributed_pytorch_tpu.parallel.trainer import (
+        make_train_step,
+        stateless_loss,
+    )
+
+    params = {"w": jnp.zeros((8, 4))}
+    loss = stateless_loss(lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2))
+    step = make_train_step(
+        loss, ExactReducer(), params, 0.05, mesh=make_mesh(), donate_state=False
+    )
+    assert step.ledger is not None
+    assert step.ledger.total_bits() == step.bits_per_step
+    assert "loss-sync" in step.ledger.by_tag()
+
+
+# ---------------------------------------------------------------------------
+# metrics logger -> events
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_end_step_without_start_is_invalid_not_zero():
+    from network_distributed_pytorch_tpu.utils.metrics import MetricsLogger
+
+    mem = MemorySink()
+    logger = MetricsLogger(bits_per_step=80, telemetry=Telemetry([mem]))
+    logger.end_step(0, loss=1.0)  # no start_step: no timing origin
+    logger.start_step()
+    logger.end_step(0, loss=0.9)
+    recs = mem.of_kind("step")
+    assert recs[0]["valid"] is False
+    assert recs[1]["valid"] is True
+    # the invalid record is excluded from the steady-state mean, not
+    # averaged in as a bogus ~0 s sample
+    assert logger.records[0].valid is False
+    assert logger.summary()["bits_communicated"] == 160
+
+
+def test_metrics_second_end_step_does_not_reuse_timing_origin():
+    from network_distributed_pytorch_tpu.utils.metrics import MetricsLogger
+
+    logger = MetricsLogger(telemetry=Telemetry([]))
+    logger.start_step()
+    first = logger.end_step(0, loss=1.0)
+    second = logger.end_step(0, loss=0.9)  # no new start_step
+    assert first.valid and not second.valid
+
+
+def test_metrics_dump_jsonl_creates_parent_and_appends(tmp_path):
+    from network_distributed_pytorch_tpu.utils.metrics import MetricsLogger
+
+    logger = MetricsLogger(bits_per_step=8, telemetry=Telemetry([]))
+    logger.start_step()
+    logger.end_step(0, loss=1.0)
+    path = str(tmp_path / "not" / "yet" / "steps.jsonl")
+    logger.dump_jsonl(path)  # parent dirs created
+    logger.dump_jsonl(path, append=True)
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 2
+    assert all(l["valid"] for l in lines)
+
+
+def test_metrics_epoch_event_banner(capsys):
+    from network_distributed_pytorch_tpu.utils.metrics import MetricsLogger
+
+    logger = MetricsLogger(bits_per_step=8_000_000, telemetry=Telemetry([StdoutSink()]))
+    logger.start_step()
+    logger.end_step(0, loss=0.5)
+    logger.end_epoch(0, rank=3)
+    out = capsys.readouterr().out
+    assert ">>>>> Rank 3, epoch 0: mean loss 0.5000, 1.00 MB communicated" in out
+
+
+# ---------------------------------------------------------------------------
+# scripts/report.py
+# ---------------------------------------------------------------------------
+
+
+def _load_report_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "report", os.path.join(REPO, "scripts", "report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_report_renders_all_sections(tmp_path):
+    report = _load_report_module()
+    path = str(tmp_path / "run.jsonl")
+    with telemetry_for_run(event_log=path, stdout=False) as t:
+        for i in range(4):
+            t.emit(StepEvent(i, 0, 1.0 - i * 0.1, 0.05 + i * 0.01, 96 * (i + 1)))
+        t.emit(
+            CollectiveEvent(
+                label="t", tag="grads", layer="reducer", op="all-reduce",
+                axis="data", dtype="float32", payload_bytes=92,
+            )
+        )
+        t.emit(
+            CompileEvent(
+                label="t", analytic_bytes=96, hlo_bytes=96, delta_bytes=0,
+                exact=True, hlo_collective_count=1,
+                hlo_by_kind={"all-reduce": 1},
+                overlap={"scheduled": True, "n_async_collectives": 0,
+                         "n_overlapped": 0, "n_async_copy_windows": 2,
+                         "n_copy_windows_with_compute": 1},
+            )
+        )
+        t.emit(EpochEvent(epoch=0, rank=0, mean_loss=0.85, bits_cumulative=384))
+        t.emit(FailureEvent(kind="watchdog_timeout", label="step 3"))
+    events = report.load_events(path)
+    text = report.render_report(events, name="unit")
+    assert "steps" in text and "4 steps recorded" in text
+    assert "wire ledger" in text and "grads" in text
+    assert "compile audit" in text and "byte-exact" in text
+    assert "all-reduce x1" in text
+    assert "epochs" in text and "failures" in text
+    assert "watchdog_timeout" in text
+
+
+def test_report_percentiles_and_delta(tmp_path):
+    report = _load_report_module()
+    assert report.percentile([1.0, 2.0, 3.0, 4.0], 50) == pytest.approx(3.0)
+    assert report.percentile([5.0], 95) == 5.0
+    path = str(tmp_path / "run.jsonl")
+    with telemetry_for_run(event_log=path, stdout=False) as t:
+        t.emit(
+            CompileEvent(
+                label="powersgd", analytic_bytes=100, hlo_bytes=92,
+                delta_bytes=-8, exact=False, hlo_collective_count=2,
+                compression_ratio=10.0, dense_grad_bytes=960,
+                overlap={"scheduled": False},
+            )
+        )
+    text = report.render_report(report.load_events(path))
+    assert "delta -8 B" in text  # reported, not hidden
+    assert "compression 10.0x" in text
+    assert "HLO not scheduled" in text
+
+
+def test_report_skips_foreign_lines(tmp_path):
+    report = _load_report_module()
+    path = str(tmp_path / "run.jsonl")
+    with open(path, "w") as f:
+        f.write("not json at all\n")
+        f.write(json.dumps({"event": "note", "message": "ok"}) + "\n")
+        f.write("[1, 2, 3]\n")  # JSON but not an object
+    events = report.load_events(path)
+    assert len(events) == 1 and events[0]["event"] == "note"
+
+
+def test_report_cli_json_mode(tmp_path, capsys):
+    report = _load_report_module()
+    path = str(tmp_path / "run.jsonl")
+    with telemetry_for_run(event_log=path, stdout=False) as t:
+        t.emit(NoteEvent("x"))
+        t.emit(NoteEvent("y"))
+    assert report.main([path, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["events"] == {"note": 2}

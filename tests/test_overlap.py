@@ -77,3 +77,51 @@ def test_overlap_report_generic_async_wrapper():
     assert rep["collectives"][0]["kind"] == "all-reduce"
     assert rep["n_overlapped"] == 1  # the fusion + dot sit inside the window
     assert rep["collectives"][0]["compute_ops_between"] == 2
+
+
+def test_overlap_report_start_done_pairing_by_name():
+    """-done pairs with ITS -start by operand name, not by order: with two
+    interleaved windows, each window's op count comes from its own span,
+    and a -done naming an unknown op is ignored rather than crashing."""
+    hlo = "\n".join([
+        "HloModule m, is_scheduled=true",
+        "ENTRY %main () -> f32[8] {",
+        "  %a-start = f32[96]{0} all-reduce-start(%x), to_apply=%add",
+        "  %b-start = (f32[8],f32[8]) all-gather-start(%y), dimensions={0}",
+        "  %f1 = f32[8]{0} fusion(%y), kind=kLoop",
+        "  %a-done = f32[96]{0} all-reduce-done(%a-start)",
+        "  %orphan = f32[8]{0} all-gather-done(%never-started)",
+        "  %d = f32[8]{0} dot(%f1, %f1)",
+        "  %b-done = f32[8]{0} all-gather-done(%b-start)",
+        "}",
+    ])
+    rep = overlap_report(hlo)
+    assert rep["n_async_collectives"] == 2
+    ar = [c for c in rep["collectives"] if c["kind"] == "all-reduce"][0]
+    ag = [c for c in rep["collectives"] if c["kind"] == "all-gather"][0]
+    # the all-reduce window holds only the all-gather-start + fusion; the
+    # all-gather window additionally spans the -done/orphan/dot lines
+    assert ar["compute_ops_between"] == 1
+    assert ag["compute_ops_between"] == 2
+    assert rep["n_overlapped"] == 2 and rep["all_overlap"]
+
+
+def test_overlap_report_copy_windows_counted():
+    """The TPU memory scheduler's copy-start/copy-done DMA prefetch windows
+    are counted (with/without compute inside) but never listed as async
+    collectives — on v5e they ARE the visible latency hiding."""
+    hlo = "\n".join([
+        "HloModule m, is_scheduled=true",
+        "ENTRY %main () -> f32[8] {",
+        "  %c1 = (f32[8],f32[8],u32[],u32[]) copy-start(%p)",
+        "  %f = f32[8]{0} fusion(%p), kind=kLoop",
+        "  %c1d = f32[8]{0} copy-done(%c1)",
+        "  %c2 = (f32[8],f32[8],u32[],u32[]) copy-start(%q)",
+        "  %c2d = f32[8]{0} copy-done(%c2)",
+        "}",
+    ])
+    rep = overlap_report(hlo)
+    assert rep["n_async_collectives"] == 0
+    assert rep["collectives"] == []
+    assert rep["n_async_copy_windows"] == 2
+    assert rep["n_copy_windows_with_compute"] == 1
