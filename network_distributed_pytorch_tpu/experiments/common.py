@@ -232,12 +232,15 @@ def audited_carry_loop(
 
     start_epoch = 0
     if checkpoint_dir is not None:
-        from ..utils.checkpoint import latest_step_path, restore_checkpoint
+        from ..utils.checkpoint import restore_latest
 
-        latest = latest_step_path(checkpoint_dir)
-        if latest is not None:
-            carry = restore_checkpoint(latest, _jax.device_get(carry))
-            start_epoch = int(latest.rsplit("step_", 1)[1]) + 1
+        resumed = restore_latest(
+            checkpoint_dir, _jax.device_get(carry),
+            telemetry=telemetry, label=run_name,
+        )
+        if resumed is not None:
+            carry, resumed_epoch = resumed
+            start_epoch = resumed_epoch + 1
 
     compiled = jitted.lower(carry, *example_batch).compile()
     hlo_text = hlo_text_of_compiled(compiled)
@@ -404,34 +407,95 @@ def resilient_train_loop(
     trace_dir: Optional[str] = None,
     audit: bool = False,
     run_name: str = "train",
+    chaos_plan: Any = None,
+    incarnation: int = 0,
+    step_retries: int = 0,
+    guard_batches: bool = False,
+    expected_batch: Optional[int] = None,
+    keep_last: Optional[int] = None,
+    batch_sharding: Any = None,
 ) -> Tuple[TrainState, "MetricsLogger", int]:
     """:func:`train_loop` plus the survival kit the reference lacks entirely
     (SURVEY §5: no checkpointing, no retry; a failed init doesn't even exit):
 
-    - on entry, resume from the newest per-epoch checkpoint under
-      ``checkpoint_dir`` (full TrainState — the EF chain continues exactly);
-    - every epoch, save one (epoch-boundary checkpoints + deterministic
-      per-epoch data streams ⇒ a crash-restart converges to the SAME state
-      as an uninterrupted run);
+    - on entry, resume from the newest COMMITTED checkpoint under
+      ``checkpoint_dir`` that passes checksum verification — a torn or
+      bit-flipped directory is skipped with a ``checkpoint_fallback`` event
+      and the previous good step restored instead (full TrainState — the EF
+      chain continues exactly);
+    - every epoch, save one through the atomic commit protocol
+      (``keep_last`` garbage-collects older steps);
     - optional :class:`utils.failure.StepWatchdog` around every step and
-      :class:`utils.failure.HeartbeatMonitor` beat per step.
+      :class:`utils.failure.HeartbeatMonitor` beat per step;
+    - ``step_retries > 0`` wraps the step in
+      :class:`resilience.guards.GuardedStep` (transient-error retry +
+      non-finite-loss rejection; requires ``donate_state=False``), and
+      ``guard_batches`` drops malformed loader batches;
+    - ``chaos_plan`` (a :class:`resilience.chaos.ChaosPlan`) threads
+      deterministic fault injection into all of the above — the chaos
+      suite's entry point. ``incarnation`` is this worker's supervisor
+      restart generation (``resilience.supervisor.incarnation_from_env``),
+      matched against the plan so a restarted worker doesn't re-crash.
 
     Returns ``(state, logger, start_epoch)`` — ``start_epoch`` tells the
     caller how many epochs were skipped via resume.
     """
-    from ..utils.checkpoint import (
-        latest_step_path,
-        restore_checkpoint,
-        save_checkpoint,
-    )
+    from ..observe import FailureEvent
+    from ..utils.checkpoint import restore_latest, save_checkpoint
     from ..utils.failure import StepWatchdog
 
     state = init_state
     start_epoch = 0
-    latest = latest_step_path(checkpoint_dir)
-    if latest is not None:
-        state = restore_checkpoint(latest, init_state)
-        start_epoch = int(latest.rsplit("step_", 1)[1]) + 1
+    resumed = restore_latest(
+        checkpoint_dir, init_state, telemetry=telemetry, label=run_name
+    )
+    if resumed is not None:
+        state, resumed_epoch = resumed
+        start_epoch = resumed_epoch + 1
+        if telemetry is not None:
+            telemetry.emit(
+                FailureEvent(
+                    kind="resumed", label=run_name, rank=rank,
+                    step=resumed_epoch, incarnation=incarnation,
+                    message=f"resumed from step_{resumed_epoch},"
+                            f" starting epoch {start_epoch}",
+                )
+            )
+
+    if chaos_plan is not None:
+        from ..resilience.chaos import ChaosStep, chaos_batches
+
+        step = ChaosStep(
+            step, chaos_plan, rank=rank, incarnation=incarnation,
+            telemetry=telemetry,
+        )
+        batches_for_epoch = chaos_batches(
+            batches_for_epoch, chaos_plan, rank=rank,
+            incarnation=incarnation, telemetry=telemetry,
+        )
+    if step_retries > 0:
+        from ..resilience.guards import GuardedStep
+
+        step = GuardedStep(
+            step, retries=step_retries, telemetry=telemetry, label=run_name
+        )
+    if guard_batches:
+        from ..resilience.guards import guarded_batches
+
+        batches_for_epoch = guarded_batches(
+            batches_for_epoch, expected_batch=expected_batch,
+            telemetry=telemetry, label=run_name,
+        )
+
+    def _save(epoch: int, st) -> None:
+        save_checkpoint(checkpoint_dir, st, step=epoch, keep_last=keep_last)
+        if chaos_plan is not None:
+            from ..resilience.chaos import apply_checkpoint_fault
+
+            apply_checkpoint_fault(
+                chaos_plan, checkpoint_dir, epoch, rank=rank,
+                incarnation=incarnation, telemetry=telemetry,
+            )
 
     wd = (
         # grace on the first step: it includes XLA compilation, which may
@@ -443,9 +507,7 @@ def resilient_train_loop(
     state, logger = train_loop(
         step, state, batches_for_epoch, epochs, rank=rank, log_every=log_every,
         start_epoch=start_epoch, watchdog=wd, heartbeat=heartbeat,
-        on_epoch_end=lambda epoch, st: save_checkpoint(
-            checkpoint_dir, st, step=epoch
-        ),
+        on_epoch_end=_save, batch_sharding=batch_sharding,
         telemetry=telemetry, trace_dir=trace_dir, audit=audit, run_name=run_name,
     )
     return state, logger, start_epoch

@@ -48,16 +48,26 @@ def run(
     max_steps_per_epoch: Optional[int] = None,
     eval_after: bool = False,
     strategy: str = "ddp",
+    checkpoint_dir: Optional[str] = None,
+    keep_last: Optional[int] = None,
 ) -> Dict:
     """``strategy="ddp"`` is the reference's replicated-parameter exact DDP;
     ``strategy="fsdp"`` runs the SAME workload with params/grads/optimizer
     state ZeRO-3-sharded over the data axis (``parallel.fsdp`` — per-device
     model+optimizer memory drops by ~1/world; the training math is still
-    exact data-parallel SGD)."""
+    exact data-parallel SGD).
+
+    ``checkpoint_dir`` switches to :func:`common.resilient_train_loop`:
+    per-epoch committed checkpoints, resume-on-entry, and (with
+    ``config.chaos_plan``) deterministic fault injection healed by the
+    recovery guards."""
     config = config or ExperimentConfig(
         training_epochs=1, global_batch_size=256, learning_rate=0.001
     )
     mesh = mesh or make_mesh()
+    resilient = checkpoint_dir is not None
+    if config.chaos_plan and not resilient:
+        raise ValueError("config.chaos_plan requires checkpoint_dir")
 
     images, labels, is_real = load_cifar10_or_synthetic(data_dir, train=True)
     model = build_model(preset, dtype=jnp.dtype(config.compute_dtype))
@@ -80,6 +90,11 @@ def run(
             raise ValueError("accum_steps is not supported with strategy='fsdp'")
         if config.max_grad_norm is not None:
             raise ValueError("max_grad_norm is not supported with strategy='fsdp'")
+        if resilient:
+            raise ValueError(
+                "checkpoint_dir requires strategy='ddp' (the FSDP carry"
+                " restores via restore_checkpoint_sharded, not this loop)"
+            )
         step = make_fsdp_train_step(
             loss_fn,
             params,
@@ -99,6 +114,9 @@ def run(
             mesh=mesh,
             accum_steps=config.accum_steps,
             max_grad_norm=config.max_grad_norm,
+            # the retry guard re-runs a failed step on its inputs, which a
+            # donated buffer cannot survive
+            donate_state=not resilient,
         )
     state = step.init_state(params, model_state=model_state)
 
@@ -109,15 +127,36 @@ def run(
 
     telemetry = telemetry_from_config(config)
     try:
-        state, logger = train_loop(
-            step, state, batches, config.training_epochs,
-            rank=config.process_id, log_every=config.log_every,
-            batch_sharding=accum_batch_sharding(mesh, config.accum_steps),
-            telemetry=telemetry,
-            trace_dir=config.trace_dir,
-            audit=audit_from_config(config),
-            run_name="exact_cifar10",
-        )
+        if resilient:
+            from ..resilience import ChaosPlan, incarnation_from_env
+            from .common import resilient_train_loop
+
+            plan = (
+                ChaosPlan.load(config.chaos_plan)
+                if config.chaos_plan else None
+            )
+            state, logger, _ = resilient_train_loop(
+                step, state, batches, config.training_epochs,
+                checkpoint_dir=checkpoint_dir,
+                rank=config.process_id, log_every=config.log_every,
+                telemetry=telemetry, trace_dir=config.trace_dir,
+                audit=audit_from_config(config), run_name="exact_cifar10",
+                chaos_plan=plan, incarnation=incarnation_from_env(),
+                step_retries=2 if plan is not None else 0,
+                guard_batches=plan is not None,
+                keep_last=keep_last,
+                batch_sharding=accum_batch_sharding(mesh, config.accum_steps),
+            )
+        else:
+            state, logger = train_loop(
+                step, state, batches, config.training_epochs,
+                rank=config.process_id, log_every=config.log_every,
+                batch_sharding=accum_batch_sharding(mesh, config.accum_steps),
+                telemetry=telemetry,
+                trace_dir=config.trace_dir,
+                audit=audit_from_config(config),
+                run_name="exact_cifar10",
+            )
     finally:
         telemetry.close()
     extra = {

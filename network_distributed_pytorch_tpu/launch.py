@@ -155,7 +155,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--checkpoint-dir", type=str, default=None,
-        help="gpt_pp/gpt_sp: save the carry per epoch and resume the newest",
+        help="gpt_pp/gpt_sp: save the carry per epoch and resume the newest;"
+             " exact_cifar10 (ddp): run through resilient_train_loop —"
+             " committed per-epoch checkpoints, verified resume, and the"
+             " --chaos-plan injection point",
     )
     p.add_argument(
         "--max-new-tokens", type=int, default=64,
@@ -166,6 +169,53 @@ def build_parser() -> argparse.ArgumentParser:
         help="gpt_generate only: 0 = greedy",
     )
     p.add_argument("--json", action="store_true", help="print the summary as JSON")
+    p.add_argument(
+        "--chaos-plan", type=str, default=None,
+        help="JSON fault schedule (resilience.chaos.ChaosPlan) injected into"
+             " experiments that run through resilient_train_loop; forwarded"
+             " to workers under --supervise",
+    )
+    # --- supervised elastic launch (resilience.supervisor) ---------------
+    # these flags configure the PARENT only and are stripped from the
+    # worker command lines (_SUPERVISOR_FLAGS below)
+    p.add_argument(
+        "--supervise", action="store_true",
+        help="run as the supervising parent: spawn --num-processes copies of"
+             " this command (one per rank), restart crashed/hung ranks with"
+             " bounded backoff, degrade to a shrunk world when a rank is"
+             " permanently gone",
+    )
+    p.add_argument(
+        "--max-restarts", type=int, default=3,
+        help="supervise: restarts per rank before it is declared dead",
+    )
+    p.add_argument(
+        "--restart-backoff", type=float, default=0.25,
+        help="supervise: base seconds of the bounded exponential backoff",
+    )
+    p.add_argument(
+        "--heartbeat-dir", type=str, default=None,
+        help="supervise: shared heartbeat directory for hang detection"
+             " (workers must beat it, e.g. via resilient_train_loop)",
+    )
+    p.add_argument(
+        "--heartbeat-timeout", type=float, default=None,
+        help="supervise: seconds without a beat before a rank is killed"
+             " and restarted",
+    )
+    p.add_argument(
+        "--min-world-size", type=int, default=1,
+        help="supervise: smallest world a degraded restart may shrink to",
+    )
+    p.add_argument(
+        "--no-degraded", action="store_true",
+        help="supervise: declare the run dead instead of shrinking the"
+             " world when a rank exhausts its restarts",
+    )
+    p.add_argument(
+        "--worker-log-dir", type=str, default=None,
+        help="supervise: per-rank-per-incarnation worker stdout logs",
+    )
     p.add_argument(
         "--event-log", type=str, default=None,
         help="append structured JSONL telemetry (steps, wire ledger, compile"
@@ -209,11 +259,93 @@ def config_from_args(args) -> ExperimentConfig:
     cfg.event_log = args.event_log
     cfg.trace_dir = args.trace_dir
     cfg.audit_wire = args.audit_wire
+    cfg.chaos_plan = args.chaos_plan
     return cfg
+
+
+# supervisor-parent-only flags, stripped from worker command lines
+# (value-taking unless marked boolean)
+_SUPERVISOR_FLAGS = {
+    "--supervise": False,
+    "--max-restarts": True,
+    "--restart-backoff": True,
+    "--heartbeat-timeout": True,
+    "--min-world-size": True,
+    "--no-degraded": False,
+    "--worker-log-dir": True,
+    # re-appended per worker with the supervisor's own numbering
+    "--process-id": True,
+    "--num-processes": True,
+}
+
+
+def worker_argv_base(argv) -> list:
+    """The launch argv with supervisor-only flags (and any explicit rank/
+    world-size) removed — what every worker command line starts from."""
+    out, skip = [], False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        flag = a.split("=", 1)[0]
+        if flag in _SUPERVISOR_FLAGS:
+            skip = _SUPERVISOR_FLAGS[flag] and "=" not in a
+            continue
+        out.append(a)
+    return out
+
+
+def _supervise(args, argv) -> dict:
+    """Run as the supervising parent: every worker is this same CLI with
+    ``--process-id``/``--num-processes`` rewritten per (rank, world)."""
+    from .observe import telemetry_for_run
+    from .resilience.supervisor import Supervisor, SupervisorConfig
+
+    base = worker_argv_base(argv)
+
+    def argv_for_rank(rank: int, world: int, incarnation: int) -> list:
+        return [
+            sys.executable, "-m", "network_distributed_pytorch_tpu.launch",
+            *base, "--process-id", str(rank), "--num-processes", str(world),
+        ]
+
+    telemetry = telemetry_for_run(event_log=args.event_log)
+    with telemetry:
+        result = Supervisor(
+            argv_for_rank,
+            world_size=args.num_processes,
+            config=SupervisorConfig(
+                max_restarts=args.max_restarts,
+                backoff_base_s=args.restart_backoff,
+                heartbeat_dir=args.heartbeat_dir,
+                heartbeat_timeout_s=args.heartbeat_timeout,
+                allow_degraded=not args.no_degraded,
+                min_world_size=args.min_world_size,
+                seed=args.seed,
+            ),
+            telemetry=telemetry,
+            log_dir=args.worker_log_dir,
+        ).run()
+    summary = {
+        "supervised": True,
+        "experiment": args.experiment,
+        "success": result.success,
+        "world_size": result.world_size,
+        "total_restarts": result.total_restarts,
+        "degraded": result.degraded,
+        "reason": result.reason,
+    }
+    if args.json:
+        Telemetry([StreamJsonSink(sys.stdout)]).emit(RawEvent(summary))
+    if not result.success:
+        raise SystemExit(3)
+    return summary
 
 
 def main(argv=None) -> dict:
     args = build_parser().parse_args(argv)
+    if args.supervise:
+        return _supervise(args, argv if argv is not None else sys.argv[1:])
     cfg = config_from_args(args)
 
     # reject silently-ignored flags BEFORE any rendezvous: a pure-CLI error
@@ -267,7 +399,8 @@ def main(argv=None) -> dict:
         kwargs.update(preset=args.preset, data_dir=args.data_dir,
                       max_steps_per_epoch=args.max_steps_per_epoch)
         if args.experiment == "exact_cifar10":
-            kwargs.update(strategy=args.strategy)
+            kwargs.update(strategy=args.strategy,
+                          checkpoint_dir=args.checkpoint_dir)
     elif args.experiment in ("powersgd_imdb", "imdb_baseline"):
         kwargs.update(preset=args.preset,
                       data_dir=None if args.data_dir == "./data" else args.data_dir,

@@ -157,8 +157,16 @@ class CompileEvent(Event):
 
 @dataclass
 class FailureEvent(Event):
-    """A detected failure (watchdog timeout, audit error, stale peer).
-    The banner is the record itself as JSON — impossible to miss AND
+    """A failure-domain lifecycle event: a detected failure (watchdog
+    timeout, audit error, stale peer, non-finite loss), an injected chaos
+    fault, or a recovery action (retry, checkpoint fallback, supervisor
+    restart, resume). ``scripts/report.py`` orders these by timestamp into
+    the run's failure timeline, so every kind shares one event type.
+
+    ``rank``/``step``/``incarnation`` locate the event in the failure
+    domain (None = not applicable): which worker, at which step of its
+    life, in which supervisor-restart generation of that worker. The
+    banner is the record itself as JSON — impossible to miss AND
     machine-parseable, like the watchdog's original structured report."""
 
     KIND: ClassVar[str] = "failure"
@@ -166,9 +174,13 @@ class FailureEvent(Event):
     kind: str
     label: str = ""
     message: str = ""
+    rank: Optional[int] = None
+    step: Optional[int] = None
+    incarnation: Optional[int] = None
 
     def banner(self) -> str:
-        return json.dumps(self.record(), default=str)
+        rec = {k: v for k, v in self.record().items() if v is not None}
+        return json.dumps(rec, default=str)
 
 
 @dataclass

@@ -1,0 +1,51 @@
+"""resilience — fault injection, recovery guards, and the supervising
+launcher.
+
+The reference's entire failure story is a rendezvous timeout that prints a
+banner and falls through (``ddp_guide_cifar10/ddp_init.py:98-99``, SURVEY
+§5: "no retry, no elasticity, no save/load anywhere") — on a 100-epoch run
+over slow links, the paper's own flagship regime, that means any preemption
+or peer death is a silent full restart. ``utils.failure`` and
+``utils.checkpoint`` provide the primitives (watchdog, heartbeat, retry,
+committed checkpoints); this package is the layer that exercises and
+operates them:
+
+- :mod:`resilience.chaos`      — deterministic, schedule-driven fault
+  injection (``ChaosPlan``): every failure path in the repo becomes
+  testable on CPU with no wall-clock randomness.
+- :mod:`resilience.guards`     — the recovery side: a step wrapper that
+  retries transient errors and rejects non-finite losses, and a batch
+  guard that drops malformed loader output.
+- :mod:`resilience.supervisor` — the restarting launcher: spawns per-rank
+  workers, watches exit codes and heartbeats, restarts crashed/hung ranks
+  with bounded backoff, resumes from the newest committed checkpoint, and
+  degrades to a shrunk world when a rank is permanently gone.
+
+``chaos`` and ``supervisor`` are jax-free at import time (the supervisor
+parent process never initializes a backend; workers do).
+"""
+
+from .chaos import (  # noqa: F401
+    CHECKPOINT_FAULTS,
+    FAULT_KINDS,
+    LOADER_FAULTS,
+    PROCESS_FAULTS,
+    STEP_FAULTS,
+    ChaosPlan,
+    ChaosStep,
+    ChaosTransientError,
+    FaultSpec,
+    apply_checkpoint_fault,
+    chaos_batches,
+)
+from .guards import (  # noqa: F401
+    GuardedStep,
+    NonFiniteLossError,
+    guarded_batches,
+)
+from .supervisor import (  # noqa: F401
+    Supervisor,
+    SupervisorConfig,
+    SupervisorResult,
+    incarnation_from_env,
+)

@@ -1,0 +1,327 @@
+"""Deterministic fault injection: the ``ChaosPlan``.
+
+A chaos plan is a seeded, schedule-driven list of faults — fault kind ×
+trigger step × target rank (× incarnation) — threaded into the layers that
+can actually fail. Because every trigger is a step INDEX rather than a
+wall-clock timer, an injected failure is exactly reproducible on CPU, which
+is what makes the chaos matrix a test suite rather than a demo.
+
+Fault kinds and where they bite:
+
+==================  =========================================================
+``loader_bad_batch``   the data loader yields a NaN-poisoned batch
+``loader_short_batch`` the loader yields a batch with a truncated leading dim
+``step_transient``     the step raises a transient ``RuntimeError`` at the
+                       reducer boundary (a preemption blip / tunnel hiccup)
+``step_nan``           the step reports a NaN loss (gradient burst) without
+                       advancing state
+``ckpt_torn``          the checkpoint just written loses its commit marker
+                       and part of its payload (crash mid-save)
+``ckpt_bitflip``       one byte of the committed payload is flipped (silent
+                       media corruption; checksums catch it at restore)
+``proc_exit``          the worker process exits non-zero at a step boundary
+``proc_kill``          the worker SIGKILLs itself (no cleanup, no atexit)
+``proc_hang``          the worker stops making progress (sleeps), so its
+                       heartbeat goes stale and the watchdog/supervisor fire
+==================  =========================================================
+
+Process- and step-level faults carry an ``incarnation`` filter (default 0)
+so a supervisor-restarted worker does not immediately re-crash on the same
+schedule — the restart is the point.
+
+jax-free at import time: the supervisor parent and the toy test workers
+load plans without dragging in a backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+import numpy as np
+
+LOADER_FAULTS = ("loader_bad_batch", "loader_short_batch")
+STEP_FAULTS = ("step_transient", "step_nan")
+CHECKPOINT_FAULTS = ("ckpt_torn", "ckpt_bitflip")
+PROCESS_FAULTS = ("proc_exit", "proc_kill", "proc_hang")
+FAULT_KINDS = LOADER_FAULTS + STEP_FAULTS + CHECKPOINT_FAULTS + PROCESS_FAULTS
+
+# exit code a chaos-injected clean crash uses — distinguishable from both
+# success (0) and a signal death (negative returncode) in supervisor logs
+CHAOS_EXIT_CODE = 43
+
+
+class ChaosTransientError(RuntimeError):
+    """The injected transient fault: a ``RuntimeError`` so the stock
+    ``retry_transient`` path treats it exactly like a real blip."""
+
+
+@dataclass
+class FaultSpec:
+    """One scheduled fault. ``step`` is the per-process step index at which
+    it triggers (for checkpoint faults: the epoch of the save); ``rank``
+    None matches any rank; ``incarnation`` None matches any restart
+    generation (default 0: fire only in a worker's first life). ``payload``
+    carries kind-specific knobs (``hang_seconds``, ``exit_code``)."""
+
+    kind: str
+    step: int
+    rank: Optional[int] = None
+    incarnation: Optional[int] = 0
+    payload: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r} (known: {FAULT_KINDS})"
+            )
+
+    def matches(self, step: int, rank: int, incarnation: int) -> bool:
+        return (
+            self.step == step
+            and (self.rank is None or self.rank == rank)
+            and (self.incarnation is None or self.incarnation == incarnation)
+        )
+
+
+class ChaosPlan:
+    """A seeded fault schedule with once-per-spec firing semantics."""
+
+    def __init__(self, faults: Iterable[FaultSpec] = (), seed: int = 0):
+        self.faults: List[FaultSpec] = list(faults)
+        self.seed = seed
+        self._fired: set = set()
+
+    # -- (de)serialization: the config/JSON surface -------------------------
+    def to_json(self) -> Dict:
+        return {
+            "seed": self.seed,
+            "faults": [dataclasses.asdict(f) for f in self.faults],
+        }
+
+    @classmethod
+    def from_json(cls, obj: Dict) -> "ChaosPlan":
+        return cls(
+            faults=[FaultSpec(**f) for f in obj.get("faults", ())],
+            seed=obj.get("seed", 0),
+        )
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=2)
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "ChaosPlan":
+        with open(path) as f:
+            return cls.from_json(json.load(f))
+
+    # -- trigger matching ---------------------------------------------------
+    def pop(
+        self,
+        kinds: Iterable[str],
+        step: int,
+        rank: int = 0,
+        incarnation: int = 0,
+    ) -> Optional[FaultSpec]:
+        """First unfired fault of one of ``kinds`` matching this (step,
+        rank, incarnation); marks it fired so it triggers exactly once."""
+        kinds = set(kinds)
+        for i, f in enumerate(self.faults):
+            if i in self._fired or f.kind not in kinds:
+                continue
+            if f.matches(step, rank, incarnation):
+                self._fired.add(i)
+                return f
+        return None
+
+
+def _emit_injected(telemetry, spec: FaultSpec, step: int, rank: int,
+                   incarnation: int, detail: str = "") -> None:
+    if telemetry is None:
+        return
+    from ..observe import FailureEvent
+
+    telemetry.emit(
+        FailureEvent(
+            kind="chaos_injected",
+            label=spec.kind,
+            message=detail,
+            rank=rank,
+            step=step,
+            incarnation=incarnation,
+        )
+    )
+
+
+class ChaosStep:
+    """Wraps a compiled step with the plan's step- and process-level
+    faults, checked at each step boundary BEFORE the real step runs.
+    Attribute access (``bits_per_step``, ``mesh``, ``init_state``)
+    delegates to the wrapped step so loops and audits see it unchanged."""
+
+    def __init__(
+        self,
+        step: Callable,
+        plan: ChaosPlan,
+        rank: int = 0,
+        incarnation: int = 0,
+        telemetry: Any = None,
+    ):
+        self._inner = step
+        self._plan = plan
+        self._rank = rank
+        self._incarnation = incarnation
+        self._telemetry = telemetry
+        self._step_index = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __call__(self, state, batch):
+        i = self._step_index
+        self._step_index += 1
+        spec = self._plan.pop(
+            STEP_FAULTS + PROCESS_FAULTS, i, self._rank, self._incarnation
+        )
+        if spec is not None:
+            _emit_injected(
+                self._telemetry, spec, i, self._rank, self._incarnation
+            )
+            if spec.kind == "proc_exit":
+                os._exit(int(spec.payload.get("exit_code", CHAOS_EXIT_CODE)))
+            if spec.kind == "proc_kill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            if spec.kind == "proc_hang":
+                # stops beating AND never returns within the deadline — the
+                # exact shape of a peer dead mid-collective
+                time.sleep(float(spec.payload.get("hang_seconds", 3600.0)))
+            if spec.kind == "step_transient":
+                raise ChaosTransientError(
+                    f"injected transient at step {i} (rank {self._rank})"
+                )
+            if spec.kind == "step_nan":
+                # a NaN gradient burst as the guard sees it: the reported
+                # loss is non-finite and the state must not advance
+                return state, float("nan")
+        return self._inner(state, batch)
+
+
+def chaos_batches(
+    batches_for_epoch: Callable[[int], Iterator[Any]],
+    plan: ChaosPlan,
+    rank: int = 0,
+    incarnation: int = 0,
+    telemetry: Any = None,
+) -> Callable[[int], Iterator[Any]]:
+    """Wrap a per-epoch batch generator factory with the plan's loader
+    faults. The trigger index counts batches ACROSS epochs within this
+    process, matching the step indexing of :class:`ChaosStep`."""
+    counter = {"i": 0}
+    rng = np.random.RandomState(plan.seed)
+
+    def poisoned(batch, spec: FaultSpec):
+        leaves = list(batch.values()) if isinstance(batch, dict) else list(batch)
+        if spec.kind == "loader_bad_batch":
+            bad = np.asarray(leaves[0]).copy()
+            flat = bad.reshape(-1)
+            # poison a seeded subset so detection can't rely on [0] alone
+            n = max(1, flat.size // 8)
+            idx = rng.choice(flat.size, size=n, replace=False)
+            if np.issubdtype(bad.dtype, np.floating):
+                flat[idx] = np.nan
+            else:  # integer labels: out-of-range garbage
+                flat[idx] = np.iinfo(bad.dtype).max
+            leaves[0] = bad
+        elif spec.kind == "loader_short_batch":
+            cut = max(1, np.asarray(leaves[0]).shape[0] // 2)
+            leaves = [np.asarray(a)[:cut] for a in leaves]
+        if isinstance(batch, dict):
+            return dict(zip(batch.keys(), leaves))
+        return tuple(leaves)
+
+    def gen(epoch: int):
+        for batch in batches_for_epoch(epoch):
+            i = counter["i"]
+            counter["i"] += 1
+            spec = plan.pop(LOADER_FAULTS, i, rank, incarnation)
+            if spec is not None:
+                _emit_injected(telemetry, spec, i, rank, incarnation)
+                batch = poisoned(batch, spec)
+            yield batch
+
+    return gen
+
+
+def apply_checkpoint_fault(
+    plan: ChaosPlan,
+    checkpoint_root: str,
+    epoch: int,
+    rank: int = 0,
+    incarnation: int = 0,
+    telemetry: Any = None,
+) -> Optional[str]:
+    """After a ``step_<epoch>`` checkpoint lands, apply any scheduled
+    checkpoint fault to it. ``ckpt_torn`` recreates the on-disk state of a
+    crash mid-save (commit marker gone, payload truncated); ``ckpt_bitflip``
+    flips one byte of the largest payload file while leaving the commit
+    marker intact — only the checksum manifest can catch it. Returns the
+    fault kind applied, if any."""
+    spec = plan.pop(CHECKPOINT_FAULTS, epoch, rank, incarnation)
+    if spec is None:
+        return None
+    path = os.path.join(os.path.abspath(checkpoint_root), f"step_{epoch}")
+    if spec.kind == "ckpt_torn":
+        tear_checkpoint(path)
+    else:
+        bitflip_checkpoint(path, seed=plan.seed)
+    _emit_injected(telemetry, spec, epoch, rank, incarnation, detail=path)
+    return spec.kind
+
+
+def _largest_payload_file(path: str) -> Optional[str]:
+    from ..utils.checkpoint import _payload_files  # jax-free helper
+
+    files = _payload_files(path)
+    if not files:
+        return None
+    return max(files, key=lambda rel: os.path.getsize(os.path.join(path, rel)))
+
+
+def tear_checkpoint(path: str) -> None:
+    """Turn a committed checkpoint into what a mid-save crash leaves: no
+    ``_COMMITTED`` marker, and a truncated payload file."""
+    from ..utils.checkpoint import COMMITTED_MARKER
+
+    marker = os.path.join(path, COMMITTED_MARKER)
+    if os.path.isfile(marker):
+        os.remove(marker)
+    victim = _largest_payload_file(path)
+    if victim is not None:
+        full = os.path.join(path, victim)
+        size = os.path.getsize(full)
+        with open(full, "r+b") as f:
+            f.truncate(size // 2)
+
+
+def bitflip_checkpoint(path: str, seed: int = 0) -> None:
+    """Flip one seeded byte of the largest payload file, leaving the commit
+    marker and manifest untouched (silent corruption)."""
+    victim = _largest_payload_file(path)
+    if victim is None:
+        return
+    full = os.path.join(path, victim)
+    size = os.path.getsize(full)
+    if size == 0:
+        return
+    offset = np.random.RandomState(seed).randint(0, size)
+    with open(full, "r+b") as f:
+        f.seek(offset)
+        byte = f.read(1)
+        f.seek(offset)
+        f.write(bytes([byte[0] ^ 0xFF]))

@@ -1,0 +1,134 @@
+"""Recovery guards: the detection/retry side of the failure paths the
+chaos plan injects.
+
+Two wrappers, both opt-in from ``resilient_train_loop``:
+
+- :class:`GuardedStep` — retries a step whose execution raised a transient
+  ``RuntimeError`` (preemption blip, tunnel hiccup, injected
+  ``ChaosTransientError``) and rejects a step whose loss came back
+  non-finite (NaN gradient burst) WITHOUT advancing state, re-running it
+  instead. Requires the wrapped step to have been built with
+  ``donate_state=False`` — a donated input buffer cannot be replayed.
+- :func:`guarded_batches` — drops loader output that would poison the run:
+  non-finite values or a leading dim that disagrees with the expected
+  global batch (a short batch would either recompile or silently skew the
+  global-batch accounting).
+
+Every recovery action is a ``FailureEvent`` through telemetry, so the run
+log shows fault → detection → recovery with timestamps.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Iterator, Optional
+
+
+class NonFiniteLossError(RuntimeError):
+    """A step reported a NaN/inf loss — treated as transient: the state
+    that produced it is discarded and the step re-run on its inputs."""
+
+
+class GuardedStep:
+    """Retry-on-transient + non-finite-loss rejection around a compiled
+    step. Attribute access delegates to the wrapped step."""
+
+    def __init__(
+        self,
+        step: Callable,
+        retries: int = 2,
+        backoff_seconds: float = 0.05,
+        max_backoff_seconds: float = 5.0,
+        jitter: float = 0.1,
+        telemetry: Any = None,
+        label: str = "step",
+    ):
+        self._inner = step
+        self.retries = retries
+        self.backoff_seconds = backoff_seconds
+        self.max_backoff_seconds = max_backoff_seconds
+        self.jitter = jitter
+        self._telemetry = telemetry
+        self._label = label
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __call__(self, state, batch):
+        import jax
+
+        # lazy: utils' package import pulls jax, which the supervisor
+        # parent (importing this module via resilience/__init__) must avoid
+        from ..utils.failure import retry_transient
+
+        def attempt():
+            new_state, loss = self._inner(state, batch)
+            # forces the step to completion; a non-finite loss means the
+            # update that produced it is poison — discard new_state and
+            # let retry re-run from the (non-donated) inputs
+            host_loss = float(jax.device_get(loss))
+            if not math.isfinite(host_loss):
+                raise NonFiniteLossError(
+                    f"{self._label}: non-finite loss {host_loss}"
+                )
+            return new_state, loss
+
+        return retry_transient(
+            attempt,
+            retries=self.retries,
+            backoff_seconds=self.backoff_seconds,
+            max_backoff_seconds=self.max_backoff_seconds,
+            jitter=self.jitter,
+            exceptions=(RuntimeError,),
+            telemetry=self._telemetry,
+            label=self._label,
+        )
+
+
+def guarded_batches(
+    batches_for_epoch: Callable[[int], Iterator[Any]],
+    expected_batch: Optional[int] = None,
+    telemetry: Any = None,
+    label: str = "loader",
+) -> Callable[[int], Iterator[Any]]:
+    """Wrap a per-epoch batch generator factory: malformed batches (wrong
+    leading dim, non-finite floats) are dropped with a
+    ``FailureEvent(kind="bad_batch_dropped")`` instead of reaching the
+    compiled step, where they would recompile (shape) or poison the
+    parameters (NaN)."""
+    import numpy as np
+
+    from ..observe import FailureEvent
+
+    def problem(batch) -> Optional[str]:
+        leaves = list(batch.values()) if isinstance(batch, dict) else list(batch)
+        lead = {np.asarray(a).shape[0] for a in leaves}
+        if len(lead) > 1:
+            return f"ragged leading dims {sorted(lead)}"
+        if expected_batch is not None and lead and lead != {expected_batch}:
+            return f"leading dim {lead.pop()} != expected {expected_batch}"
+        for a in leaves:
+            arr = np.asarray(a)
+            if np.issubdtype(arr.dtype, np.floating) and not np.all(
+                np.isfinite(arr)
+            ):
+                return "non-finite values"
+        return None
+
+    def gen(epoch: int):
+        for i, batch in enumerate(batches_for_epoch(epoch)):
+            reason = problem(batch)
+            if reason is not None:
+                if telemetry is not None:
+                    telemetry.emit(
+                        FailureEvent(
+                            kind="bad_batch_dropped",
+                            label=label,
+                            step=i,
+                            message=f"epoch {epoch}: {reason}",
+                        )
+                    )
+                continue
+            yield batch
+
+    return gen

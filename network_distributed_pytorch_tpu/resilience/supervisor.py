@@ -1,0 +1,300 @@
+"""The supervising launcher: spawn, watch, restart, degrade.
+
+The reference launches one unsupervised process per rank from four copied
+``run_script.py`` files; when a rank dies, the survivors hang in a
+collective until the rendezvous timeout prints a banner (SURVEY §5). This
+module is the missing parent: it spawns the per-rank worker processes,
+watches exit codes and the heartbeat directory, restarts crashed or hung
+ranks with bounded exponential backoff (restarted workers resume from the
+newest COMMITTED checkpoint — ``utils.checkpoint.restore_latest``), and
+when a rank exhausts ``max_restarts`` in a data-parallel run, restarts the
+survivors on a SHRUNK world (graceful degradation) instead of declaring
+the whole run dead.
+
+Degraded-mesh semantics (see DESIGN.md): ranks are renumbered 0..W'-1 and
+workers are relaunched with the new ``--num-processes``; each worker
+re-derives its mesh, data partition, wire ledger, and global-batch
+accounting from the world size it was launched with, so the accounting is
+recomputed — not patched — for the new world. Per-worker state that is
+keyed by world size (EF memories sharded over ranks) starts fresh;
+replicated state (params, momenta) resumes from the committed checkpoint.
+
+jax-free: the parent process never initializes a backend (heartbeat files
+are read directly rather than through ``utils.failure``, whose package
+import would drag jax in).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import subprocess
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+# environment contract with workers (read via :func:`incarnation_from_env`)
+ENV_INCARNATION = "RESILIENCE_INCARNATION"
+ENV_RANK = "RESILIENCE_RANK"
+ENV_WORLD = "RESILIENCE_WORLD"
+
+
+def incarnation_from_env(default: int = 0) -> int:
+    """Which life of this worker is running (0 = first launch; the
+    supervisor increments it on every restart)."""
+    try:
+        return int(os.environ.get(ENV_INCARNATION, default))
+    except ValueError:
+        return default
+
+
+@dataclass
+class SupervisorConfig:
+    max_restarts: int = 3  # per rank, per world generation
+    backoff_base_s: float = 0.25
+    backoff_max_s: float = 10.0
+    backoff_jitter: float = 0.1  # seeded — reproducible schedules
+    poll_interval_s: float = 0.1
+    heartbeat_dir: Optional[str] = None
+    heartbeat_timeout_s: Optional[float] = None  # None = no hang detection
+    startup_grace_s: float = 60.0  # first-beat allowance after (re)spawn
+    allow_degraded: bool = True
+    min_world_size: int = 1
+    deadline_s: Optional[float] = None  # whole-run wall clock cap
+    seed: int = 0
+
+
+@dataclass
+class SupervisorResult:
+    success: bool
+    world_size: int  # final (possibly shrunk) world
+    total_restarts: int
+    degraded: bool
+    exit_codes: Dict[int, int] = field(default_factory=dict)
+    reason: str = ""
+
+
+@dataclass
+class _Worker:
+    rank: int
+    proc: subprocess.Popen
+    incarnation: int
+    spawned_at: float
+    restarts: int = 0
+    done: bool = False
+
+
+class Supervisor:
+    """Run ``world_size`` workers to completion, restarting as needed.
+
+    ``argv_for_rank(rank, world_size, incarnation) -> List[str]`` builds a
+    worker's command line — world_size is passed on every call because a
+    degraded restart relaunches the survivors with a smaller world.
+    """
+
+    def __init__(
+        self,
+        argv_for_rank: Callable[[int, int, int], List[str]],
+        world_size: int,
+        config: Optional[SupervisorConfig] = None,
+        telemetry: Any = None,
+        env: Optional[Dict[str, str]] = None,
+        log_dir: Optional[str] = None,
+    ):
+        self.argv_for_rank = argv_for_rank
+        self.world_size = world_size
+        self.config = config or SupervisorConfig()
+        self.telemetry = telemetry
+        self.env = env
+        self.log_dir = log_dir
+        self.total_restarts = 0
+        self.degraded = False
+        self._incarnations: Dict[int, int] = {}  # next incarnation per rank
+        self._rng = random.Random(self.config.seed)
+
+    # -- telemetry ----------------------------------------------------------
+    def _emit(self, kind: str, rank: Optional[int] = None, message: str = "",
+              incarnation: Optional[int] = None) -> None:
+        if self.telemetry is None:
+            return
+        from ..observe import FailureEvent
+
+        self.telemetry.emit(
+            FailureEvent(
+                kind=kind, label="supervisor", message=message,
+                rank=rank, incarnation=incarnation,
+            )
+        )
+
+    # -- process management -------------------------------------------------
+    def _spawn(self, rank: int, world_size: int) -> _Worker:
+        incarnation = self._incarnations.get(rank, 0)
+        self._incarnations[rank] = incarnation + 1
+        argv = self.argv_for_rank(rank, world_size, incarnation)
+        env = dict(self.env if self.env is not None else os.environ)
+        env[ENV_INCARNATION] = str(incarnation)
+        env[ENV_RANK] = str(rank)
+        env[ENV_WORLD] = str(world_size)
+        stdout = stderr = None
+        if self.log_dir is not None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            log = open(
+                os.path.join(self.log_dir, f"rank{rank}.{incarnation}.log"), "w"
+            )
+            stdout, stderr = log, subprocess.STDOUT
+        proc = subprocess.Popen(argv, env=env, stdout=stdout, stderr=stderr)
+        return _Worker(
+            rank=rank, proc=proc, incarnation=incarnation,
+            spawned_at=time.monotonic(),
+        )
+
+    def _backoff(self, restarts: int) -> float:
+        delay = min(
+            self.config.backoff_base_s * (2 ** max(0, restarts - 1)),
+            self.config.backoff_max_s,
+        )
+        return delay * (1.0 + self.config.backoff_jitter * self._rng.random())
+
+    def _read_beat(self, rank: int) -> Optional[Dict]:
+        # HeartbeatMonitor's file layout, read without importing jax
+        path = os.path.join(
+            self.config.heartbeat_dir, f"heartbeat_{rank}.json"
+        )
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _is_hung(self, w: _Worker) -> bool:
+        cfg = self.config
+        if cfg.heartbeat_dir is None or cfg.heartbeat_timeout_s is None:
+            return False
+        age = time.monotonic() - w.spawned_at
+        beat = self._read_beat(w.rank)
+        # a beat from a PREVIOUS incarnation is the dead predecessor's file,
+        # not evidence of life — this is what the incarnation field is for
+        if beat is None or beat.get("incarnation", 0) != w.incarnation:
+            return age > cfg.startup_grace_s + cfg.heartbeat_timeout_s
+        return time.time() - beat.get("ts", 0.0) > cfg.heartbeat_timeout_s
+
+    @staticmethod
+    def _kill(w: _Worker) -> None:
+        try:
+            w.proc.kill()
+            w.proc.wait(timeout=10)
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+
+    # -- the run loop -------------------------------------------------------
+    def run(self) -> SupervisorResult:
+        cfg = self.config
+        world = self.world_size
+        started = time.monotonic()
+        workers = {r: self._spawn(r, world) for r in range(world)}
+        exit_codes: Dict[int, int] = {}
+
+        def fail(reason: str) -> SupervisorResult:
+            for w in workers.values():
+                if not w.done:
+                    self._kill(w)
+            self._emit("run_failed", message=reason)
+            return SupervisorResult(
+                success=False, world_size=world,
+                total_restarts=self.total_restarts, degraded=self.degraded,
+                exit_codes=exit_codes, reason=reason,
+            )
+
+        def degrade(dead_rank: int) -> bool:
+            new_world = world - 1
+            if not cfg.allow_degraded or new_world < cfg.min_world_size:
+                return False
+            self._emit(
+                "degraded_restart", rank=dead_rank,
+                message=f"world {world} -> {new_world}",
+            )
+            for w in workers.values():
+                if not w.done:
+                    self._kill(w)
+            return True
+
+        while True:
+            if (
+                cfg.deadline_s is not None
+                and time.monotonic() - started > cfg.deadline_s
+            ):
+                return fail(f"deadline {cfg.deadline_s}s exceeded")
+
+            restart_queue: List[int] = []
+            dead_rank: Optional[int] = None
+            for rank, w in workers.items():
+                if w.done:
+                    continue
+                rc = w.proc.poll()
+                if rc == 0:
+                    w.done = True
+                    exit_codes[rank] = 0
+                    self._emit(
+                        "worker_complete", rank=rank, incarnation=w.incarnation
+                    )
+                    continue
+                if rc is None:
+                    if self._is_hung(w):
+                        self._emit(
+                            "worker_hang", rank=rank, incarnation=w.incarnation,
+                            message="heartbeat stale; killing",
+                        )
+                        self._kill(w)
+                        rc = w.proc.returncode
+                    else:
+                        continue
+                # crashed (or just killed for hanging)
+                exit_codes[rank] = rc if rc is not None else -1
+                self._emit(
+                    "worker_exit", rank=rank, incarnation=w.incarnation,
+                    message=f"exit code {rc}",
+                )
+                if w.restarts >= cfg.max_restarts:
+                    dead_rank = rank
+                    break
+                restart_queue.append(rank)
+
+            if dead_rank is not None:
+                if not degrade(dead_rank):
+                    return fail(
+                        f"rank {dead_rank} exceeded max_restarts="
+                        f"{cfg.max_restarts}"
+                    )
+                # shrunk world: renumber 0..W'-1, fresh restart budgets —
+                # workers recompute mesh/partition/ledger from the new size
+                self.degraded = True
+                world -= 1
+                exit_codes = {}
+                workers = {r: self._spawn(r, world) for r in range(world)}
+                continue
+
+            for rank in restart_queue:
+                w = workers[rank]
+                restarts = w.restarts + 1
+                self.total_restarts += 1
+                delay = self._backoff(restarts)
+                self._emit(
+                    "worker_restart", rank=rank,
+                    incarnation=self._incarnations.get(rank, 0),
+                    message=f"restart {restarts}/{cfg.max_restarts}"
+                            f" after {delay:.2f}s backoff",
+                )
+                time.sleep(delay)
+                workers[rank] = self._spawn(rank, world)
+                workers[rank].restarts = restarts
+
+            if all(w.done for w in workers.values()):
+                self._emit("run_complete", message=f"world_size={world}")
+                return SupervisorResult(
+                    success=True, world_size=world,
+                    total_restarts=self.total_restarts,
+                    degraded=self.degraded, exit_codes=exit_codes,
+                )
+            time.sleep(cfg.poll_interval_s)

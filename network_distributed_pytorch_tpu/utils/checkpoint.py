@@ -1,4 +1,4 @@
-"""Checkpoint / resume.
+"""Checkpoint / resume, with an atomic commit protocol.
 
 The reference has NO checkpointing (SURVEY §5: "no save/load anywhere" —
 every run restarts from torchvision/HF pretrained weights). For a framework
@@ -10,26 +10,146 @@ multi-host aware, sharding-preserving).
 The FULL ``TrainState`` is saved — params, momenta, **per-worker error
 memories**, and the PowerSGD warm-start Q buffer — so a resumed run continues
 the error-feedback chain bit-for-bit, not just the weights.
+
+Commit protocol (what makes a crash mid-save survivable):
+
+1. orbax writes the state into a sibling ``_tmp.<name>.<pid>`` directory;
+2. a ``_CHECKSUMS.json`` manifest (sha256 of every file) is written inside;
+3. a ``_COMMITTED`` marker lands LAST;
+4. one atomic ``os.replace`` renames the tmp dir to its final ``step_N`` name.
+
+A crash at any point leaves either no ``step_N`` at all (steps 1-3: only an
+ignorable tmp dir) or a fully-committed checkpoint (after 4). Readers only
+trust directories carrying the marker: :func:`latest_step_path` skips
+uncommitted ones, and :func:`restore_latest` additionally verifies the
+manifest at restore time, falling back to the previous committed step (with
+a ``FailureEvent`` through telemetry) instead of resuming from a torn or
+bit-flipped directory.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Optional
+import shutil
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
 
+COMMITTED_MARKER = "_COMMITTED"
+CHECKSUM_MANIFEST = "_CHECKSUMS.json"
+_TMP_PREFIX = "_tmp."
+# files our own protocol adds on top of what orbax wrote — excluded from the
+# manifest so the hash set covers exactly the checkpoint payload
+_PROTOCOL_FILES = {COMMITTED_MARKER, CHECKSUM_MANIFEST}
 
-def save_checkpoint(path: str, state: Any, step: Optional[int] = None) -> str:
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _payload_files(root: str) -> List[str]:
+    """Every regular file under ``root`` (relative paths), protocol files
+    excluded."""
+    out = []
+    for dirpath, _dirs, files in os.walk(root):
+        for name in files:
+            rel = os.path.relpath(os.path.join(dirpath, name), root)
+            if rel in _PROTOCOL_FILES:
+                continue
+            out.append(rel)
+    return sorted(out)
+
+
+def write_manifest(path: str) -> Dict[str, str]:
+    """Hash every payload file under ``path`` into ``_CHECKSUMS.json``."""
+    sums = {rel: _sha256_file(os.path.join(path, rel)) for rel in _payload_files(path)}
+    with open(os.path.join(path, CHECKSUM_MANIFEST), "w") as f:
+        json.dump(sums, f)
+    return sums
+
+
+def is_committed(path: str) -> bool:
+    return os.path.isfile(os.path.join(path, COMMITTED_MARKER))
+
+
+def verify_checkpoint(path: str) -> Tuple[bool, str]:
+    """Integrity check: committed marker present, manifest present, every
+    manifest entry exists with a matching sha256, no payload file missing
+    from the manifest. Returns ``(ok, reason)``."""
+    if not os.path.isdir(path):
+        return False, "missing directory"
+    if not is_committed(path):
+        return False, "uncommitted (no _COMMITTED marker)"
+    manifest_path = os.path.join(path, CHECKSUM_MANIFEST)
+    if not os.path.isfile(manifest_path):
+        return False, "no checksum manifest"
+    try:
+        with open(manifest_path) as f:
+            sums = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, f"unreadable manifest: {e}"
+    for rel, want in sums.items():
+        full = os.path.join(path, rel)
+        if not os.path.isfile(full):
+            return False, f"missing file {rel}"
+        if _sha256_file(full) != want:
+            return False, f"checksum mismatch at {rel}"
+    extra = set(_payload_files(path)) - set(sums)
+    if extra:
+        return False, f"unmanifested files: {sorted(extra)[:3]}"
+    return True, "ok"
+
+
+def _commit(tmp: str, final: str, step: Optional[int]) -> None:
+    write_manifest(tmp)
+    with open(os.path.join(tmp, COMMITTED_MARKER), "w") as f:
+        json.dump({"step": step, "ts": time.time()}, f)
+    if os.path.isdir(final):  # re-save of the same step: replace wholesale
+        shutil.rmtree(final)
+    os.replace(tmp, final)
+
+
+def save_checkpoint(
+    path: str,
+    state: Any,
+    step: Optional[int] = None,
+    keep_last: Optional[int] = None,
+    _abort_before_commit: bool = False,
+) -> str:
     """Save a state pytree — a ``TrainState`` or any experiment carry —
-    (blocking). Returns the final checkpoint path."""
-    path = os.path.abspath(path)
-    if step is not None:
-        path = os.path.join(path, f"step_{step}")
+    (blocking), via the atomic commit protocol above. Returns the final
+    checkpoint path. ``keep_last`` garbage-collects all but the newest K
+    committed steps after the save lands.
+
+    ``_abort_before_commit`` is the fault-injection seam: it returns after
+    the data write but BEFORE the manifest/marker/rename, leaving exactly
+    the torn tmp directory a mid-save crash would — the chaos suite uses it
+    to prove readers never resume from one.
+    """
+    root = os.path.abspath(path)
+    final = os.path.join(root, f"step_{step}") if step is not None else root
+    parent, name = os.path.dirname(final), os.path.basename(final)
+    tmp = os.path.join(parent, f"{_TMP_PREFIX}{name}.{os.getpid()}")
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(parent, exist_ok=True)
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, jax.device_get(state))
-    return path
+        ckptr.save(tmp, jax.device_get(state))
+        # context exit waits for the async write — data is on disk here
+    if _abort_before_commit:
+        return tmp
+    _commit(tmp, final, step)
+    if keep_last is not None and step is not None:
+        gc_checkpoints(root, keep_last)
+    return final
 
 
 def restore_checkpoint(path: str, template: Any) -> Any:
@@ -83,15 +203,78 @@ def restore_checkpoint_sharded(path: str, template: Any) -> Any:
     return _rebuild_carry(template, restored)
 
 
-def latest_step_path(root: str) -> Optional[str]:
-    """Newest ``step_N`` checkpoint under ``root``, or None."""
+def committed_step_paths(root: str) -> List[Tuple[int, str]]:
+    """Committed ``step_N`` checkpoints under ``root``, newest first.
+    Uncommitted (torn) directories and in-flight tmp dirs are skipped."""
     root = os.path.abspath(root)
     if not os.path.isdir(root):
-        return None
+        return []
     steps = []
     for name in os.listdir(root):
         if name.startswith("step_") and name[5:].isdigit():
-            steps.append(int(name[5:]))
-    if not steps:
-        return None
-    return os.path.join(root, f"step_{max(steps)}")
+            full = os.path.join(root, name)
+            if is_committed(full):
+                steps.append((int(name[5:]), full))
+    return sorted(steps, reverse=True)
+
+
+def latest_step_path(root: str) -> Optional[str]:
+    """Newest COMMITTED ``step_N`` checkpoint under ``root``, or None. A
+    directory truncated mid-save carries no ``_COMMITTED`` marker and is
+    never selected."""
+    committed = committed_step_paths(root)
+    return committed[0][1] if committed else None
+
+
+def restore_latest(
+    root: str,
+    template: Any,
+    telemetry: Any = None,
+    label: str = "",
+    sharded: bool = False,
+) -> Optional[Tuple[Any, int]]:
+    """Restore the newest checkpoint that passes integrity verification,
+    walking backwards through older committed steps when the newest is
+    corrupt (bit-flip, torn payload) or unrestorable. Every skip emits a
+    ``FailureEvent(kind="checkpoint_fallback")`` through ``telemetry``.
+    Returns ``(state, step)`` or None when nothing restorable exists."""
+    from ..observe import FailureEvent
+
+    restore = restore_checkpoint_sharded if sharded else restore_checkpoint
+    for step, path in committed_step_paths(root):
+        ok, reason = verify_checkpoint(path)
+        if ok:
+            try:
+                return restore(path, template), step
+            except Exception as e:  # torn payload orbax can't parse
+                reason = f"restore failed: {type(e).__name__}: {e}"
+        if telemetry is not None:
+            telemetry.emit(
+                FailureEvent(
+                    kind="checkpoint_fallback",
+                    label=label,
+                    step=step,
+                    message=f"skipping {os.path.basename(path)}: {reason}",
+                )
+            )
+    return None
+
+
+def gc_checkpoints(root: str, keep_last: int) -> List[str]:
+    """Retention: delete all but the newest ``keep_last`` committed steps,
+    plus any abandoned ``_tmp.*`` write directories not owned by this
+    process. Returns the deleted paths."""
+    if keep_last < 1:
+        raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+    root = os.path.abspath(root)
+    deleted = []
+    for _step, path in committed_step_paths(root)[keep_last:]:
+        shutil.rmtree(path, ignore_errors=True)
+        deleted.append(path)
+    if os.path.isdir(root):
+        own_suffix = f".{os.getpid()}"
+        for name in os.listdir(root):
+            if name.startswith(_TMP_PREFIX) and not name.endswith(own_suffix):
+                shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+                deleted.append(os.path.join(root, name))
+    return deleted

@@ -49,3 +49,8 @@ class ExperimentConfig:
     event_log: Optional[str] = None
     trace_dir: Optional[str] = None
     audit_wire: Optional[bool] = None
+
+    # resilience (resilience/): path to a JSON fault schedule
+    # (resilience.chaos.ChaosPlan) for experiments running through
+    # resilient_train_loop — deterministic fault injection for chaos drills
+    chaos_plan: Optional[str] = None

@@ -20,6 +20,11 @@ cd "$(dirname "$0")"
 python scripts/lint_no_print.py
 
 mkdir -p artifacts
+# tests/ includes the resilience chaos suite (tests/test_chaos.py,
+# tests/test_supervisor.py): the fault-primitive and supervisor-mechanics
+# tests run in the fast tier (-m "not slow" compatible); the full chaos
+# matrix on a real training loop and the SIGKILL-and-resume determinism
+# test are @slow like the other end-to-end drives.
 exec env -u PALLAS_AXON_POOL_IPS \
     JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \

@@ -58,6 +58,81 @@ def _fmt_bytes(n: float) -> str:
     return f"{n:.0f} B"
 
 
+# failure-event kinds by role in the fault lifecycle (resilience/):
+# an injection starts a timeline span; the next detection and the next
+# recovery on the same rank close it with measurable latencies
+_DETECTION_KINDS = {
+    "worker_exit", "worker_hang", "watchdog_timeout", "bad_batch_dropped",
+    "audit_error", "stale_peer",
+}
+_RECOVERY_KINDS = {
+    "retry", "checkpoint_fallback", "worker_restart", "resumed",
+    "degraded_restart", "worker_complete", "run_complete",
+}
+
+
+def _same_rank(a: Dict, b: Dict) -> bool:
+    ra, rb = a.get("rank"), b.get("rank")
+    return ra is None or rb is None or ra == rb
+
+
+def render_failure_timeline(failures: List[Dict]) -> List[str]:
+    """The failures section: every failure-domain event (injected faults,
+    detections, recoveries) ordered by timestamp with relative times, plus
+    the injected → detected → recovered latencies per fault."""
+    timed = [f for f in failures if isinstance(f.get("ts"), (int, float))]
+    untimed = [f for f in failures if f not in timed]
+    timed.sort(key=lambda f: f["ts"])
+    ordered = timed + untimed
+    t0 = timed[0]["ts"] if timed else None
+
+    lines = ["", "failures — timeline", "-------------------"]
+    for f in ordered:
+        when = (
+            f"t+{f['ts'] - t0:8.3f}s" if isinstance(f.get("ts"), (int, float))
+            else " " * 10 + "-"
+        )
+        who = f"rank {f['rank']}" if f.get("rank") is not None else "-"
+        inc = (
+            f" inc {f['incarnation']}"
+            if f.get("incarnation") not in (None, 0)
+            else ""
+        )
+        at = f" @step {f['step']}" if f.get("step") is not None else ""
+        detail = f.get("label", "") or ""
+        msg = f.get("message", "") or ""
+        tail = " ".join(x for x in (detail, msg) if x)
+        lines.append(
+            f"  {when}  {f.get('kind', '?'):<20} [{who}{inc}]{at}  {tail}"
+        )
+
+    # latency spans: injected -> first detection -> first recovery (same rank)
+    for i, f in enumerate(timed):
+        if f.get("kind") != "chaos_injected":
+            continue
+        detected = recovered = None
+        for g in timed[i + 1:]:
+            if not _same_rank(f, g):
+                continue
+            if detected is None and g.get("kind") in _DETECTION_KINDS:
+                detected = g
+            if g.get("kind") in _RECOVERY_KINDS:
+                recovered = g
+                break
+        span = []
+        if detected is not None:
+            span.append(f"detected +{detected['ts'] - f['ts']:.3f}s")
+        if recovered is not None:
+            span.append(
+                f"{recovered.get('kind')} +{recovered['ts'] - f['ts']:.3f}s"
+            )
+        if span:
+            lines.append(
+                f"    -> {f.get('label', '?')}: {', '.join(span)}"
+            )
+    return lines
+
+
 def render_report(events: List[Dict], name: str = "") -> str:
     by_kind: Dict[str, List[Dict]] = {}
     for e in events:
@@ -173,11 +248,7 @@ def render_report(events: List[Dict], name: str = "") -> str:
 
     failures = by_kind.get("failure", [])
     if failures:
-        lines.append("")
-        lines.append("failures")
-        lines.append("--------")
-        for f_ in failures:
-            lines.append(f"  {json.dumps(f_, default=str)}")
+        lines.extend(render_failure_timeline(failures))
 
     notes = by_kind.get("note", [])
     if notes:

@@ -1,0 +1,453 @@
+"""Chaos matrix + checkpoint hardening.
+
+The fault-injection half of the resilience story: every recoverable fault
+kind in ``resilience.chaos`` is injected into a real (small) training run
+and the run must complete with the documented recovery — and, for the
+state-preserving faults, land on EXACTLY the parameters of a clean run.
+The checkpoint tests prove the commit protocol: a torn directory is never
+selected, a bit-flip is caught by checksums at restore, and ``restore_latest``
+falls back to the previous good step with a telemetry trail.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from network_distributed_pytorch_tpu.experiments.common import (
+    resilient_train_loop,
+)
+from network_distributed_pytorch_tpu.models import SmallCNN
+from network_distributed_pytorch_tpu.observe import MemorySink, Telemetry
+from network_distributed_pytorch_tpu.parallel import PowerSGDReducer, make_mesh
+from network_distributed_pytorch_tpu.parallel.trainer import (
+    make_train_step,
+    stateless_loss,
+)
+from network_distributed_pytorch_tpu.resilience import (
+    ChaosPlan,
+    ChaosStep,
+    ChaosTransientError,
+    FaultSpec,
+    GuardedStep,
+    NonFiniteLossError,
+    chaos_batches,
+    guarded_batches,
+)
+from network_distributed_pytorch_tpu.resilience.chaos import (
+    bitflip_checkpoint,
+    tear_checkpoint,
+)
+from network_distributed_pytorch_tpu.utils import cross_entropy_loss
+from network_distributed_pytorch_tpu.utils.checkpoint import (
+    COMMITTED_MARKER,
+    CHECKSUM_MANIFEST,
+    committed_step_paths,
+    gc_checkpoints,
+    is_committed,
+    latest_step_path,
+    restore_latest,
+    save_checkpoint,
+    verify_checkpoint,
+)
+
+IMG = (8, 8, 3)
+EPOCHS = 2
+BATCH = 32
+
+
+def _setup():
+    model = SmallCNN(width=4)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, *IMG)))["params"]
+
+    def lf(p, b):
+        x, y = b
+        return cross_entropy_loss(model.apply({"params": p}, x), y)
+
+    mesh = make_mesh()
+    step = make_train_step(
+        stateless_loss(lf),
+        PowerSGDReducer(random_seed=7, compression_rank=2, matricize="last"),
+        params, learning_rate=0.05, momentum=0.9, algorithm="ef_momentum",
+        mesh=mesh, donate_state=False,
+    )
+    return step, params
+
+
+def _batches(epoch, steps=3):
+    rng = np.random.RandomState(1000 + epoch)
+    means = np.random.RandomState(999).randn(10, *IMG)
+    for _ in range(steps):
+        y = rng.randint(0, 10, BATCH)
+        x = means[y] + 0.5 * rng.randn(BATCH, *IMG)
+        yield jnp.asarray(x, jnp.float32), jnp.asarray(y)
+
+
+def _telemetry():
+    sink = MemorySink()
+    return Telemetry([sink]), sink
+
+
+def _kinds(sink):
+    return [r.get("kind") for r in sink.records if r.get("event") == "failure"]
+
+
+def _run(tmp_path, name, plan=None, **kw):
+    step, params = _setup()
+    telemetry, sink = _telemetry()
+    state, _, _ = resilient_train_loop(
+        step, step.init_state(params), _batches, EPOCHS,
+        checkpoint_dir=str(tmp_path / name), telemetry=telemetry,
+        run_name=name, chaos_plan=plan, **kw,
+    )
+    return state, sink
+
+
+def _assert_params_equal(a, b):
+    for x, y in zip(
+        jax.tree_util.tree_leaves(a.params), jax.tree_util.tree_leaves(b.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# chaos matrix: every recoverable fault kind x its documented recovery
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "kind", ["loader_bad_batch", "loader_short_batch"]
+)
+def test_chaos_matrix_loader_faults_dropped(devices, tmp_path, kind):
+    """A poisoned/short batch is injected, detected, and dropped; the run
+    completes on the remaining batches."""
+    plan = ChaosPlan([FaultSpec(kind=kind, step=1)], seed=3)
+    state, sink = _run(
+        tmp_path, f"chaos-{kind}", plan=plan,
+        guard_batches=True, expected_batch=BATCH,
+    )
+    kinds = _kinds(sink)
+    assert "chaos_injected" in kinds
+    assert "bad_batch_dropped" in kinds
+    assert all(
+        np.isfinite(np.asarray(l)).all()
+        for l in jax.tree_util.tree_leaves(state.params)
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind", ["step_transient", "step_nan"])
+def test_chaos_matrix_step_faults_retried_bit_exact(devices, tmp_path, kind):
+    """A transient step error / NaN loss is retried without advancing state,
+    so the final parameters are BIT-IDENTICAL to a clean run."""
+    clean, _ = _run(tmp_path, "clean")
+    plan = ChaosPlan([FaultSpec(kind=kind, step=2)], seed=3)
+    state, sink = _run(
+        tmp_path, f"chaos-{kind}", plan=plan, step_retries=2,
+    )
+    kinds = _kinds(sink)
+    assert "chaos_injected" in kinds
+    assert "retry" in kinds
+    _assert_params_equal(state, clean)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.memories),
+        jax.tree_util.tree_leaves(clean.memories),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind", ["ckpt_torn", "ckpt_bitflip"])
+def test_chaos_matrix_checkpoint_faults_fall_back(devices, tmp_path, kind):
+    """A corrupted newest checkpoint is skipped at resume; the run restarts
+    from the previous good epoch and still finishes all epochs."""
+    plan = ChaosPlan([FaultSpec(kind=kind, step=1)], seed=3)
+    # run 2 epochs, corrupting the epoch-1 checkpoint after it lands
+    _run(tmp_path, "chaos-ckpt", plan=plan)
+    root = str(tmp_path / "chaos-ckpt")
+    if kind == "ckpt_torn":
+        # torn: no marker -> not even listed as committed
+        assert latest_step_path(root) == os.path.join(root, "step_0")
+    else:
+        # bitflip: still committed, only checksums can catch it
+        assert latest_step_path(root) == os.path.join(root, "step_1")
+        ok, reason = verify_checkpoint(os.path.join(root, "step_1"))
+        assert not ok and "checksum mismatch" in reason
+
+    # resume: falls back to step_0, re-trains epoch 1, emits the fallback
+    step, params = _setup()
+    telemetry, sink = _telemetry()
+    state, _, start_epoch = resilient_train_loop(
+        step, step.init_state(params), _batches, EPOCHS,
+        checkpoint_dir=root, telemetry=telemetry, run_name="resume",
+    )
+    assert start_epoch == 1
+    if kind == "ckpt_bitflip":
+        assert "checkpoint_fallback" in _kinds(sink)
+    # the re-save replaced the corrupt step_1 with a good one
+    ok, reason = verify_checkpoint(os.path.join(root, "step_1"))
+    assert ok, reason
+
+
+@pytest.mark.slow
+def test_chaos_full_matrix_combined(devices, tmp_path):
+    """All recoverable fault kinds in ONE run — recoveries compose."""
+    plan = ChaosPlan(
+        [
+            FaultSpec(kind="loader_bad_batch", step=0),
+            FaultSpec(kind="loader_short_batch", step=3),
+            FaultSpec(kind="step_transient", step=1),
+            FaultSpec(kind="step_nan", step=2),
+        ],
+        seed=5,
+    )
+    state, sink = _run(
+        tmp_path, "combined", plan=plan, step_retries=2,
+        guard_batches=True, expected_batch=BATCH,
+    )
+    kinds = _kinds(sink)
+    assert kinds.count("chaos_injected") == 4
+    assert "bad_batch_dropped" in kinds and "retry" in kinds
+
+
+# ---------------------------------------------------------------------------
+# chaos primitives (fast, no training loop)
+# ---------------------------------------------------------------------------
+
+def test_chaos_plan_roundtrip_and_once_semantics(tmp_path):
+    plan = ChaosPlan(
+        [
+            FaultSpec(kind="proc_kill", step=2, rank=1),
+            FaultSpec(kind="step_nan", step=2, rank=None, incarnation=None),
+        ],
+        seed=9,
+    )
+    path = plan.save(str(tmp_path / "plan.json"))
+    loaded = ChaosPlan.load(path)
+    assert loaded.seed == 9
+    assert [f.kind for f in loaded.faults] == ["proc_kill", "step_nan"]
+
+    # rank filter: rank 0 at step 2 only matches the any-rank spec
+    spec = loaded.pop(("step_nan",), 2, rank=0, incarnation=5)
+    assert spec is not None and spec.kind == "step_nan"
+    # once-per-spec: the same trigger never fires twice
+    assert loaded.pop(("step_nan",), 2, rank=0, incarnation=5) is None
+    # incarnation filter: the default-0 proc_kill won't fire in life 1
+    assert loaded.pop(("proc_kill",), 2, rank=1, incarnation=1) is None
+    assert loaded.pop(("proc_kill",), 2, rank=1, incarnation=0) is not None
+
+
+def test_fault_spec_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultSpec(kind="meteor_strike", step=0)
+
+
+def test_chaos_step_transient_and_nan(devices):
+    calls = []
+
+    class FakeStep:
+        bits_per_step = 123
+
+        def __call__(self, state, batch):
+            calls.append(batch)
+            return state + 1, 0.5
+
+    plan = ChaosPlan(
+        [
+            FaultSpec(kind="step_transient", step=0),
+            FaultSpec(kind="step_nan", step=1),
+        ]
+    )
+    telemetry, sink = _telemetry()
+    wrapped = ChaosStep(FakeStep(), plan, telemetry=telemetry)
+    assert wrapped.bits_per_step == 123  # delegation
+    with pytest.raises(ChaosTransientError):
+        wrapped(0, "b0")
+    # step_nan: state NOT advanced, loss non-finite, inner never called
+    state, loss = wrapped(0, "b1")
+    assert state == 0 and np.isnan(loss)
+    assert calls == []
+    # past the schedule, the real step runs
+    state, loss = wrapped(0, "b2")
+    assert state == 1 and calls == ["b2"]
+    assert _kinds(sink).count("chaos_injected") == 2
+
+
+def test_guarded_step_retries_nan_without_advancing(devices):
+    attempts = []
+
+    class FlakyStep:
+        def __call__(self, state, batch):
+            attempts.append(state)
+            if len(attempts) == 1:
+                return state + 100, jnp.float32(float("nan"))
+            return state + 1, jnp.float32(0.25)
+
+    telemetry, sink = _telemetry()
+    guarded = GuardedStep(
+        FlakyStep(), retries=2, backoff_seconds=0.0, telemetry=telemetry
+    )
+    state, loss = guarded(0, None)
+    assert state == 1  # poisoned +100 update was discarded
+    assert attempts == [0, 0]  # same inputs replayed
+    assert "retry" in _kinds(sink)
+
+
+def test_guarded_step_exhausted_raises(devices):
+    class AlwaysNaN:
+        def __call__(self, state, batch):
+            return state, jnp.float32(float("nan"))
+
+    telemetry, _ = _telemetry()
+    guarded = GuardedStep(
+        AlwaysNaN(), retries=1, backoff_seconds=0.0, telemetry=telemetry
+    )
+    with pytest.raises(NonFiniteLossError):
+        guarded(0, None)
+
+
+def test_chaos_batches_poison_and_short(devices):
+    def src(epoch):
+        for _ in range(2):
+            yield (np.zeros((8, 4), np.float32), np.zeros((8,), np.int32))
+
+    plan = ChaosPlan(
+        [
+            FaultSpec(kind="loader_bad_batch", step=0),
+            FaultSpec(kind="loader_short_batch", step=1),
+        ],
+        seed=2,
+    )
+    telemetry, sink = _telemetry()
+    out = list(chaos_batches(src, plan, telemetry=telemetry)(0))
+    assert np.isnan(np.asarray(out[0][0])).any()
+    assert np.asarray(out[1][0]).shape[0] == 4  # halved leading dim
+    assert np.asarray(out[1][1]).shape[0] == 4
+
+    # guarded_batches drops exactly the two poisoned ones
+    plan2 = ChaosPlan(
+        [
+            FaultSpec(kind="loader_bad_batch", step=0),
+            FaultSpec(kind="loader_short_batch", step=1),
+        ],
+        seed=2,
+    )
+    poisoned = chaos_batches(src, plan2, telemetry=telemetry)
+    guarded = guarded_batches(poisoned, expected_batch=8, telemetry=telemetry)
+    assert list(guarded(0)) == []
+    assert _kinds(sink).count("bad_batch_dropped") == 2
+
+
+# ---------------------------------------------------------------------------
+# checkpoint hardening: the commit protocol
+# ---------------------------------------------------------------------------
+
+def _tree(v: float):
+    return {
+        "w": np.full((16, 8), v, np.float32),
+        "b": np.arange(8, dtype=np.float32) * v,
+    }
+
+
+def test_commit_protocol_artifacts(devices, tmp_path):
+    root = str(tmp_path / "ck")
+    final = save_checkpoint(root, _tree(1.0), step=0)
+    assert final == os.path.join(os.path.abspath(root), "step_0")
+    assert is_committed(final)
+    assert os.path.isfile(os.path.join(final, CHECKSUM_MANIFEST))
+    with open(os.path.join(final, COMMITTED_MARKER)) as f:
+        assert json.load(f)["step"] == 0
+    ok, reason = verify_checkpoint(final)
+    assert ok, reason
+    # no leftover tmp dirs
+    assert not [n for n in os.listdir(root) if n.startswith("_tmp.")]
+
+
+def test_abort_before_commit_leaves_only_tmp(devices, tmp_path):
+    """The mid-save crash seam: data written, commit never ran — readers
+    must see NO checkpoint at all."""
+    root = str(tmp_path / "ck")
+    tmp = save_checkpoint(root, _tree(1.0), step=0, _abort_before_commit=True)
+    assert os.path.basename(tmp).startswith("_tmp.")
+    assert os.path.isdir(tmp)
+    assert not os.path.isdir(os.path.join(root, "step_0"))
+    assert latest_step_path(root) is None
+    assert restore_latest(root, _tree(0.0)) is None
+
+
+def test_torn_checkpoint_never_selected(devices, tmp_path):
+    root = str(tmp_path / "ck")
+    save_checkpoint(root, _tree(1.0), step=0)
+    save_checkpoint(root, _tree(2.0), step=1)
+    tear_checkpoint(os.path.join(root, "step_1"))
+    assert latest_step_path(root) == os.path.join(
+        os.path.abspath(root), "step_0"
+    )
+    restored = restore_latest(root, _tree(0.0))
+    assert restored is not None
+    state, step = restored
+    assert step == 0
+    np.testing.assert_array_equal(state["w"], _tree(1.0)["w"])
+
+
+def test_bitflip_caught_by_checksums_with_fallback_event(devices, tmp_path):
+    root = str(tmp_path / "ck")
+    save_checkpoint(root, _tree(1.0), step=0)
+    save_checkpoint(root, _tree(2.0), step=1)
+    bitflip_checkpoint(os.path.join(root, "step_1"), seed=4)
+    # still committed — only verification can tell
+    assert latest_step_path(root) == os.path.join(
+        os.path.abspath(root), "step_1"
+    )
+    telemetry, sink = _telemetry()
+    restored = restore_latest(root, _tree(0.0), telemetry=telemetry, label="t")
+    assert restored is not None
+    state, step = restored
+    assert step == 0
+    np.testing.assert_array_equal(state["w"], _tree(1.0)["w"])
+    fallbacks = [
+        r for r in sink.records
+        if r.get("event") == "failure" and r.get("kind") == "checkpoint_fallback"
+    ]
+    assert len(fallbacks) == 1
+    assert "checksum mismatch" in fallbacks[0]["message"]
+
+
+def test_manifest_catches_extra_and_missing_files(devices, tmp_path):
+    root = str(tmp_path / "ck")
+    final = save_checkpoint(root, _tree(1.0), step=0)
+    with open(os.path.join(final, "smuggled.bin"), "wb") as f:
+        f.write(b"x")
+    ok, reason = verify_checkpoint(final)
+    assert not ok and "unmanifested" in reason
+    os.remove(os.path.join(final, "smuggled.bin"))
+    with open(os.path.join(final, CHECKSUM_MANIFEST)) as f:
+        victim = sorted(json.load(f))[0]
+    os.remove(os.path.join(final, victim))
+    ok, reason = verify_checkpoint(final)
+    assert not ok and "missing file" in reason
+
+
+def test_gc_keep_last(devices, tmp_path):
+    root = str(tmp_path / "ck")
+    for s in range(4):
+        save_checkpoint(root, _tree(float(s)), step=s)
+    # a foreign abandoned tmp dir gets collected too
+    os.makedirs(os.path.join(root, "_tmp.step_9.99999"))
+    deleted = gc_checkpoints(root, keep_last=2)
+    kept = [s for s, _ in committed_step_paths(root)]
+    assert kept == [3, 2]
+    assert any("_tmp.step_9" in d for d in deleted)
+
+    # keep_last threaded through save_checkpoint
+    save_checkpoint(root, _tree(9.0), step=4, keep_last=2)
+    assert [s for s, _ in committed_step_paths(root)] == [4, 3]
+    with pytest.raises(ValueError):
+        gc_checkpoints(root, keep_last=0)
+
+
+def test_restore_latest_empty_root(devices, tmp_path):
+    assert restore_latest(str(tmp_path / "nope"), _tree(0.0)) is None

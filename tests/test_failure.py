@@ -1,8 +1,12 @@
 """Failure detection: watchdog fires on hangs (and not on fast steps),
 transient retry recovers, heartbeat staleness finds dead peers."""
 
+import random
 import time
 
+import pytest
+
+from network_distributed_pytorch_tpu.observe import MemorySink, Telemetry
 from network_distributed_pytorch_tpu.utils.failure import (
     HeartbeatMonitor,
     StepWatchdog,
@@ -60,7 +64,12 @@ def test_retry_transient_recovers_and_exhausts():
 
 
 def test_heartbeat_staleness(tmp_path):
-    a = HeartbeatMonitor(str(tmp_path), process_id=0, num_processes=3)
+    # grace 0: a never-beat peer counts as stale immediately (the default
+    # grace would hold off while the world is still booting)
+    a = HeartbeatMonitor(
+        str(tmp_path), process_id=0, num_processes=3,
+        startup_grace_seconds=0.0,
+    )
     b = HeartbeatMonitor(str(tmp_path), process_id=1, num_processes=3)
     a.beat()
     b.beat(step=42)
@@ -72,3 +81,108 @@ def test_heartbeat_staleness(tmp_path):
     time.sleep(0.15)
     a.beat()
     assert a.stale_peers(threshold_seconds=0.1) == [1, 2]
+
+
+def test_watchdog_reset_rearms_compile_grace():
+    """reset() clears fired history and re-applies compile_grace — a
+    supervisor-restarted worker recompiles, so its first step is exempt
+    again."""
+    fired = []
+    wd = StepWatchdog(
+        timeout_seconds=0.1, on_timeout=fired.append, compile_grace=1
+    )
+    with wd.watch("compile"):  # grace: never armed
+        time.sleep(0.25)
+    with wd.watch("steady"):  # armed: fires
+        time.sleep(0.25)
+    assert fired == ["steady"]
+    assert wd.fired == ["steady"]
+
+    wd.reset()
+    assert wd.fired == []
+    with wd.watch("recompile"):  # grace applies AGAIN after reset
+        time.sleep(0.25)
+    with wd.watch("fast"):
+        pass
+    assert wd.fired == []
+
+
+def test_retry_backoff_cap_and_jitter(monkeypatch):
+    """Exponential growth is capped at max_backoff_seconds and jitter
+    spreads each delay over [d, d*(1+jitter)] with a seedable rng."""
+    slept = []
+    monkeypatch.setattr(time, "sleep", slept.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 5:
+            raise RuntimeError("blip")
+        return "ok"
+
+    assert retry_transient(
+        flaky, retries=5, backoff_seconds=1.0, max_backoff_seconds=2.0,
+        jitter=0.5, rng=random.Random(0),
+    ) == "ok"
+    # uncapped would be 1, 2, 4, 8; the cap clamps to 1, 2, 2, 2 before jitter
+    assert len(slept) == 4
+    for base, actual in zip([1.0, 2.0, 2.0, 2.0], slept):
+        assert base <= actual <= base * 1.5
+
+    # jitter is reproducible: the same seed gives the same schedule
+    calls["n"], replay = 0, list(slept)
+    slept.clear()
+    retry_transient(
+        flaky, retries=5, backoff_seconds=1.0, max_backoff_seconds=2.0,
+        jitter=0.5, rng=random.Random(0),
+    )
+    assert slept == replay
+
+
+def test_retry_emits_event_per_attempt(monkeypatch):
+    """Every attempt — including the exhausted last one — lands in the
+    structured log as FailureEvent(kind='retry')."""
+    monkeypatch.setattr(time, "sleep", lambda _s: None)
+    sink = MemorySink()
+    telemetry = Telemetry([sink])
+
+    def always():
+        raise RuntimeError("permanent")
+
+    with pytest.raises(RuntimeError, match="permanent"):
+        retry_transient(
+            always, retries=2, backoff_seconds=0.0,
+            telemetry=telemetry, label="reducer",
+        )
+    retries = [
+        r for r in sink.records
+        if r.get("event") == "failure" and r.get("kind") == "retry"
+    ]
+    assert len(retries) == 3  # initial try + 2 retries, all recorded
+    assert retries[0]["label"] == "reducer"
+    assert "attempt 1/2" in retries[0]["message"]
+    assert "attempt 3/2" in retries[-1]["message"]
+    assert "permanent" in retries[-1]["message"]
+
+
+def test_heartbeat_incarnation_and_grace(tmp_path):
+    """Beats carry the incarnation field (how a reader tells a live
+    restarted worker from its dead predecessor's file), and a fresh monitor
+    gives never-beat peers a startup grace before calling them stale."""
+    old = HeartbeatMonitor(str(tmp_path), process_id=0, num_processes=2)
+    old.beat()
+    new = HeartbeatMonitor(
+        str(tmp_path), process_id=0, num_processes=2, incarnation=1,
+        startup_grace_seconds=0.2,
+    )
+    new.beat(step=7)
+    payloads = new.peer_payloads()
+    assert payloads[0]["incarnation"] == 1  # the restart overwrote life 0
+    assert payloads[0]["step"] == 7
+    assert payloads[1] is None
+
+    # within the grace window the silent peer 1 is not yet stale...
+    assert new.stale_peers(threshold_seconds=60.0) == []
+    time.sleep(0.25)
+    # ...after it, "never beat" counts
+    assert new.stale_peers(threshold_seconds=60.0) == [1]

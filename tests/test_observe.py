@@ -393,6 +393,37 @@ def test_report_renders_all_sections(tmp_path):
     assert "watchdog_timeout" in text
 
 
+def test_report_failure_timeline(tmp_path):
+    """The failures section orders the fault lifecycle by timestamp and
+    reports injected -> detected -> recovered latencies per chaos fault."""
+    report = _load_report_module()
+    base = 1000.0
+    failures = [
+        {"event": "failure", "kind": "chaos_injected", "label": "proc_kill",
+         "rank": 1, "step": 6, "ts": base},
+        {"event": "failure", "kind": "worker_exit", "rank": 1,
+         "message": "exit code -9", "ts": base + 0.4},
+        {"event": "failure", "kind": "worker_restart", "rank": 1,
+         "incarnation": 1, "ts": base + 1.0},
+        {"event": "failure", "kind": "resumed", "rank": 1, "step": 0,
+         "incarnation": 1, "ts": base + 2.5},
+    ]
+    lines = report.render_failure_timeline(failures)
+    text = "\n".join(lines)
+    assert "failures" in text  # section header contract with render_report
+    assert "t+   0.000s" in text and "chaos_injected" in text
+    assert "rank 1" in text and "@step 6" in text
+    assert "inc 1" in text
+    # the latency span: detection and recovery measured from the injection
+    assert "proc_kill: detected +0.400s, worker_restart +1.000s" in text
+
+    # events without a ts (foreign/legacy records) still render, at the end
+    lines = report.render_failure_timeline(
+        [{"event": "failure", "kind": "watchdog_timeout", "label": "step 3"}]
+    )
+    assert any("watchdog_timeout" in ln for ln in lines)
+
+
 def test_report_percentiles_and_delta(tmp_path):
     report = _load_report_module()
     assert report.percentile([1.0, 2.0, 3.0, 4.0], 50) == pytest.approx(3.0)

@@ -1,0 +1,287 @@
+"""Supervisor: spawn, watch, restart, degrade — against real subprocesses.
+
+The fast tests drive the jax-free ``toy_supervised_worker`` (millisecond
+restarts) through every supervisor code path: crash → restart → resume,
+hang → heartbeat-kill → restart, restart exhaustion → degraded world
+shrink, and ``allow_degraded=False`` → run declared dead. The slow test is
+the ISSUE's acceptance bar: a REAL training rank (SmallCNN + PowerSGD EF)
+SIGKILLed mid-epoch by its chaos plan, restarted by the supervisor, resumed
+from the committed checkpoint — and the final params/EF-memory digests are
+bit-identical to an uninterrupted run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from network_distributed_pytorch_tpu.launch import worker_argv_base
+from network_distributed_pytorch_tpu.observe import MemorySink, Telemetry
+from network_distributed_pytorch_tpu.resilience import (
+    ChaosPlan,
+    FaultSpec,
+    Supervisor,
+    SupervisorConfig,
+)
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+TOY = os.path.join(TESTS_DIR, "toy_supervised_worker.py")
+JAXWORKER = os.path.join(TESTS_DIR, "supervised_worker.py")
+
+
+def _telemetry():
+    sink = MemorySink()
+    return Telemetry([sink]), sink
+
+
+def _kinds(sink):
+    return [r.get("kind") for r in sink.records if r.get("event") == "failure"]
+
+
+def _toy_argv(tmp_path, steps=6, plan_path=None, heartbeat=False,
+              step_seconds=0.01):
+    def argv_for_rank(rank, world, incarnation):
+        argv = [
+            sys.executable, TOY,
+            "--rank", str(rank), "--world", str(world),
+            "--steps", str(steps),
+            "--state-dir", str(tmp_path / "state"),
+            "--result-dir", str(tmp_path / "results"),
+            "--step-seconds", str(step_seconds),
+        ]
+        if plan_path:
+            argv += ["--chaos-plan", plan_path]
+        if heartbeat:
+            argv += ["--heartbeat-dir", str(tmp_path / "hb")]
+        return argv
+
+    return argv_for_rank
+
+
+def _result(tmp_path, rank):
+    with open(tmp_path / "results" / f"rank{rank}.json") as f:
+        return json.load(f)
+
+
+def test_toy_crash_restart_resume(tmp_path):
+    """Rank 1 exits non-zero at step 2; the supervisor restarts it and the
+    restarted life resumes from the persisted accumulator — total progress
+    is exactly steps * world, not recomputed from zero."""
+    plan_path = str(tmp_path / "plan.json")
+    ChaosPlan([FaultSpec(kind="proc_exit", step=2, rank=1)]).save(plan_path)
+    telemetry, sink = _telemetry()
+    result = Supervisor(
+        _toy_argv(tmp_path, steps=6, plan_path=plan_path),
+        world_size=2,
+        config=SupervisorConfig(
+            max_restarts=2, backoff_base_s=0.01, poll_interval_s=0.02,
+        ),
+        telemetry=telemetry,
+    ).run()
+    assert result.success
+    assert result.total_restarts == 1
+    assert not result.degraded
+    assert result.world_size == 2
+    r0, r1 = _result(tmp_path, 0), _result(tmp_path, 1)
+    assert r0["value"] == r1["value"] == 6 * 2  # resumed, not restarted
+    assert r0["incarnation"] == 0
+    assert r1["incarnation"] == 1  # finished in its second life
+    kinds = _kinds(sink)
+    assert "worker_exit" in kinds
+    assert "worker_restart" in kinds
+    assert "run_complete" in kinds
+
+
+def test_toy_hang_detected_by_heartbeat(tmp_path):
+    """Rank 0 stops beating (sleeps forever); the supervisor notices the
+    stale heartbeat, kills it, and the restarted incarnation finishes."""
+    plan_path = str(tmp_path / "plan.json")
+    ChaosPlan(
+        [FaultSpec(kind="proc_hang", step=2, rank=0,
+                   payload={"hang_seconds": 60.0})]
+    ).save(plan_path)
+    telemetry, sink = _telemetry()
+    result = Supervisor(
+        _toy_argv(tmp_path, steps=5, plan_path=plan_path, heartbeat=True),
+        world_size=1,
+        config=SupervisorConfig(
+            max_restarts=2, backoff_base_s=0.01, poll_interval_s=0.05,
+            heartbeat_dir=str(tmp_path / "hb"),
+            heartbeat_timeout_s=0.5, startup_grace_s=5.0,
+            deadline_s=30.0,
+        ),
+        telemetry=telemetry,
+    ).run()
+    assert result.success, result.reason
+    assert result.total_restarts == 1
+    assert _result(tmp_path, 0)["value"] == 5
+    kinds = _kinds(sink)
+    assert "worker_hang" in kinds
+    assert "worker_restart" in kinds
+
+
+def test_toy_degraded_world_shrink(tmp_path):
+    """Rank 1 crashes in EVERY life (incarnation=None): once its restart
+    budget is gone the supervisor relaunches the survivors on a shrunk
+    world instead of declaring the run dead."""
+    plan_path = str(tmp_path / "plan.json")
+    ChaosPlan(
+        [FaultSpec(kind="proc_exit", step=1, rank=1, incarnation=None)]
+    ).save(plan_path)
+    telemetry, sink = _telemetry()
+    result = Supervisor(
+        _toy_argv(tmp_path, steps=5, plan_path=plan_path),
+        world_size=2,
+        config=SupervisorConfig(
+            max_restarts=1, backoff_base_s=0.01, poll_interval_s=0.02,
+            deadline_s=60.0,
+        ),
+        telemetry=telemetry,
+    ).run()
+    assert result.success, result.reason
+    assert result.degraded
+    assert result.world_size == 1
+    assert "degraded_restart" in _kinds(sink)
+    # the surviving rank finished on the shrunk world; its later steps
+    # accumulated world=1 increments (the accounting was recomputed)
+    r0 = _result(tmp_path, 0)
+    assert r0["world"] == 1
+    assert r0["step"] == 5
+    # rank 1 never completed
+    assert not os.path.exists(tmp_path / "results" / "rank1.json")
+
+
+def test_toy_no_degraded_declares_dead(tmp_path):
+    plan_path = str(tmp_path / "plan.json")
+    ChaosPlan(
+        [FaultSpec(kind="proc_exit", step=1, rank=1, incarnation=None)]
+    ).save(plan_path)
+    telemetry, sink = _telemetry()
+    result = Supervisor(
+        _toy_argv(tmp_path, steps=5, plan_path=plan_path),
+        world_size=2,
+        config=SupervisorConfig(
+            max_restarts=1, backoff_base_s=0.01, poll_interval_s=0.02,
+            allow_degraded=False, deadline_s=60.0,
+        ),
+        telemetry=telemetry,
+    ).run()
+    assert not result.success
+    assert "max_restarts" in result.reason
+    assert "run_failed" in _kinds(sink)
+
+
+def test_toy_sigkill_shows_negative_returncode(tmp_path):
+    """A SIGKILLed worker (no cleanup, no atexit) is restarted like any
+    crash; the recorded exit code is the signal's negative returncode."""
+    plan_path = str(tmp_path / "plan.json")
+    ChaosPlan([FaultSpec(kind="proc_kill", step=1, rank=0)]).save(plan_path)
+    telemetry, sink = _telemetry()
+    result = Supervisor(
+        _toy_argv(tmp_path, steps=4, plan_path=plan_path),
+        world_size=1,
+        config=SupervisorConfig(
+            max_restarts=2, backoff_base_s=0.01, poll_interval_s=0.02,
+        ),
+        telemetry=telemetry,
+    ).run()
+    assert result.success
+    assert result.total_restarts == 1
+    exits = [
+        r for r in sink.records
+        if r.get("event") == "failure" and r.get("kind") == "worker_exit"
+    ]
+    assert any("exit code -9" in e.get("message", "") for e in exits)
+
+
+def test_worker_argv_base_strips_supervisor_flags():
+    argv = [
+        "--experiment", "exact", "--supervise", "--max-restarts", "5",
+        "--heartbeat-timeout=30", "--no-degraded",
+        "--process-id", "3", "--num-processes", "8",
+        "--chaos-plan", "plan.json", "--epochs", "2",
+    ]
+    assert worker_argv_base(argv) == [
+        "--experiment", "exact", "--chaos-plan", "plan.json", "--epochs", "2",
+    ]
+
+
+# ---------------------------------------------------------------------------
+# the acceptance bar: kill-and-resume determinism on a REAL training rank
+# ---------------------------------------------------------------------------
+
+def _run_jax_worker_supervised(tmp_path, name, plan_path=None, epochs=3):
+    ckpt = str(tmp_path / name / "ckpt")
+    result_path = str(tmp_path / name / "result.json")
+    event_log = str(tmp_path / name / "events.jsonl")
+    os.makedirs(str(tmp_path / name), exist_ok=True)
+
+    def argv_for_rank(rank, world, incarnation):
+        argv = [
+            sys.executable, JAXWORKER,
+            "--rank", str(rank), "--world", str(world),
+            "--epochs", str(epochs),
+            "--ckpt-dir", ckpt, "--result", result_path,
+            "--event-log", event_log,
+        ]
+        if plan_path:
+            argv += ["--chaos-plan", plan_path]
+        return argv
+
+    telemetry, sink = _telemetry()
+    result = Supervisor(
+        argv_for_rank, world_size=1,
+        config=SupervisorConfig(
+            max_restarts=2, backoff_base_s=0.05, poll_interval_s=0.1,
+            deadline_s=540.0,
+        ),
+        telemetry=telemetry,
+        log_dir=str(tmp_path / name / "logs"),
+    ).run()
+    assert result.success, (result.reason, result.exit_codes)
+    with open(result_path) as f:
+        digests = json.load(f)
+    events = []
+    with open(event_log) as f:
+        for line in f:
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                pass
+    return result, digests, events, sink
+
+
+@pytest.mark.slow
+def test_kill_and_resume_matches_uninterrupted(devices, tmp_path):
+    """SIGKILL a rank mid-epoch (chaos proc_kill at step 6 of a 3-epoch x
+    4-step run), let the supervisor restart it, and assert the resumed
+    run's final params and EF memories are bit-identical to an
+    uninterrupted run — the EF chain continued from the committed
+    checkpoint, not from scratch."""
+    _, ref, _, _ = _run_jax_worker_supervised(tmp_path, "ref")
+
+    plan_path = str(tmp_path / "plan.json")
+    ChaosPlan(
+        [FaultSpec(kind="proc_kill", step=6, rank=0)]  # epoch 1, mid-epoch
+    ).save(plan_path)
+    result, killed, events, sink = _run_jax_worker_supervised(
+        tmp_path, "killed", plan_path=plan_path
+    )
+
+    assert result.total_restarts == 1
+    assert killed["incarnation"] == 1  # finished in its second life
+    assert killed["start_epoch"] == 1  # resumed from the epoch-0 checkpoint
+    assert killed["params_digest"] == ref["params_digest"]
+    assert killed["memories_digest"] == ref["memories_digest"]
+
+    worker_kinds = [
+        e.get("kind") for e in events if e.get("event") == "failure"
+    ]
+    assert "chaos_injected" in worker_kinds  # the kill, from life 0
+    assert "resumed" in worker_kinds  # the restart, from life 1
+    parent_kinds = _kinds(sink)
+    assert "worker_exit" in parent_kinds
+    assert "worker_restart" in parent_kinds
+    assert "worker_complete" in parent_kinds

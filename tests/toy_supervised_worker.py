@@ -1,0 +1,114 @@
+"""Jax-free toy worker for supervisor mechanics tests (run as subprocess).
+
+Simulates a rank of a deterministic "training" run without importing jax
+(so a restart costs milliseconds, not a backend init): each step adds
+``world_size`` to an accumulator — the toy stand-in for the global-batch
+contribution, so a degraded-world restart visibly changes the accounting —
+checkpoints the accumulator atomically every step, beats a heartbeat file,
+and obeys a ``resilience.chaos.ChaosPlan`` for process-level faults
+(exit / SIGKILL / hang). On completion writes a result JSON per rank.
+
+Usage::
+
+    python toy_supervised_worker.py --rank R --world W --steps N \
+        --state-dir D --result-dir D [--heartbeat-dir D] [--chaos-plan F] \
+        [--step-seconds S]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from network_distributed_pytorch_tpu.resilience.chaos import (  # noqa: E402
+    PROCESS_FAULTS,
+    ChaosPlan,
+)
+from network_distributed_pytorch_tpu.resilience.supervisor import (  # noqa: E402
+    incarnation_from_env,
+)
+
+
+def _load_state(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {"step": 0, "value": 0}
+
+
+def _save_state(path, state):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f)
+    os.replace(tmp, path)
+
+
+def _beat(directory, rank, incarnation, step):
+    path = os.path.join(directory, f"heartbeat_{rank}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(
+            {"process_id": rank, "incarnation": incarnation,
+             "ts": time.time(), "step": step},
+            f,
+        )
+    os.replace(tmp, path)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rank", type=int, required=True)
+    p.add_argument("--world", type=int, required=True)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--state-dir", required=True)
+    p.add_argument("--result-dir", required=True)
+    p.add_argument("--heartbeat-dir", default=None)
+    p.add_argument("--chaos-plan", default=None)
+    p.add_argument("--step-seconds", type=float, default=0.01)
+    args = p.parse_args()
+
+    incarnation = incarnation_from_env()
+    plan = ChaosPlan.load(args.chaos_plan) if args.chaos_plan else ChaosPlan()
+    os.makedirs(args.state_dir, exist_ok=True)
+    os.makedirs(args.result_dir, exist_ok=True)
+    if args.heartbeat_dir:
+        os.makedirs(args.heartbeat_dir, exist_ok=True)
+
+    state_path = os.path.join(args.state_dir, f"rank{args.rank}.json")
+    state = _load_state(state_path)
+
+    while state["step"] < args.steps:
+        i = state["step"]
+        if args.heartbeat_dir:
+            _beat(args.heartbeat_dir, args.rank, incarnation, i)
+        spec = plan.pop(PROCESS_FAULTS, i, args.rank, incarnation)
+        if spec is not None:
+            if spec.kind == "proc_exit":
+                os._exit(int(spec.payload.get("exit_code", 43)))
+            if spec.kind == "proc_kill":
+                import signal
+
+                os.kill(os.getpid(), signal.SIGKILL)
+            if spec.kind == "proc_hang":
+                time.sleep(float(spec.payload.get("hang_seconds", 3600.0)))
+        time.sleep(args.step_seconds)
+        state = {"step": i + 1, "value": state["value"] + args.world}
+        _save_state(state_path, state)
+
+    with open(
+        os.path.join(args.result_dir, f"rank{args.rank}.json"), "w"
+    ) as f:
+        json.dump(
+            {"rank": args.rank, "world": args.world,
+             "incarnation": incarnation, **state},
+            f,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
