@@ -32,7 +32,9 @@ handler can run): this file is now TWO programs.
   one cumulative, self-contained JSON line, so whenever the driver's
   patience runs out the tail of stdout is the richest complete snapshot.
   A global deadline (default 870 s < the driver's window) is enforced
-  between phases; remaining phases are recorded as skipped.
+  between phases; remaining phases are recorded as skipped. The very last
+  line is a bounded (≤1,200-char) summary digest so a fixed-size stdout
+  tail always ends in one complete, parseable record.
 - **Child** (``--phases a,b,...``): performs the backend init (daemon-thread
   watchdog — the TPU tunnel's failure mode is an indefinite hang inside the
   PJRT client), then runs its phases, printing one marker-prefixed JSON
@@ -56,6 +58,7 @@ compilation cache (``.xla_cache/``), so any run in the same machine image
 from __future__ import annotations
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -332,9 +335,12 @@ def _default_reps(env_var: str, tpu: str, cpu: str) -> int:
 
 def _timed_dispatches(compiled, state, chunk_batch, reps):
     """Warmup + ``reps`` fetch-to-observe timed CHUNK-step dispatches.
-    Returns ``(state, sorted_times_s)`` (round-4 verdict weak #1: one-shot
-    timings through a contended tunnel showed a 54% spread across runs —
-    22.8k vs 35.0k imgs/sec; every published rate needs median + spread)."""
+    Returns ``(state, times_s)`` in MEASUREMENT order (round-4 verdict weak
+    #1: one-shot timings through a contended tunnel showed a 54% spread
+    across runs — 22.8k vs 35.0k imgs/sec; every published rate needs
+    median + spread, and the published sequence must keep its time order so
+    a drift across reps — tunnel warmup, a draining abandoned compile —
+    stays visible; callers sort a local copy for min/median/max)."""
     from network_distributed_pytorch_tpu.utils.timing import wait_result
 
     state, losses = compiled(state, chunk_batch)  # warmup
@@ -345,7 +351,37 @@ def _timed_dispatches(compiled, state, chunk_batch, reps):
         state, losses = compiled(state, chunk_batch)
         wait_result(losses)  # fetch-to-observe-completion, utils.timing
         times.append(time.perf_counter() - t0)
-    return state, sorted(times)
+    return state, times
+
+
+def _flops_band(ratio: float, chunk: int):
+    """Classify the FLOPs cross-check ratio ``flops_chunk / flops_1`` as
+    ``"trip"`` (trip-multiplied, ratio ~chunk), ``"once"`` (count-once,
+    ratio ~1), or ``None`` (matches neither — caller withholds MFU).
+
+    The original two ±2x windows — [chunk/2, 2*chunk] and [0.5, 2] —
+    OVERLAP once chunk <= 4 (at chunk=2, ratio 1.5 sits in both, and the
+    trip-multiplied branch won by ``if`` ordering, silently dividing a
+    count-once flops figure by chunk). Inside the overlap the nearer band
+    center in log space decides; outside it the windows are disjoint and
+    the behavior is unchanged (identical to the old code for chunk >= 8).
+    At chunk == 1 the bands coincide and the tie resolves to ``"trip"`` —
+    harmless, since dividing by 1 equals counting once."""
+    if ratio <= 0 or chunk < 1:
+        return None
+    in_trip = 0.5 * chunk <= ratio <= 2.0 * chunk
+    in_once = 0.5 <= ratio <= 2.0
+    if in_trip and in_once:
+        return (
+            "trip"
+            if abs(math.log(ratio / chunk)) <= abs(math.log(ratio))
+            else "once"
+        )
+    if in_trip:
+        return "trip"
+    if in_once:
+        return "once"
+    return None
 
 
 def _phase_flagship() -> dict:
@@ -367,6 +403,7 @@ def _phase_flagship() -> dict:
         pass
     reps = _default_reps("BENCH_FLAGSHIP_REPS", "5", "2")
     state, times = _timed_dispatches(compiled, state, chunk_batch, reps)
+    ranked = sorted(times)
     dt = _median(times)
     out = {
         "preset": "small" if small else "full",
@@ -374,8 +411,11 @@ def _phase_flagship() -> dict:
         "step_time_ms": round(1000.0 * dt / CHUNK, 4),
         "flagship_reps": reps,
         # min dispatch time -> max rate and vice versa
-        "flagship_imgs_per_sec_max": round(batch_size * CHUNK / times[0], 2),
-        "flagship_imgs_per_sec_min": round(batch_size * CHUNK / times[-1], 2),
+        "flagship_imgs_per_sec_max": round(batch_size * CHUNK / ranked[0], 2),
+        "flagship_imgs_per_sec_min": round(batch_size * CHUNK / ranked[-1], 2),
+        # measurement order, NOT sorted: a monotone drift across reps (the
+        # tunnel warming up, an abandoned compile draining) must stay
+        # visible in the published sequence
         "dispatch_times_ms": [round(1000.0 * t, 2) for t in times],
     }
     # flops_chunk ÷ CHUNK is only valid where the compiler's cost analysis
@@ -457,10 +497,11 @@ def _phase_flagship() -> dict:
                 raise ValueError("chunk-1 cost analysis returned no flops")
             ratio = flops_chunk / flops_1
             out["flops_chunk_ratio"] = round(ratio, 2)
-            if 0.5 * CHUNK <= ratio <= 2.0 * CHUNK:
+            band = _flops_band(ratio, CHUNK)
+            if band == "trip":
                 per_step = flops_chunk / CHUNK
                 out["flops_method"] = "hlo scan-trip-multiplied (chunk-1 cross-checked)"
-            elif 0.5 <= ratio <= 2.0:
+            elif band == "once":
                 per_step = flops_chunk
                 out["flops_method"] = "hlo count-once (chunk-1 cross-checked)"
         except Exception as e:  # noqa: BLE001 — cross-check is best-effort;
@@ -507,10 +548,12 @@ def _phase_baseline() -> dict:
     batch = _cifar_batch(batch_size)
     state, loss = step(state, batch)  # compile + warmup
     wait_result(loss)
-    # two independent timed passes (round-4 verdict weak #5: vs_baseline
-    # rested on a single unreplicated pair); each pass pays the host round
-    # trip every step by design — that is this arm's whole point
-    passes = max(1, int(os.environ.get("BENCH_BASELINE_PASSES", "2")))
+    # three independent timed passes (round-4 verdict weak #5: vs_baseline
+    # rested on a single unreplicated pair; with two passes the median IS
+    # an endpoint, so three is the floor at which median and spread are
+    # distinct); each pass pays the host round trip every step by design —
+    # that is this arm's whole point
+    passes = max(1, int(os.environ.get("BENCH_BASELINE_PASSES", "3")))
     rates = []
     for _ in range(passes):
         t0 = time.perf_counter()
@@ -522,6 +565,10 @@ def _phase_baseline() -> dict:
     return {
         "baseline_imgs_per_sec": round(med, 2),
         "baseline_step_time_ms": round(1000.0 * batch_size / med, 4),
+        # spread endpoints ride the record like the flagship's — the
+        # vs_baseline ratio's denominator needs error bars too
+        "baseline_imgs_per_sec_min": round(min(rates), 2),
+        "baseline_imgs_per_sec_max": round(max(rates), 2),
         "baseline_passes": [round(r, 2) for r in sorted(rates)],
     }
 
@@ -542,6 +589,7 @@ def _phase_fp32arm() -> dict:
     )
     reps = _default_reps("BENCH_FP32ARM_REPS", "3", "1")
     state, times = _timed_dispatches(compiled, state, chunk_batch, reps)
+    ranked = sorted(times)
     dt = _median(times)
     return {
         # same tier-labeling contract as the flagship: a small-preset rate
@@ -550,8 +598,10 @@ def _phase_fp32arm() -> dict:
         "fp32_scanned_imgs_per_sec": round(batch_size * CHUNK / dt, 2),
         "fp32_scanned_step_time_ms": round(1000.0 * dt / CHUNK, 4),
         "fp32_scanned_reps": reps,
-        "fp32_scanned_imgs_per_sec_max": round(batch_size * CHUNK / times[0], 2),
-        "fp32_scanned_imgs_per_sec_min": round(batch_size * CHUNK / times[-1], 2),
+        "fp32_scanned_imgs_per_sec_max": round(batch_size * CHUNK / ranked[0], 2),
+        "fp32_scanned_imgs_per_sec_min": round(batch_size * CHUNK / ranked[-1], 2),
+        # measurement order — same contract as the flagship's
+        # dispatch_times_ms
         "fp32_dispatch_times_ms": [round(1000.0 * t, 2) for t in times],
     }
 
@@ -907,7 +957,11 @@ def _artifact_pointers(out: dict) -> None:
                 # baseline-derived fields only when THAT phase was also
                 # plain-ok TPU — a fallback-tier baseline must not be
                 # re-exported under the chip label either
-                keys += ["baseline_imgs_per_sec", "baseline_passes", "vs_baseline"]
+                keys += [
+                    "baseline_imgs_per_sec", "baseline_imgs_per_sec_min",
+                    "baseline_imgs_per_sec_max", "baseline_passes",
+                    "vs_baseline",
+                ]
             if mid.get("phases", {}).get("fp32arm") == "ok":
                 keys += ["fp32_scanned_imgs_per_sec"]
             rec = {k: mid.get(k) for k in keys if mid.get(k) is not None}
@@ -1025,6 +1079,53 @@ def _await_child_exit(child, out: dict, left) -> None:
         if ev.get("phase") == "__drain__":
             out["abandoned_drain"] = ev.get("data")
             _emit(out)
+
+
+# serialized byte budget for the final summary line. The driver reads a
+# fixed-size tail of stdout (~2,000 chars); 1,200 leaves headroom for the
+# newline plus a partially-truncated previous line sharing the tail.
+_SUMMARY_LIMIT = 1200
+# headline keys in keep-priority order — when the serialized summary
+# overflows _SUMMARY_LIMIT, keys drop from the BOTTOM of this list first
+_SUMMARY_PRIORITY = (
+    "metric", "value", "unit", "vs_baseline", "device", "platform",
+    "n_devices", "preset", "wall_s", "partial", "value_tier",
+    "flagship_imgs_per_sec", "flagship_imgs_per_sec_min",
+    "flagship_imgs_per_sec_max", "baseline_imgs_per_sec",
+    "baseline_imgs_per_sec_min", "baseline_imgs_per_sec_max", "mfu",
+    "fp32_scanned_imgs_per_sec", "tpu_error", "flops_chunk_ratio",
+)
+
+
+def _compact_summary(out: dict, status: dict) -> dict:
+    """A bounded digest of the cumulative record, emitted as the round's
+    VERY LAST stdout line: the driver parses a fixed-size tail, and the
+    full record can outgrow it (per-dispatch time lists, artifact pointers,
+    400-char error strings) — then the tail's only complete line would be
+    truncated garbage. Serialized size is guaranteed <= _SUMMARY_LIMIT:
+    every string is clipped, and whole keys drop in reverse priority order
+    until the line fits."""
+
+    def _clip(v):
+        return v[:120] if isinstance(v, str) else v
+
+    summary = {"summary": True}
+    for k in _SUMMARY_PRIORITY:
+        if out.get(k) is not None:
+            summary[k] = _clip(out[k])
+    # per-phase status strings, clipped hard: error statuses carry up to
+    # 200 chars each and six phases of those would eat half the budget
+    summary["phases"] = {k: _clip(str(v))[:60] for k, v in status.items()}
+    gpt = out.get("gpt")
+    if isinstance(gpt, dict):
+        summary["gpt"] = {
+            k: _clip(gpt[k])
+            for k in ("model", "seq_len", "mfu", "tokens_per_sec")
+            if gpt.get(k) is not None
+        }
+    while len(json.dumps(summary)) > _SUMMARY_LIMIT and len(summary) > 1:
+        summary.pop(next(reversed(summary)))
+    return summary
 
 
 def orchestrate() -> int:
@@ -1175,6 +1276,9 @@ def orchestrate() -> int:
     out["wall_s"] = round(time.time() - t_start, 1)
     _persist_midround(out, status)
     _emit(out)
+    # the full record above stays the authoritative line; the bounded
+    # summary AFTER it is what a fixed-size tail is guaranteed to hold
+    _emit(_compact_summary(out, status))
     return 0
 
 

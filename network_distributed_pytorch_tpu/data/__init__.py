@@ -1,6 +1,12 @@
 """L0 — data layer: deterministic cross-rank partitioning + dataset pipelines."""
 
-from .partition import Partition, DataPartitioner, partition_dataset  # noqa: F401
+from .partition import (  # noqa: F401
+    DataPartitioner,
+    Partition,
+    elastic_assignments,
+    partition_dataset,
+    split_indices,
+)
 
 from .loader import device_prefetch, epoch_order, iterate_batches, steps_per_epoch  # noqa: F401
 from .cifar10 import load_cifar10, load_cifar10_or_synthetic, synthetic_cifar10  # noqa: F401

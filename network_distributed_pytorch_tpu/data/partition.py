@@ -32,6 +32,26 @@ class Partition:
         return self.data[self.index[i]]
 
 
+def split_indices(
+    data_len: int, sizes: Sequence[float], seed: int = 1234
+) -> List[List[int]]:
+    """The partitioner's index math without a data object: shuffle
+    ``range(data_len)`` with the fixed local RNG, cut into ``int(frac *
+    data_len)``-truncated chunks. The permutation depends only on ``seed``
+    and ``data_len`` — NOT on ``sizes`` — which is what makes elastic
+    re-splits (below) coverage-preserving."""
+    rng = random.Random()
+    rng.seed(seed)
+    indexes = list(range(data_len))
+    rng.shuffle(indexes)
+    partitions: List[List[int]] = []
+    for frac in sizes:
+        part_len = int(frac * data_len)
+        partitions.append(indexes[:part_len])
+        indexes = indexes[part_len:]
+    return partitions
+
+
 class DataPartitioner:
     """Shuffle-once, cut-into-fractions partitioner
     (reference ``partition_helper.py:18-35``, including the fixed default
@@ -39,16 +59,7 @@ class DataPartitioner:
 
     def __init__(self, data, sizes: Sequence[float] = (0.7, 0.2, 0.1), seed: int = 1234):
         self.data = data
-        self.partitions: List[List[int]] = []
-        rng = random.Random()
-        rng.seed(seed)
-        indexes = list(range(len(data)))
-        rng.shuffle(indexes)
-        data_len = len(data)
-        for frac in sizes:
-            part_len = int(frac * data_len)
-            self.partitions.append(indexes[:part_len])
-            indexes = indexes[part_len:]
+        self.partitions = split_indices(len(data), sizes, seed=seed)
 
     def use(self, partition: int) -> Partition:
         return Partition(self.data, self.partitions[partition])
@@ -59,6 +70,21 @@ def partition_dataset(data, world_size: int, rank: int, seed: int = 1234) -> Par
     ``use(rank)`` (reference ``ddp_guide_cifar10/ddp_init.py:49-52``)."""
     sizes = [1.0 / world_size for _ in range(world_size)]
     return DataPartitioner(data, sizes, seed=seed).use(rank)
+
+
+def elastic_assignments(
+    data_len: int, world_size: int, seed: int = 1234
+) -> List[List[int]]:
+    """Per-rank index assignments for the equal split at ANY world size,
+    all cut from the same seed-``seed`` permutation — the elastic-recovery
+    re-split. When the supervisor shrinks W → W', the W' survivors call
+    this with the new world and, with no reshuffle and no coordination,
+    cover the same ``world_size * (data_len // world_size)`` permutation
+    prefix disjointly (the whole dataset when ``world_size`` divides
+    ``data_len``)."""
+    return split_indices(
+        data_len, [1.0 / world_size] * world_size, seed=seed
+    )
 
 
 def per_worker_batch_size(global_batch: int, world_size: int) -> int:

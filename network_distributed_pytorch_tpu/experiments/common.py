@@ -99,6 +99,7 @@ def train_loop(
     watchdog: Any = None,
     heartbeat: Any = None,
     on_epoch_end: Optional[Callable[[int, TrainState], None]] = None,
+    on_step_end: Optional[Callable[[int, int, TrainState], bool]] = None,
     prefetch: int = 2,
     batch_sharding: Any = None,
     telemetry: Any = None,
@@ -126,8 +127,10 @@ def train_loop(
     Optional hooks (all default-off; :func:`resilient_train_loop` wires
     them): a ``utils.failure.StepWatchdog`` around every step, a
     ``utils.failure.HeartbeatMonitor`` beat per step (rate-limited by the
-    monitor itself), and an ``on_epoch_end(epoch, state)`` callback (e.g.
-    checkpointing).
+    monitor itself), an ``on_epoch_end(epoch, state)`` callback (e.g.
+    checkpointing), and an ``on_step_end(epoch, steps_done, state) ->
+    stop?`` callback after every completed step — returning True ends the
+    loop early with the current state (the preemption-grace shutdown path).
     """
     import contextlib
 
@@ -160,6 +163,7 @@ def train_loop(
             batches = batches_for_epoch(epoch)
             if prefetch:
                 batches = device_prefetch(batches, sharding, depth=prefetch)
+            steps_done = 0
             for batch in batches:
                 if audit_pending:
                     # must precede the first execution: donate_argnums
@@ -190,8 +194,13 @@ def train_loop(
                     state, loss = step(state, batch)
                     loss = jax.device_get(loss)
                 logger.end_step(epoch, loss)
+                steps_done += 1
                 if heartbeat is not None:
                     heartbeat.beat(epoch=epoch)
+                if on_step_end is not None and on_step_end(
+                    epoch, steps_done, state
+                ):
+                    return state, logger
             logger.end_epoch(epoch, rank=rank)
             if on_epoch_end is not None:
                 on_epoch_end(epoch, state)
@@ -414,6 +423,8 @@ def resilient_train_loop(
     expected_batch: Optional[int] = None,
     keep_last: Optional[int] = None,
     batch_sharding: Any = None,
+    topology: Optional[Dict] = None,
+    preemption_guard: Any = None,
 ) -> Tuple[TrainState, "MetricsLogger", int]:
     """:func:`train_loop` plus the survival kit the reference lacks entirely
     (SURVEY §5: no checkpointing, no retry; a failed init doesn't even exit):
@@ -435,30 +446,101 @@ def resilient_train_loop(
       deterministic fault injection into all of the above — the chaos
       suite's entry point. ``incarnation`` is this worker's supervisor
       restart generation (``resilience.supervisor.incarnation_from_env``),
-      matched against the plan so a restarted worker doesn't re-crash.
+      matched against the plan so a restarted worker doesn't re-crash;
+    - ``topology`` (a ``resilience.reshard.make_topology`` record for THIS
+      run's world) tags every checkpoint with its world size and, on
+      resume, routes a cross-world restore through the resharder: EF
+      memories fold by summation, per-worker stats merge, and ``resumed``/
+      ``resharded`` events plus an accounting ``note`` (old/new
+      accumulation, recomputed ``bits_per_step``) land in telemetry;
+    - ``preemption_guard`` (a ``resilience.guards.PreemptionGuard``) turns
+      a SIGTERM into an emergency committed checkpoint at the next step
+      boundary: the save records an ``epoch_cursor`` in the topology tag,
+      the loop stops early, and the NEXT resume re-enters the same epoch
+      skipping exactly the steps already accounted for.
 
     Returns ``(state, logger, start_epoch)`` — ``start_epoch`` tells the
     caller how many epochs were skipped via resume.
     """
-    from ..observe import FailureEvent
-    from ..utils.checkpoint import restore_latest, save_checkpoint
+    import itertools
+    import os
+
+    from ..observe import FailureEvent, NoteEvent
+    from ..utils.checkpoint import (
+        read_topology,
+        restore_latest,
+        save_checkpoint,
+    )
     from ..utils.failure import StepWatchdog
 
     state = init_state
     start_epoch = 0
+    resume_skip = 0  # steps of start_epoch already in the restored state
+    reshard_note: Dict[str, Any] = {}
+
+    def _resharder(path, saved_topo):
+        from ..resilience.reshard import reshard_from_checkpoint
+
+        reshard_note["old"] = saved_topo or {}
+        return reshard_from_checkpoint(
+            path, init_state, saved_topology=saved_topo
+        )
+
     resumed = restore_latest(
-        checkpoint_dir, init_state, telemetry=telemetry, label=run_name
+        checkpoint_dir, init_state, telemetry=telemetry, label=run_name,
+        resharder=_resharder if topology is not None else None,
     )
     if resumed is not None:
         state, resumed_epoch = resumed
-        start_epoch = resumed_epoch + 1
+        restored_topo = read_topology(
+            os.path.join(os.path.abspath(checkpoint_dir), f"step_{resumed_epoch}")
+        )
+        cursor = (restored_topo or {}).get("epoch_cursor")
+        if cursor and cursor.get("batches_done"):
+            # a preemption-grace mid-epoch save: re-enter the SAME epoch,
+            # skipping the steps already in the restored state (the
+            # per-epoch batch stream is deterministic, so the skip is
+            # exact even across a world change — steps/epoch is a function
+            # of the preserved global batch, not the world size)
+            start_epoch = int(cursor["epoch"])
+            resume_skip = int(cursor["batches_done"])
+        else:
+            start_epoch = resumed_epoch + 1
         if telemetry is not None:
+            mid = f" (+{resume_skip} steps)" if resume_skip else ""
             telemetry.emit(
                 FailureEvent(
                     kind="resumed", label=run_name, rank=rank,
                     step=resumed_epoch, incarnation=incarnation,
                     message=f"resumed from step_{resumed_epoch},"
-                            f" starting epoch {start_epoch}",
+                            f" starting epoch {start_epoch}{mid}",
+                )
+            )
+        if reshard_note and telemetry is not None:
+            old, new = reshard_note["old"], topology or {}
+            new_bits = new.get("bits_per_step")
+            if new_bits is None:
+                new_bits = getattr(step, "bits_per_step", None)
+            telemetry.emit(
+                FailureEvent(
+                    kind="resharded", label=run_name, rank=rank,
+                    step=resumed_epoch, incarnation=incarnation,
+                    message=f"world {old.get('world_size')} ->"
+                            f" {new.get('world_size')}: EF memories folded"
+                            f" by summation, per-worker stats merged,"
+                            f" partitions re-split from the fixed"
+                            f" permutation",
+                )
+            )
+            telemetry.emit(
+                NoteEvent(
+                    message=f"reshard accounting: global_batch"
+                            f" {old.get('global_batch')} ->"
+                            f" {new.get('global_batch')} (preserved),"
+                            f" accum_steps {old.get('accum_steps')} ->"
+                            f" {new.get('accum_steps')},"
+                            f" bits_per_step {old.get('bits_per_step')} ->"
+                            f" {new_bits}",
                 )
             )
 
@@ -487,8 +569,18 @@ def resilient_train_loop(
             telemetry=telemetry, label=run_name,
         )
 
+    def _topo(cursor: Optional[Dict] = None) -> Optional[Dict]:
+        if topology is None:
+            return {"epoch_cursor": cursor} if cursor else None
+        out = dict(topology)
+        out["epoch_cursor"] = cursor
+        return out
+
     def _save(epoch: int, st) -> None:
-        save_checkpoint(checkpoint_dir, st, step=epoch, keep_last=keep_last)
+        save_checkpoint(
+            checkpoint_dir, st, step=epoch, keep_last=keep_last,
+            topology=_topo(),
+        )
         if chaos_plan is not None:
             from ..resilience.chaos import apply_checkpoint_fault
 
@@ -496,6 +588,34 @@ def resilient_train_loop(
                 chaos_plan, checkpoint_dir, epoch, rank=rank,
                 incarnation=incarnation, telemetry=telemetry,
             )
+
+    def _on_step_end(epoch: int, steps_done: int, st) -> bool:
+        if preemption_guard is None or not preemption_guard.requested:
+            return False
+        done = steps_done + (resume_skip if epoch == start_epoch else 0)
+        save_checkpoint(
+            checkpoint_dir, st, step=epoch, keep_last=keep_last,
+            topology=_topo(cursor={"epoch": epoch, "batches_done": done}),
+        )
+        preemption_guard.checkpoint_saved = True
+        if telemetry is not None:
+            telemetry.emit(
+                FailureEvent(
+                    kind="preempt_checkpoint", label=run_name, rank=rank,
+                    step=epoch, incarnation=incarnation,
+                    message=f"emergency checkpoint committed at epoch"
+                            f" {epoch} after {done} steps; stopping for"
+                            f" preemption",
+                )
+            )
+        return True
+
+    if resume_skip:
+        inner_batches, first_epoch, skip = batches_for_epoch, start_epoch, resume_skip
+
+        def batches_for_epoch(epoch: int):  # noqa: F811
+            it = inner_batches(epoch)
+            return itertools.islice(it, skip, None) if epoch == first_epoch else it
 
     wd = (
         # grace on the first step: it includes XLA compilation, which may
@@ -507,7 +627,9 @@ def resilient_train_loop(
     state, logger = train_loop(
         step, state, batches_for_epoch, epochs, rank=rank, log_every=log_every,
         start_epoch=start_epoch, watchdog=wd, heartbeat=heartbeat,
-        on_epoch_end=_save, batch_sharding=batch_sharding,
+        on_epoch_end=_save,
+        on_step_end=_on_step_end if preemption_guard is not None else None,
+        batch_sharding=batch_sharding,
         telemetry=telemetry, trace_dir=trace_dir, audit=audit, run_name=run_name,
     )
     return state, logger, start_epoch

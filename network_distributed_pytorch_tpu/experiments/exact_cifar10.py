@@ -128,25 +128,53 @@ def run(
     telemetry = telemetry_from_config(config)
     try:
         if resilient:
-            from ..resilience import ChaosPlan, incarnation_from_env
+            from ..resilience import (
+                PREEMPT_EXIT_CODE,
+                ChaosPlan,
+                PreemptionGuard,
+                incarnation_from_env,
+                make_topology,
+            )
             from .common import resilient_train_loop
 
             plan = (
                 ChaosPlan.load(config.chaos_plan)
                 if config.chaos_plan else None
             )
-            state, logger, _ = resilient_train_loop(
-                step, state, batches, config.training_epochs,
-                checkpoint_dir=checkpoint_dir,
-                rank=config.process_id, log_every=config.log_every,
-                telemetry=telemetry, trace_dir=config.trace_dir,
-                audit=audit_from_config(config), run_name="exact_cifar10",
-                chaos_plan=plan, incarnation=incarnation_from_env(),
-                step_retries=2 if plan is not None else 0,
-                guard_batches=plan is not None,
-                keep_last=keep_last,
-                batch_sharding=accum_batch_sharding(mesh, config.accum_steps),
-            )
+            incarnation = incarnation_from_env()
+            with PreemptionGuard(
+                telemetry=telemetry, rank=config.process_id,
+                incarnation=incarnation, label="exact_cifar10",
+            ) as guard:
+                state, logger, _ = resilient_train_loop(
+                    step, state, batches, config.training_epochs,
+                    checkpoint_dir=checkpoint_dir,
+                    rank=config.process_id, log_every=config.log_every,
+                    telemetry=telemetry, trace_dir=config.trace_dir,
+                    audit=audit_from_config(config), run_name="exact_cifar10",
+                    chaos_plan=plan, incarnation=incarnation,
+                    step_retries=2 if plan is not None else 0,
+                    guard_batches=plan is not None,
+                    keep_last=keep_last,
+                    batch_sharding=accum_batch_sharding(mesh, config.accum_steps),
+                    # topology-tag every committed checkpoint so a restart
+                    # on a shrunken mesh reshards instead of mis-resuming
+                    topology=make_topology(
+                        mesh.size,
+                        global_batch=config.global_batch_size,
+                        accum_steps=config.accum_steps,
+                        data_seed=config.seed,
+                        bits_per_step=step.bits_per_step,
+                        rng_seed=config.seed,
+                        incarnation=incarnation,
+                    ),
+                    preemption_guard=guard,
+                )
+            if guard.requested:
+                # the emergency checkpoint is committed; die with the
+                # graceful sentinel rather than report a half-run result
+                # (the finally below still closes telemetry)
+                raise SystemExit(PREEMPT_EXIT_CODE)
         else:
             state, logger = train_loop(
                 step, state, batches, config.training_epochs,

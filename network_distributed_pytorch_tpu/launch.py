@@ -204,6 +204,12 @@ def build_parser() -> argparse.ArgumentParser:
              " and restarted",
     )
     p.add_argument(
+        "--term-grace", type=float, default=5.0,
+        help="supervise: seconds between SIGTERM and SIGKILL on every"
+             " supervisor-initiated kill — the window a worker's"
+             " PreemptionGuard has to commit an emergency checkpoint",
+    )
+    p.add_argument(
         "--min-world-size", type=int, default=1,
         help="supervise: smallest world a degraded restart may shrink to",
     )
@@ -270,6 +276,7 @@ _SUPERVISOR_FLAGS = {
     "--max-restarts": True,
     "--restart-backoff": True,
     "--heartbeat-timeout": True,
+    "--term-grace": True,
     "--min-world-size": True,
     "--no-degraded": False,
     "--worker-log-dir": True,
@@ -319,6 +326,7 @@ def _supervise(args, argv) -> dict:
                 backoff_base_s=args.restart_backoff,
                 heartbeat_dir=args.heartbeat_dir,
                 heartbeat_timeout_s=args.heartbeat_timeout,
+                term_grace_s=args.term_grace,
                 allow_degraded=not args.no_degraded,
                 min_world_size=args.min_world_size,
                 seed=args.seed,

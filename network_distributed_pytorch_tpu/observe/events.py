@@ -158,10 +158,14 @@ class CompileEvent(Event):
 @dataclass
 class FailureEvent(Event):
     """A failure-domain lifecycle event: a detected failure (watchdog
-    timeout, audit error, stale peer, non-finite loss), an injected chaos
-    fault, or a recovery action (retry, checkpoint fallback, supervisor
-    restart, resume). ``scripts/report.py`` orders these by timestamp into
-    the run's failure timeline, so every kind shares one event type.
+    timeout, audit error, stale peer, non-finite loss, a ``preempt_notice``
+    SIGTERM), an injected chaos fault, or a recovery action (retry,
+    checkpoint fallback, supervisor restart, resume, an elastic
+    ``resharded`` restore at a shrunk world, a ``preempt_checkpoint``
+    emergency save). ``scripts/report.py`` orders these by timestamp into
+    the run's failure timeline — including the graceful-vs-hard death
+    tally it reads from supervisor ``worker_exit``/``worker_term``
+    messages — so every kind shares one event type.
 
     ``rank``/``step``/``incarnation`` locate the event in the failure
     domain (None = not applicable): which worker, at which step of its

@@ -14,21 +14,32 @@ operates them:
   injection (``ChaosPlan``): every failure path in the repo becomes
   testable on CPU with no wall-clock randomness.
 - :mod:`resilience.guards`     — the recovery side: a step wrapper that
-  retries transient errors and rejects non-finite losses, and a batch
-  guard that drops malformed loader output.
+  retries transient errors and rejects non-finite losses, a batch guard
+  that drops malformed loader output, and the ``PreemptionGuard`` that
+  turns SIGTERM into an emergency committed checkpoint at the next step
+  boundary.
 - :mod:`resilience.supervisor` — the restarting launcher: spawns per-rank
   workers, watches exit codes and heartbeats, restarts crashed/hung ranks
-  with bounded backoff, resumes from the newest committed checkpoint, and
-  degrades to a shrunk world when a rank is permanently gone.
+  with bounded backoff (SIGTERM-then-SIGKILL with a grace window, never a
+  bare kill), resumes from the newest committed checkpoint, and degrades
+  to a shrunk world when a rank is permanently gone.
+- :mod:`resilience.reshard`    — what makes the degraded restart lossless:
+  deterministic state resharding from a topology-tagged checkpoint at
+  world W to any W' ≤ W (EF memories fold by summation preserving the
+  unsent-error sum bit-for-bit, per-worker stats merge, partitions
+  re-split from the fixed permutation, global batch preserved via
+  accumulation rescale).
 
-``chaos`` and ``supervisor`` are jax-free at import time (the supervisor
-parent process never initializes a backend; workers do).
+The whole package is jax-free at import time (the supervisor parent
+process never initializes a backend; workers do — reshard/guards import
+jax lazily inside the functions that touch pytrees).
 """
 
 from .chaos import (  # noqa: F401
     CHECKPOINT_FAULTS,
     FAULT_KINDS,
     LOADER_FAULTS,
+    PREEMPT_EXIT_CODE,
     PROCESS_FAULTS,
     STEP_FAULTS,
     ChaosPlan,
@@ -41,7 +52,20 @@ from .chaos import (  # noqa: F401
 from .guards import (  # noqa: F401
     GuardedStep,
     NonFiniteLossError,
+    PreemptionGuard,
     guarded_batches,
+)
+from .reshard import (  # noqa: F401
+    derive_rank_key,
+    fold_groups,
+    fold_memories,
+    make_topology,
+    memory_total,
+    merge_model_state,
+    rescale_accum_steps,
+    reshard_from_checkpoint,
+    reshard_train_state,
+    widen_template,
 )
 from .supervisor import (  # noqa: F401
     Supervisor,

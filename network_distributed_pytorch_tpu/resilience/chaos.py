@@ -23,6 +23,9 @@ Fault kinds and where they bite:
 ``proc_kill``          the worker SIGKILLs itself (no cleanup, no atexit)
 ``proc_hang``          the worker stops making progress (sleeps), so its
                        heartbeat goes stale and the watchdog/supervisor fire
+``proc_preempt``       a preemption notice: the worker SIGTERMs itself; an
+                       installed ``guards.PreemptionGuard`` turns it into an
+                       emergency committed checkpoint at the step boundary
 ==================  =========================================================
 
 Process- and step-level faults carry an ``incarnation`` filter (default 0)
@@ -48,12 +51,16 @@ import numpy as np
 LOADER_FAULTS = ("loader_bad_batch", "loader_short_batch")
 STEP_FAULTS = ("step_transient", "step_nan")
 CHECKPOINT_FAULTS = ("ckpt_torn", "ckpt_bitflip")
-PROCESS_FAULTS = ("proc_exit", "proc_kill", "proc_hang")
+PROCESS_FAULTS = ("proc_exit", "proc_kill", "proc_hang", "proc_preempt")
 FAULT_KINDS = LOADER_FAULTS + STEP_FAULTS + CHECKPOINT_FAULTS + PROCESS_FAULTS
 
 # exit code a chaos-injected clean crash uses — distinguishable from both
 # success (0) and a signal death (negative returncode) in supervisor logs
 CHAOS_EXIT_CODE = 43
+# exit code of a worker that honored SIGTERM and committed its emergency
+# checkpoint (EX_TEMPFAIL: restartable). The supervisor classifies it — and
+# a bare SIGTERM death — as a GRACEFUL death; anything else is hard.
+PREEMPT_EXIT_CODE = 75
 
 
 class ChaosTransientError(RuntimeError):
@@ -201,6 +208,12 @@ class ChaosStep:
                 # stops beating AND never returns within the deadline — the
                 # exact shape of a peer dead mid-collective
                 time.sleep(float(spec.payload.get("hang_seconds", 3600.0)))
+            if spec.kind == "proc_preempt":
+                # a preemption notice, self-delivered: the Python-level
+                # SIGTERM handler (PreemptionGuard) runs before the step
+                # below, flags the request, and the loop commits the
+                # emergency checkpoint right after this step completes
+                os.kill(os.getpid(), signal.SIGTERM)
             if spec.kind == "step_transient":
                 raise ChaosTransientError(
                     f"injected transient at step {i} (rank {self._rank})"

@@ -14,6 +14,14 @@ Two wrappers, both opt-in from ``resilient_train_loop``:
   global batch (a short batch would either recompile or silently skew the
   global-batch accounting).
 
+Plus the preemption-grace side of elastic recovery:
+
+- :class:`PreemptionGuard` — a SIGTERM handler that converts a preemption
+  notice into a request for an emergency COMMITTED checkpoint at the next
+  step boundary (``resilient_train_loop`` polls it), so a supervisor's
+  graceful SIGTERM-then-SIGKILL shutdown loses zero completed steps
+  instead of everything since the last epoch boundary.
+
 Every recovery action is a ``FailureEvent`` through telemetry, so the run
 log shows fault → detection → recovery with timestamps.
 """
@@ -21,12 +29,79 @@ log shows fault → detection → recovery with timestamps.
 from __future__ import annotations
 
 import math
+import signal
 from typing import Any, Callable, Iterator, Optional
 
 
 class NonFiniteLossError(RuntimeError):
     """A step reported a NaN/inf loss — treated as transient: the state
     that produced it is discarded and the step re-run on its inputs."""
+
+
+class PreemptionGuard:
+    """SIGTERM → "checkpoint at the next step boundary, then stop".
+
+    Signal handlers cannot safely save a checkpoint (the step may be
+    mid-execution, the state half-donated), so the handler only raises a
+    flag; ``resilient_train_loop`` polls :attr:`requested` after every
+    completed step and performs the emergency committed save itself, sets
+    :attr:`checkpoint_saved`, and returns early. The worker process then
+    exits with ``resilience.chaos.PREEMPT_EXIT_CODE`` so the supervisor
+    can tell a graceful death from a hard one.
+
+    Use as a context manager (or ``install()``/``uninstall()``) so the
+    previous SIGTERM disposition is restored — important in test processes.
+    """
+
+    def __init__(self, telemetry: Any = None, rank: int = 0,
+                 incarnation: int = 0, label: str = "train"):
+        self._telemetry = telemetry
+        self._rank = rank
+        self._incarnation = incarnation
+        self._label = label
+        self._prev = None
+        self._installed = False
+        self._requested = False
+        self.checkpoint_saved = False
+
+    @property
+    def requested(self) -> bool:
+        return self._requested
+
+    def request(self) -> None:
+        """Raise the flag without a signal — the handler body, also usable
+        directly (e.g. by a cloud preemption-notice poller)."""
+        self._requested = True
+        if self._telemetry is not None:
+            from ..observe import FailureEvent
+
+            self._telemetry.emit(
+                FailureEvent(
+                    kind="preempt_notice", label=self._label,
+                    rank=self._rank, incarnation=self._incarnation,
+                    message="SIGTERM received; emergency checkpoint at next"
+                            " step boundary",
+                )
+            )
+
+    def _handle(self, signum, frame) -> None:
+        self.request()
+
+    def install(self) -> "PreemptionGuard":
+        self._prev = signal.signal(signal.SIGTERM, self._handle)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            signal.signal(signal.SIGTERM, self._prev or signal.SIG_DFL)
+            self._installed = False
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
 
 
 class GuardedStep:

@@ -1,0 +1,309 @@
+"""Deterministic state resharding: resume a W-rank checkpoint at W' ≤ W.
+
+PR 2's supervisor shrinks the world when a rank is permanently gone, but its
+restart was lossy by its own admission: per-rank sharded state — the PowerSGD
+error-feedback memories above all — was discarded on any world-size change.
+The EF memory IS the accumulated unsent gradient (Vogels et al., 2019), so
+dropping it silently breaks the error-feedback convergence guarantee. This
+module makes a world change a *resharding* instead of a reset:
+
+- **EF memories fold by summation.** The invariant worth preserving is that
+  the sum of per-rank memories equals the total unsent error. Old ranks
+  ``0..W-W'`` are folded into new rank 0 by left-to-right fp32 addition and
+  the remaining old ranks shift down one-to-one, so the sequential
+  rank-order sum (:func:`memory_total`) is the SAME chain of fp32 additions
+  before and after — bit-for-bit, not merely approximately.
+- **Per-worker BN statistics merge by weighted average**, weighted by the
+  samples each source rank has seen (equal partitions ⇒ equal weights).
+- **Data partitions re-split, not reshuffled.** ``DataPartitioner``'s fixed
+  seed-1234 permutation is world-independent, so re-cutting it into W'
+  equal fractions (``data.partition.elastic_assignments``) keeps the W'
+  survivors covering the dataset disjointly with zero coordination.
+- **Global batch is preserved.** The effective global batch (and therefore
+  the LR-schedule semantics) stays fixed across the shrink; per-rank
+  gradient-accumulation steps are rescaled (:func:`rescale_accum_steps`)
+  so per-device microbatches do not balloon.
+- **Per-rank RNG keys re-derive** via ``fold_in(key, rank)`` then
+  ``fold_in(·, incarnation)`` — no stored per-rank key material needed.
+
+The topology that makes any of this decidable at restore time is recorded
+in the checkpoint itself (``utils.checkpoint`` writes a ``_TOPOLOGY.json``
+protocol file from :func:`make_topology`); ``restore_latest`` refuses a
+silent cross-topology restore and routes through
+:func:`reshard_from_checkpoint` instead.
+
+jax-free at import time (numpy only), like the rest of ``resilience`` —
+jax is imported lazily inside the functions that touch pytrees.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+TOPOLOGY_VERSION = 1
+
+
+# -- rank folding geometry ----------------------------------------------------
+
+def fold_groups(old_world: int, new_world: int) -> List[List[int]]:
+    """Which old ranks each new rank absorbs. New rank 0 takes the leading
+    ``W - W' + 1`` old ranks; every other new rank takes exactly one old
+    rank, in order. This prefix grouping is what makes the fold's
+    sequential-sum invariant exact in floating point (see module docstring),
+    not just mathematically true."""
+    if new_world < 1:
+        raise ValueError(f"new_world must be >= 1, got {new_world}")
+    if new_world > old_world:
+        raise ValueError(
+            f"cannot reshard {old_world} ranks up to {new_world} — elastic"
+            f" recovery only shrinks (W' <= W)"
+        )
+    head = old_world - new_world + 1
+    return [list(range(head))] + [[head + d - 1] for d in range(1, new_world)]
+
+
+def fold_memories(memories: Any, new_world: int) -> Any:
+    """Fold the leading per-rank axis of every EF-memory leaf from W rows to
+    ``new_world`` rows by summation, on host, in the leaf's own dtype, with
+    a fixed left-to-right addition order."""
+    import jax
+
+    def _fold(leaf):
+        arr = np.asarray(jax.device_get(leaf))
+        old_world = arr.shape[0]
+        if old_world == new_world:
+            return arr
+        groups = fold_groups(old_world, new_world)
+        head = arr[0].copy()
+        for s in groups[0][1:]:
+            head = head + arr[s]
+        return np.concatenate([head[None], arr[old_world - new_world + 1:]], axis=0)
+
+    return jax.tree_util.tree_map(_fold, memories)
+
+
+def memory_total(memories: Any) -> Any:
+    """The conserved quantity: per-leaf sum over the rank axis, computed as
+    a strict left-to-right sequential fold so the result is a deterministic
+    fp32 value — the property test compares its bytes before/after a fold."""
+    import jax
+
+    def _total(leaf):
+        arr = np.asarray(jax.device_get(leaf))
+        total = arr[0].copy()
+        for s in range(1, arr.shape[0]):
+            total = total + arr[s]
+        return total
+
+    return jax.tree_util.tree_map(_total, memories)
+
+
+def merge_model_state(
+    model_state: Any,
+    new_world: int,
+    samples_per_rank: Optional[Sequence[int]] = None,
+) -> Any:
+    """Merge per-worker model state (BN running mean/var) down to
+    ``new_world`` rows: each fold group's floating leaves are averaged
+    weighted by the samples its source ranks saw (``None`` = equal weights,
+    exact for equal partitions); integer leaves keep the first source's
+    value. Running variances merged this way are approximate — the standard
+    BN-stat treatment — and self-heal with momentum within a few steps."""
+    import jax
+
+    def _merge(leaf):
+        arr = np.asarray(jax.device_get(leaf))
+        old_world = arr.shape[0]
+        if old_world == new_world:
+            return arr
+        groups = fold_groups(old_world, new_world)
+        weights = np.asarray(
+            samples_per_rank
+            if samples_per_rank is not None
+            else [1.0] * old_world,
+            dtype=np.float64,
+        )
+        if weights.shape[0] != old_world:
+            raise ValueError(
+                f"samples_per_rank has {weights.shape[0]} entries for"
+                f" {old_world} source ranks"
+            )
+        rows = []
+        for group in groups:
+            if len(group) == 1 or not np.issubdtype(arr.dtype, np.floating):
+                rows.append(arr[group[0]])
+                continue
+            gw = weights[group].reshape((len(group),) + (1,) * (arr.ndim - 1))
+            merged = (arr[group].astype(np.float64) * gw).sum(axis=0)
+            rows.append((merged / gw.sum()).astype(arr.dtype))
+        return np.stack(rows, axis=0)
+
+    if model_state is None:
+        return None
+    return jax.tree_util.tree_map(_merge, model_state)
+
+
+# -- global-batch preservation ------------------------------------------------
+
+def rescale_accum_steps(
+    global_batch: int, old_world: int, new_world: int, old_accum: int = 1
+) -> int:
+    """Gradient-accumulation steps for the shrunk world that keep the
+    effective global batch (and so the LR-schedule semantics) unchanged
+    while holding per-device microbatches at or below their old size.
+
+    The ideal is ``old_accum * W / W'`` (identical per-device microbatch);
+    the returned value is the smallest feasible accumulation at or above it
+    — feasible meaning the trainer's batch contract still holds:
+    ``global_batch % accum == 0`` and the microbatch splits over ``W'``
+    devices. Falls back to ``old_accum`` when no feasible rescale exists
+    (the caller's global batch cannot shard over W' at all)."""
+    if old_accum < 1:
+        raise ValueError(f"old_accum must be >= 1, got {old_accum}")
+    target = old_accum * old_world / new_world
+    k = max(old_accum, math.ceil(target))
+    while k * new_world <= global_batch:
+        if global_batch % k == 0 and (global_batch // k) % new_world == 0:
+            return k
+        k += 1
+    return old_accum
+
+
+# -- per-rank RNG lineage -----------------------------------------------------
+
+def derive_rank_key(key: Any, rank: int, incarnation: int = 0):
+    """Re-derive a rank's PRNG key from the run's base key (or integer
+    seed): ``fold_in(fold_in(key, rank), incarnation)``. No per-rank key is
+    ever stored — any (rank, incarnation) pair is reconstructible after an
+    arbitrary sequence of world changes."""
+    import jax
+
+    if isinstance(key, int):
+        key = jax.random.PRNGKey(key)
+    return jax.random.fold_in(jax.random.fold_in(key, rank), incarnation)
+
+
+# -- the topology record ------------------------------------------------------
+
+def make_topology(
+    world_size: int,
+    global_batch: Optional[int] = None,
+    accum_steps: int = 1,
+    data_seed: Optional[int] = None,
+    partition_seed: int = 1234,
+    bits_per_step: Optional[int] = None,
+    rng_seed: Optional[int] = None,
+    incarnation: int = 0,
+    epoch_cursor: Optional[Dict[str, int]] = None,
+) -> Dict[str, Any]:
+    """The topology record a checkpoint is tagged with (written as the
+    ``_TOPOLOGY.json`` protocol file by ``utils.checkpoint``): everything a
+    restore at a different world size needs to decide whether and how to
+    reshard. ``epoch_cursor`` (``{"epoch": e, "batches_done": n}``) is set
+    by a preemption-grace mid-epoch save; ``None`` means the checkpoint sits
+    on an epoch boundary."""
+    return {
+        "version": TOPOLOGY_VERSION,
+        "world_size": int(world_size),
+        "global_batch": None if global_batch is None else int(global_batch),
+        "accum_steps": int(accum_steps),
+        "data_seed": None if data_seed is None else int(data_seed),
+        "partition_seed": int(partition_seed),
+        "bits_per_step": None if bits_per_step is None else int(bits_per_step),
+        "rng_seed": None if rng_seed is None else int(rng_seed),
+        "incarnation": int(incarnation),
+        # per-rank shard layout: rank r owns row r of the leading axis of
+        # every per-worker leaf (memories, per-worker model_state)
+        "shard_layout": [
+            {"rank": r, "per_worker_row": r} for r in range(int(world_size))
+        ],
+        "epoch_cursor": dict(epoch_cursor) if epoch_cursor else None,
+    }
+
+
+# -- resharding a whole TrainState --------------------------------------------
+
+def _template_world(template: Any) -> int:
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(getattr(template, "memories", None))
+    if not leaves:
+        raise TypeError(
+            "reshard needs a TrainState-like template with per-rank"
+            " `memories` (got no memory leaves to read the world size from)"
+        )
+    return int(leaves[0].shape[0])
+
+
+def reshard_train_state(
+    state: Any,
+    new_world: int,
+    samples_per_rank: Optional[Sequence[int]] = None,
+) -> Any:
+    """Fold a restored W-rank ``TrainState`` down to ``new_world`` ranks:
+    memories fold by summation, per-worker model state merges by weighted
+    average, replicated leaves (params, momenta, reducer warm-start) pass
+    through untouched."""
+    if not hasattr(state, "_fields") or not hasattr(state, "memories"):
+        raise TypeError(
+            f"reshard_train_state expects a TrainState, got {type(state).__name__}"
+        )
+    import jax
+
+    folded = fold_memories(state.memories, new_world)
+    model_state = state.model_state
+    if model_state is not None and jax.tree_util.tree_leaves(model_state):
+        model_state = merge_model_state(
+            model_state, new_world, samples_per_rank=samples_per_rank
+        )
+    return state._replace(memories=folded, model_state=model_state)
+
+
+def widen_template(template: Any, old_world: int) -> Any:
+    """A restore template for the ORIGINAL world: every per-rank leaf of
+    ``template`` (built for the new, smaller world) gets its leading axis
+    re-widened to ``old_world`` so orbax can read the W-rank checkpoint
+    into it before the fold."""
+    import jax
+
+    def _widen(leaf):
+        arr = np.asarray(jax.device_get(leaf))
+        return np.zeros((old_world,) + arr.shape[1:], arr.dtype)
+
+    memories = jax.tree_util.tree_map(_widen, template.memories)
+    model_state = template.model_state
+    if model_state is not None and jax.tree_util.tree_leaves(model_state):
+        model_state = jax.tree_util.tree_map(_widen, model_state)
+    return jax.device_get(template)._replace(
+        memories=memories, model_state=model_state
+    )
+
+
+def reshard_from_checkpoint(
+    path: str,
+    template: Any,
+    saved_topology: Optional[Dict] = None,
+    samples_per_rank: Optional[Sequence[int]] = None,
+) -> Any:
+    """The resharder ``restore_latest`` routes through on a topology
+    mismatch: restore the checkpoint at ``path`` into a template widened to
+    its RECORDED world size, then fold it down to the world ``template`` was
+    built for. Returns host arrays, like :func:`utils.checkpoint.restore_checkpoint`."""
+    from ..utils.checkpoint import read_topology, restore_checkpoint
+
+    topo = saved_topology if saved_topology is not None else read_topology(path)
+    if topo is None or topo.get("world_size") is None:
+        raise ValueError(
+            f"checkpoint {path} carries no topology record — cannot reshard"
+            f" (only topology-tagged checkpoints are world-size-elastic)"
+        )
+    old_world = int(topo["world_size"])
+    new_world = _template_world(template)
+    wide = widen_template(template, old_world)
+    state = restore_checkpoint(path, wide)
+    return reshard_train_state(
+        state, new_world, samples_per_rank=samples_per_rank
+    )

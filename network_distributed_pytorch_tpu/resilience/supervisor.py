@@ -16,8 +16,19 @@ workers are relaunched with the new ``--num-processes``; each worker
 re-derives its mesh, data partition, wire ledger, and global-batch
 accounting from the world size it was launched with, so the accounting is
 recomputed — not patched — for the new world. Per-worker state that is
-keyed by world size (EF memories sharded over ranks) starts fresh;
-replicated state (params, momenta) resumes from the committed checkpoint.
+keyed by world size (EF memories sharded over ranks) is RESHARDED, not
+dropped: a topology-tagged checkpoint restored at the shrunk world routes
+through ``resilience.reshard`` (EF memories fold by summation — the sum
+invariant error feedback depends on is preserved bit-for-bit — and
+per-worker stats merge), while replicated state (params, momenta) resumes
+directly.
+
+Shutdowns are graceful-first: every supervisor-initiated kill is SIGTERM,
+a ``term_grace_s`` window for the worker's ``PreemptionGuard`` to commit
+an emergency checkpoint, then SIGKILL only if the worker overstays. Worker
+deaths are classified graceful (exit 0, ``PREEMPT_EXIT_CODE``, or death by
+SIGTERM) vs hard in the emitted events, which is what the report timeline
+renders.
 
 jax-free: the parent process never initializes a backend (heartbeat files
 are read directly rather than through ``utils.failure``, whose package
@@ -60,6 +71,7 @@ class SupervisorConfig:
     heartbeat_dir: Optional[str] = None
     heartbeat_timeout_s: Optional[float] = None  # None = no hang detection
     startup_grace_s: float = 60.0  # first-beat allowance after (re)spawn
+    term_grace_s: float = 5.0  # SIGTERM -> SIGKILL escalation window
     allow_degraded: bool = True
     min_world_size: int = 1
     deadline_s: Optional[float] = None  # whole-run wall clock cap
@@ -180,13 +192,33 @@ class Supervisor:
             return age > cfg.startup_grace_s + cfg.heartbeat_timeout_s
         return time.time() - beat.get("ts", 0.0) > cfg.heartbeat_timeout_s
 
-    @staticmethod
-    def _kill(w: _Worker) -> None:
+    def _kill(self, w: _Worker) -> str:
+        """Graceful-first shutdown: SIGTERM, wait ``term_grace_s`` for the
+        worker to commit its emergency checkpoint and exit (the
+        ``PreemptionGuard`` contract), SIGKILL only on overstay. Returns
+        ``"graceful"`` or ``"hard"`` — how the worker actually died."""
         try:
+            w.proc.terminate()
+            try:
+                w.proc.wait(timeout=max(0.0, self.config.term_grace_s))
+                return "graceful"
+            except subprocess.TimeoutExpired:
+                pass
             w.proc.kill()
             w.proc.wait(timeout=10)
         except (OSError, subprocess.TimeoutExpired):
             pass
+        return "hard"
+
+    @staticmethod
+    def _death(rc: Optional[int]) -> str:
+        """Classify an observed exit code: clean completion, a honored
+        SIGTERM (with or without the preempt exit code), or anything else
+        (crash, SIGKILL, chaos exit)."""
+        from .chaos import PREEMPT_EXIT_CODE
+
+        graceful = rc in (0, PREEMPT_EXIT_CODE, -int(signal.SIGTERM))
+        return "graceful" if graceful else "hard"
 
     # -- the run loop -------------------------------------------------------
     def run(self) -> SupervisorResult:
@@ -217,7 +249,11 @@ class Supervisor:
             )
             for w in workers.values():
                 if not w.done:
-                    self._kill(w)
+                    how = self._kill(w)
+                    self._emit(
+                        "worker_term", rank=w.rank, incarnation=w.incarnation,
+                        message=f"{how} shutdown for world shrink",
+                    )
             return True
 
         while True:
@@ -254,7 +290,7 @@ class Supervisor:
                 exit_codes[rank] = rc if rc is not None else -1
                 self._emit(
                     "worker_exit", rank=rank, incarnation=w.incarnation,
-                    message=f"exit code {rc}",
+                    message=f"exit code {rc} ({self._death(rc)} death)",
                 )
                 if w.restarts >= cfg.max_restarts:
                     dead_rank = rank

@@ -41,10 +41,19 @@ import orbax.checkpoint as ocp
 
 COMMITTED_MARKER = "_COMMITTED"
 CHECKSUM_MANIFEST = "_CHECKSUMS.json"
+TOPOLOGY_RECORD = "_TOPOLOGY.json"
 _TMP_PREFIX = "_tmp."
 # files our own protocol adds on top of what orbax wrote — excluded from the
 # manifest so the hash set covers exactly the checkpoint payload
-_PROTOCOL_FILES = {COMMITTED_MARKER, CHECKSUM_MANIFEST}
+_PROTOCOL_FILES = {COMMITTED_MARKER, CHECKSUM_MANIFEST, TOPOLOGY_RECORD}
+
+
+class TopologyMismatchError(ValueError):
+    """The checkpoint was written at a different world size than the
+    template it is being restored into. A plain restore here would either
+    fail deep inside orbax or, worse, silently mis-assign per-rank shards —
+    route through ``resilience.reshard.reshard_from_checkpoint`` (or pass a
+    ``resharder`` to :func:`restore_latest`) instead."""
 
 
 def _sha256_file(path: str) -> str:
@@ -108,7 +117,65 @@ def verify_checkpoint(path: str) -> Tuple[bool, str]:
     return True, "ok"
 
 
-def _commit(tmp: str, final: str, step: Optional[int]) -> None:
+def write_topology(path: str, topology: Dict[str, Any]) -> str:
+    """Tag a checkpoint directory with its topology record (world size,
+    shard layout, global batch, accumulation, seed lineage, epoch cursor —
+    ``resilience.reshard.make_topology`` builds the dict). A protocol file,
+    like the marker: excluded from the payload manifest."""
+    full = os.path.join(path, TOPOLOGY_RECORD)
+    with open(full, "w") as f:
+        json.dump(topology, f, indent=2, sort_keys=True)
+    return full
+
+
+def read_topology(path: str) -> Optional[Dict[str, Any]]:
+    """The topology record of a checkpoint directory, or None for an
+    untagged (pre-elastic) checkpoint."""
+    try:
+        with open(os.path.join(path, TOPOLOGY_RECORD)) as f:
+            topo = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return topo if isinstance(topo, dict) else None
+
+
+def _template_world(template: Any) -> Optional[int]:
+    # TrainState-like templates carry the world size as the leading axis of
+    # every per-rank memories leaf; anything else is topology-agnostic
+    memories = getattr(template, "memories", None)
+    if memories is None:
+        return None
+    leaves = jax.tree_util.tree_leaves(memories)
+    if not leaves:
+        return None
+    return int(leaves[0].shape[0])
+
+
+def check_topology(path: str, template: Any) -> Optional[Dict[str, Any]]:
+    """Compare a checkpoint's recorded world size against the template's.
+    Returns the topology record (None for untagged checkpoints); raises
+    :class:`TopologyMismatchError` on a cross-topology restore attempt."""
+    topo = read_topology(path)
+    if topo is None:
+        return None
+    saved = topo.get("world_size")
+    have = _template_world(template)
+    if saved is not None and have is not None and int(saved) != have:
+        raise TopologyMismatchError(
+            f"topology mismatch: checkpoint {os.path.basename(path)} was"
+            f" written at world size {saved}, template expects {have} —"
+            f" refusing the silent cross-topology restore; reshard via"
+            f" resilience.reshard.reshard_from_checkpoint"
+        )
+    return topo
+
+
+def _commit(
+    tmp: str, final: str, step: Optional[int],
+    topology: Optional[Dict[str, Any]] = None,
+) -> None:
+    if topology is not None:
+        write_topology(tmp, topology)
     write_manifest(tmp)
     with open(os.path.join(tmp, COMMITTED_MARKER), "w") as f:
         json.dump({"step": step, "ts": time.time()}, f)
@@ -122,12 +189,15 @@ def save_checkpoint(
     state: Any,
     step: Optional[int] = None,
     keep_last: Optional[int] = None,
+    topology: Optional[Dict[str, Any]] = None,
     _abort_before_commit: bool = False,
 ) -> str:
     """Save a state pytree — a ``TrainState`` or any experiment carry —
     (blocking), via the atomic commit protocol above. Returns the final
     checkpoint path. ``keep_last`` garbage-collects all but the newest K
-    committed steps after the save lands.
+    committed steps after the save lands. ``topology`` tags the checkpoint
+    with its world-size record (see :func:`write_topology`), which is what
+    makes it restorable at a SHRUNK world through the resharder.
 
     ``_abort_before_commit`` is the fault-injection seam: it returns after
     the data write but BEFORE the manifest/marker/rename, leaving exactly
@@ -146,7 +216,7 @@ def save_checkpoint(
         # context exit waits for the async write — data is on disk here
     if _abort_before_commit:
         return tmp
-    _commit(tmp, final, step)
+    _commit(tmp, final, step, topology=topology)
     if keep_last is not None and step is not None:
         gc_checkpoints(root, keep_last)
     return final
@@ -155,7 +225,10 @@ def save_checkpoint(
 def restore_checkpoint(path: str, template: Any) -> Any:
     """Restore into the shapes/dtypes (and shardings) of ``template`` —
     build the template the same way the original run built its initial
-    state (e.g. ``CompiledStep.init_state``)."""
+    state (e.g. ``CompiledStep.init_state``). A topology-tagged checkpoint
+    written at a different world size raises
+    :class:`TopologyMismatchError` instead of restoring garbage."""
+    check_topology(os.path.abspath(path), template)
     with ocp.StandardCheckpointer() as ckptr:
         restored = ckptr.restore(os.path.abspath(path), template)
     # orbax hands back arrays COMMITTED to one device; the jitted shard_map
@@ -189,7 +262,14 @@ def restore_checkpoint_sharded(path: str, template: Any) -> Any:
     leaves carrying ``.sharding``); orbax reads each leaf shard-by-shard
     onto its target devices, so per-host memory is the SHARD size, not the
     global size.
+
+    Like :func:`restore_checkpoint`, a topology-tagged checkpoint from a
+    different world size raises :class:`TopologyMismatchError` — at pod
+    scale a silent wrong-world restore would hand every host someone
+    else's shards.
     """
+    check_topology(os.path.abspath(path), template)
+
     def _abstract(x):
         if isinstance(x, jax.ShapeDtypeStruct):
             return x
@@ -232,12 +312,21 @@ def restore_latest(
     telemetry: Any = None,
     label: str = "",
     sharded: bool = False,
+    resharder: Optional[Any] = None,
 ) -> Optional[Tuple[Any, int]]:
     """Restore the newest checkpoint that passes integrity verification,
     walking backwards through older committed steps when the newest is
     corrupt (bit-flip, torn payload) or unrestorable. Every skip emits a
     ``FailureEvent(kind="checkpoint_fallback")`` through ``telemetry``.
-    Returns ``(state, step)`` or None when nothing restorable exists."""
+    Returns ``(state, step)`` or None when nothing restorable exists.
+
+    A topology-tagged checkpoint from a DIFFERENT world size is never
+    silently restored: with ``resharder`` (a ``(path, saved_topology) ->
+    state`` callable, typically wrapping
+    ``resilience.reshard.reshard_from_checkpoint``) the restore routes
+    through it; without one, :class:`TopologyMismatchError` propagates —
+    a world change is a real event the caller must opt into handling,
+    not a corrupt file to fall back from."""
     from ..observe import FailureEvent
 
     restore = restore_checkpoint_sharded if sharded else restore_checkpoint
@@ -246,6 +335,10 @@ def restore_latest(
         if ok:
             try:
                 return restore(path, template), step
+            except TopologyMismatchError:
+                if resharder is None:
+                    raise
+                return resharder(path, read_topology(path)), step
             except Exception as e:  # torn payload orbax can't parse
                 reason = f"restore failed: {type(e).__name__}: {e}"
         if telemetry is not None:

@@ -63,12 +63,30 @@ def _fmt_bytes(n: float) -> str:
 # recovery on the same rank close it with measurable latencies
 _DETECTION_KINDS = {
     "worker_exit", "worker_hang", "watchdog_timeout", "bad_batch_dropped",
-    "audit_error", "stale_peer",
+    "audit_error", "stale_peer", "preempt_notice",
 }
 _RECOVERY_KINDS = {
     "retry", "checkpoint_fallback", "worker_restart", "resumed",
-    "degraded_restart", "worker_complete", "run_complete",
+    "resharded", "preempt_checkpoint", "degraded_restart",
+    "worker_complete", "run_complete",
 }
+# supervisor-observed worker deaths; their messages carry the supervisor's
+# graceful-vs-hard classification (SIGTERM honored within the grace window
+# vs SIGKILL/crash), which the timeline tallies
+_DEATH_KINDS = {"worker_exit", "worker_term"}
+
+
+def _death_counts(events: List[Dict]) -> Dict[str, int]:
+    counts = {"graceful": 0, "hard": 0}
+    for f in events:
+        if f.get("kind") not in _DEATH_KINDS:
+            continue
+        msg = f.get("message", "") or ""
+        if "graceful" in msg:
+            counts["graceful"] += 1
+        elif "hard" in msg:
+            counts["hard"] += 1
+    return counts
 
 
 def _same_rank(a: Dict, b: Dict) -> bool:
@@ -104,6 +122,13 @@ def render_failure_timeline(failures: List[Dict]) -> List[str]:
         tail = " ".join(x for x in (detail, msg) if x)
         lines.append(
             f"  {when}  {f.get('kind', '?'):<20} [{who}{inc}]{at}  {tail}"
+        )
+
+    deaths = _death_counts(ordered)
+    if deaths["graceful"] or deaths["hard"]:
+        lines.append(
+            f"  deaths: {deaths['graceful']} graceful (SIGTERM honored /"
+            f" clean exit), {deaths['hard']} hard (SIGKILL / crash)"
         )
 
     # latency spans: injected -> first detection -> first recovery (same rank)

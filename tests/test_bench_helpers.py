@@ -195,7 +195,8 @@ def _run_orchestrator(bench, tmp_path, spawns):
 
 def test_orchestrator_happy_path(monkeypatch, tmp_path):
     """One child serves every phase; a cumulative line lands after each;
-    the tail line is the richest and is final (partial=False)."""
+    the full record is final (partial=False) and the very last line is the
+    bounded summary digest of it."""
     bench = _load_bench(monkeypatch)
     all_phases = list(bench.PHASES)
     lines = _run_orchestrator(bench, tmp_path, [(all_phases, [
@@ -210,14 +211,21 @@ def test_orchestrator_happy_path(monkeypatch, tmp_path):
     ])])
     # first line precedes any backend touch and is already valid
     assert lines[0]["partial"] is True and lines[0]["value"] == 0.0
-    tail = lines[-1]
+    tail = lines[-2]  # the authoritative full record
     assert tail["partial"] is False
     assert tail["value"] == 1000.0 and tail["vs_baseline"] == 10.0
     assert tail["device"] == "TPU v5e"
     assert tail["gpt"]["mfu"] == 0.35
     assert all(tail["phases"][p] == "ok" for p in bench.PHASES)
-    # every line is a self-contained superset of the one before it
-    assert len(lines) == 2 + len(bench.PHASES)
+    # the LAST line is the bounded summary: same headline numbers, always
+    # small enough for a fixed-size stdout tail
+    summary = lines[-1]
+    assert summary["summary"] is True
+    assert summary["value"] == 1000.0 and summary["vs_baseline"] == 10.0
+    assert summary["phases"]["flagship"] == "ok"
+    assert len(json.dumps(summary)) <= bench._SUMMARY_LIMIT
+    # per-phase cumulative lines + first line + full record + summary
+    assert len(lines) == 3 + len(bench.PHASES)
 
 
 def test_orchestrator_survives_hang_and_respawns(monkeypatch, tmp_path):
@@ -396,9 +404,9 @@ def test_orchestrator_waits_for_abandoned_drain(monkeypatch, tmp_path):
          "data": {"drained": ["gpt"], "still_alive": []}},
         None,  # child exits on its own AFTER draining
     ])])
-    tail = lines[-1]
-    assert tail["abandoned_drain"] == {"drained": ["gpt"], "still_alive": []}
-    assert tail["phases"]["gpt"].startswith("error")
+    full = lines[-2]  # abandoned_drain is full-record detail, not summary
+    assert full["abandoned_drain"] == {"drained": ["gpt"], "still_alive": []}
+    assert full["phases"]["gpt"].startswith("error")
     assert _FakeChild.killed == [True]  # backstop fired once, after EOF
 
 
@@ -512,6 +520,134 @@ def test_init_hang_is_decisive_one_probe_engages_fallback(monkeypatch, tmp_path)
     assert tail["tpu_error"].startswith("_InitTimeout")
     assert tail["device"] == "cpu" and tail["value"] == 50.0
     os.environ.pop("BENCH_PLATFORM", None)  # orchestrate mutated real env
+
+
+def test_flops_band_disjoint_windows_unchanged(monkeypatch):
+    """At the production CHUNK (>= 8) the two ±2x windows are disjoint and
+    the helper reproduces the old classification exactly."""
+    bench = _load_bench(monkeypatch)
+    assert bench._flops_band(50.0, 50) == "trip"
+    assert bench._flops_band(25.0, 50) == "trip"   # lower window edge
+    assert bench._flops_band(100.0, 50) == "trip"  # upper window edge
+    assert bench._flops_band(1.0, 50) == "once"
+    assert bench._flops_band(0.5, 50) == "once"
+    assert bench._flops_band(2.0, 50) == "once"
+    assert bench._flops_band(7.0, 50) is None      # between the windows
+    assert bench._flops_band(0.4, 50) is None      # below both
+    assert bench._flops_band(101.0, 50) is None    # above both
+    assert bench._flops_band(0.0, 50) is None      # degenerate input
+
+
+def test_flops_band_small_chunk_overlap_resolved(monkeypatch):
+    """The bug: for CHUNK <= 4 the windows [chunk/2, 2*chunk] and [0.5, 2]
+    OVERLAP, and the old ``if`` ordering classified every overlap ratio as
+    trip-multiplied — silently dividing a count-once flops figure by
+    chunk. The helper resolves the overlap by nearest band center in log
+    space."""
+    bench = _load_bench(monkeypatch)
+    # chunk=2: 1.2 is nearer 1 than 2 (the old code called it "trip")
+    assert bench._flops_band(1.2, 2) == "once"
+    assert bench._flops_band(1.5, 2) == "trip"  # nearer 2 in log space
+    assert bench._flops_band(1.9, 2) == "trip"
+    # chunk=4: the geometric midpoint of the bands is 2.0 — ties go trip
+    assert bench._flops_band(1.9, 4) == "once"
+    assert bench._flops_band(2.0, 4) == "trip"
+    assert bench._flops_band(2.1, 4) == "trip"
+    # chunk=1: bands coincide; either label divides by 1 — same number
+    assert bench._flops_band(1.0, 1) == "trip"
+
+
+def _worst_case_record(bench):
+    """A cumulative record padded to every observed maximum at once: long
+    error strings at their truncation caps, full per-dispatch time lists,
+    every artifact pointer, six error-status phases."""
+    out = {
+        "metric": "cifar10_resnet50_train_imgs_per_sec",
+        "value": 123456.78, "unit": "imgs/sec", "vs_baseline": 1234.567,
+        "partial": False, "wall_s": 869.9,
+        "device": "TPU v5 litepod-256 " + "d" * 100,
+        "platform": "tpu", "n_devices": 256, "preset": "full",
+        "value_tier": "cpu-smoke-fallback",
+        "flagship_imgs_per_sec": 35000.12, "step_time_ms": 7.3142,
+        "flagship_reps": 64,
+        "flagship_imgs_per_sec_min": 22800.01,
+        "flagship_imgs_per_sec_max": 35000.12,
+        "dispatch_times_ms": [round(7.31 + i / 100, 2) for i in range(64)],
+        "baseline_imgs_per_sec": 40.25, "baseline_step_time_ms": 6360.2484,
+        "baseline_imgs_per_sec_min": 38.11, "baseline_imgs_per_sec_max": 44.92,
+        "baseline_passes": [round(38.0 + i / 10, 2) for i in range(16)],
+        "mfu": 0.4123, "flops_per_step": 1.039e10,
+        "flops_chunk_ratio": 49.97,
+        "flops_method": ("hlo scan-trip-multiplied (cross-check "
+                         "unavailable: " + "e" * 160)[:160],
+        "fp32_scanned_imgs_per_sec": 9000.5,
+        "fp32_dispatch_times_ms": [round(28.0 + i, 2) for i in range(16)],
+        "tpu_error": "E" * 400,  # the child-side truncation cap
+        "abandoned_drain": {"drained": ["gpt", "flagship_crosscheck"],
+                            "still_alive": ["overlap"]},
+        "concurrent_abandoned": ["gpt"],
+        "gpt": {"model": "gpt2-small-124m", "seq_len": 1024, "batch": 8,
+                "vocab": 50257, "mfu": 0.3512, "tokens_per_sec": 123456.7,
+                "step_time_ms": 66.4, "flops_per_step": 8.76e12,
+                "flops_method": "f" * 160},
+        "overlap": {"n_async_collectives": 0, "n_overlapped": 0,
+                    "compiled_collectives": 3, "combiner_merged": True},
+        "tpu_evidence": {"device": "TPU v5 lite", "recorded_unix": 1754000000,
+                         "phases_ok": ["allreduce", "flagship", "gpt",
+                                       "overlap", "powersgd", "probe"]},
+        "accuracy_study": {
+            t: {"accuracy_delta_pts": -0.42, "gradient_bytes_ratio": 122.8}
+            for t in ("cifar", "imdb", "imdb_wide")
+        },
+        "midround_chip_bench": {
+            "device": "TPU v5 lite", "recorded_unix": 1754000000,
+            "flagship_imgs_per_sec": 35000.12, "mfu": 0.41,
+            "baseline_imgs_per_sec": 40.25, "vs_baseline": 869.5,
+            "baseline_passes": [38.1, 40.25, 44.9],
+            "gpt": {"model": "gpt2-small-124m", "seq_len": 1024,
+                    "mfu": 0.35, "tokens_per_sec": 123456.7},
+        },
+    }
+    status = {p: ("error: " + "y" * 200)[:206] for p in bench.PHASES}
+    return out, status
+
+
+def test_compact_summary_bounded_on_worst_case(monkeypatch):
+    """The summary line serializes under _SUMMARY_LIMIT even when every
+    field of the record is at its maximum size, and still leads with the
+    headline numbers."""
+    bench = _load_bench(monkeypatch)
+    out, status = _worst_case_record(bench)
+    summary = bench._compact_summary(out, status)
+    line = json.dumps(summary)
+    assert len(line) <= bench._SUMMARY_LIMIT, len(line)
+    assert summary["summary"] is True
+    assert summary["metric"] == out["metric"]
+    assert summary["value"] == out["value"]
+    assert summary["vs_baseline"] == out["vs_baseline"]
+    # unbounded payloads must never ride the summary
+    for k in ("dispatch_times_ms", "baseline_passes", "abandoned_drain",
+              "midround_chip_bench", "accuracy_study"):
+        assert k not in summary
+
+
+def test_compact_summary_parses_from_2000_char_tail(monkeypatch):
+    """The driver's failure mode this line exists for: the full record has
+    outgrown a 2,000-char stdout tail, so the tail's last COMPLETE line
+    must be the summary and must round-trip json.loads."""
+    bench = _load_bench(monkeypatch)
+    out, status = _worst_case_record(bench)
+    full_line = json.dumps(out)
+    assert len(full_line) > 2000  # the premise: the record alone overflows
+    summary = bench._compact_summary(out, status)
+    stream = full_line + "\n" + json.dumps(summary) + "\n"
+    tail = stream[-2000:]
+    complete = [ln for ln in tail.split("\n") if ln]
+    # the first tail entry is the truncated full record — unparseable —
+    # but the LAST complete line is the whole summary
+    rec = json.loads(complete[-1])
+    assert rec == summary
+    assert rec["summary"] is True and rec["value"] == out["value"]
 
 
 @pytest.mark.slow

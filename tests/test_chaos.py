@@ -11,6 +11,7 @@ falls back to the previous good step with a telemetry trail.
 
 import json
 import os
+import signal
 
 import jax
 import jax.numpy as jnp
@@ -28,12 +29,14 @@ from network_distributed_pytorch_tpu.parallel.trainer import (
     stateless_loss,
 )
 from network_distributed_pytorch_tpu.resilience import (
+    PROCESS_FAULTS,
     ChaosPlan,
     ChaosStep,
     ChaosTransientError,
     FaultSpec,
     GuardedStep,
     NonFiniteLossError,
+    PreemptionGuard,
     chaos_batches,
     guarded_batches,
 )
@@ -49,6 +52,7 @@ from network_distributed_pytorch_tpu.utils.checkpoint import (
     gc_checkpoints,
     is_committed,
     latest_step_path,
+    read_topology,
     restore_latest,
     save_checkpoint,
     verify_checkpoint,
@@ -214,8 +218,76 @@ def test_chaos_full_matrix_combined(devices, tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# preemption grace: SIGTERM -> emergency checkpoint -> mid-epoch resume
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_preempt_grace_checkpoint_and_midepoch_resume(devices, tmp_path):
+    """A ``proc_preempt`` fault SIGTERMs the process mid-epoch; the
+    installed guard turns it into an emergency COMMITTED checkpoint at the
+    next step boundary (epoch cursor recorded), the loop stops early, and
+    the resumed run re-enters the SAME epoch at the right step — landing
+    bit-identical to an uninterrupted run."""
+    clean, _ = _run(tmp_path, "preempt-clean")
+
+    plan = ChaosPlan([FaultSpec(kind="proc_preempt", step=1)], seed=3)
+    step, params = _setup()
+    telemetry, sink = _telemetry()
+    root = str(tmp_path / "preempt")
+    with PreemptionGuard(telemetry=telemetry) as guard:
+        resilient_train_loop(
+            step, step.init_state(params), _batches, EPOCHS,
+            checkpoint_dir=root, telemetry=telemetry, run_name="preempt",
+            chaos_plan=plan, preemption_guard=guard,
+        )
+    assert guard.checkpoint_saved
+    kinds = _kinds(sink)
+    assert "chaos_injected" in kinds
+    assert "preempt_notice" in kinds
+    assert "preempt_checkpoint" in kinds
+    # the emergency save carries the mid-epoch cursor: 2 of 3 steps done
+    cursor = read_topology(os.path.join(root, "step_0"))["epoch_cursor"]
+    assert cursor == {"epoch": 0, "batches_done": 2}
+
+    step2, params2 = _setup()
+    telemetry2, sink2 = _telemetry()
+    resumed, _, start_epoch = resilient_train_loop(
+        step2, step2.init_state(params2), _batches, EPOCHS,
+        checkpoint_dir=root, telemetry=telemetry2, run_name="resume",
+    )
+    assert start_epoch == 0  # the preempted epoch, not the next one
+    msg = next(
+        r["message"] for r in sink2.records if r.get("kind") == "resumed"
+    )
+    assert "+2 steps" in msg
+    _assert_params_equal(resumed, clean)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(resumed.memories),
+        jax.tree_util.tree_leaves(clean.memories),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
 # chaos primitives (fast, no training loop)
 # ---------------------------------------------------------------------------
+
+def test_fault_kinds_include_proc_preempt():
+    assert "proc_preempt" in PROCESS_FAULTS
+    FaultSpec(kind="proc_preempt", step=0)  # accepted, not "unknown kind"
+
+
+def test_preemption_guard_turns_sigterm_into_flag():
+    prev = signal.getsignal(signal.SIGTERM)
+    telemetry, sink = _telemetry()
+    with PreemptionGuard(telemetry=telemetry, rank=1) as guard:
+        assert not guard.requested
+        os.kill(os.getpid(), signal.SIGTERM)  # the process survives this
+        assert guard.requested
+    assert signal.getsignal(signal.SIGTERM) == prev  # disposition restored
+    notices = [r for r in sink.records if r.get("kind") == "preempt_notice"]
+    assert len(notices) == 1
+    assert notices[0]["rank"] == 1
 
 def test_chaos_plan_roundtrip_and_once_semantics(tmp_path):
     plan = ChaosPlan(

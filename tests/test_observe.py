@@ -424,6 +424,34 @@ def test_report_failure_timeline(tmp_path):
     assert any("watchdog_timeout" in ln for ln in lines)
 
 
+def test_report_death_tally_graceful_vs_hard():
+    """The timeline tallies supervisor-observed deaths by the graceful/hard
+    classification carried in worker_exit/worker_term messages — other
+    kinds never count, even if their message mentions the words."""
+    report = _load_report_module()
+    failures = [
+        {"event": "failure", "kind": "worker_exit", "rank": 0,
+         "message": "exit code 75 (graceful death)", "ts": 1.0},
+        {"event": "failure", "kind": "worker_exit", "rank": 1,
+         "message": "exit code -9 (hard death)", "ts": 2.0},
+        {"event": "failure", "kind": "worker_term", "rank": 2,
+         "message": "graceful shutdown for world shrink", "ts": 3.0},
+        {"event": "failure", "kind": "resumed", "rank": 0,
+         "message": "a graceful restart that must NOT count", "ts": 4.0},
+    ]
+    lines = report.render_failure_timeline(failures)
+    tally = [ln for ln in lines if "deaths:" in ln]
+    assert len(tally) == 1
+    assert "2 graceful" in tally[0] and "1 hard" in tally[0]
+    # no deaths, no tally line
+    assert not any(
+        "deaths:" in ln
+        for ln in report.render_failure_timeline(
+            [{"event": "failure", "kind": "resumed", "ts": 1.0}]
+        )
+    )
+
+
 def test_report_percentiles_and_delta(tmp_path):
     report = _load_report_module()
     assert report.percentile([1.0, 2.0, 3.0, 4.0], 50) == pytest.approx(3.0)

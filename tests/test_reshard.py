@@ -1,0 +1,428 @@
+"""Elastic world-size-safe recovery: resharding math + topology protocol.
+
+Fast half: the ``resilience.reshard`` invariants as property tests — the
+EF-memory fold preserves the sequential rank-order sum BIT-FOR-BIT, the
+per-worker stat merge is the weighted average, the elastic re-split keeps
+exactly-once dataset coverage, the accumulation rescale preserves the
+global batch — plus the checkpoint topology protocol: a cross-world
+restore refuses loudly (``TopologyMismatchError``) unless routed through
+the resharder.
+
+Slow half: the end-to-end proof. A 4-rank run is preempted mid-epoch
+(``proc_preempt`` + ``PreemptionGuard`` → emergency committed checkpoint
+with an epoch cursor), then restarted at world 3: the restore reshards,
+the resumed run matches an uninterrupted world-3 run seeded with the same
+resharded state, and the ``resumed``/``resharded`` events land in the
+JSONL log.
+"""
+
+import json
+import os
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from network_distributed_pytorch_tpu.data import elastic_assignments
+from network_distributed_pytorch_tpu.experiments.common import (
+    resilient_train_loop,
+    train_loop,
+)
+from network_distributed_pytorch_tpu.models import SmallCNN
+from network_distributed_pytorch_tpu.observe import (
+    JsonlSink,
+    MemorySink,
+    Telemetry,
+)
+from network_distributed_pytorch_tpu.parallel import PowerSGDReducer, make_mesh
+from network_distributed_pytorch_tpu.parallel.trainer import (
+    make_train_step,
+    stateless_loss,
+)
+from network_distributed_pytorch_tpu.resilience import (
+    ChaosPlan,
+    FaultSpec,
+    PreemptionGuard,
+)
+from network_distributed_pytorch_tpu.resilience.reshard import (
+    derive_rank_key,
+    fold_groups,
+    fold_memories,
+    make_topology,
+    memory_total,
+    merge_model_state,
+    rescale_accum_steps,
+    reshard_from_checkpoint,
+)
+from network_distributed_pytorch_tpu.utils import cross_entropy_loss
+from network_distributed_pytorch_tpu.utils.checkpoint import (
+    TopologyMismatchError,
+    read_topology,
+    restore_checkpoint,
+    restore_checkpoint_sharded,
+    restore_latest,
+    save_checkpoint,
+)
+
+
+class MiniState(NamedTuple):
+    """Smallest TrainState-like carry the reshard/topology code accepts."""
+
+    params: Any
+    memories: Any
+    model_state: Any
+
+
+def _mini(world: int, seed: int = 0) -> MiniState:
+    rng = np.random.RandomState(seed)
+    return MiniState(
+        params={"w": rng.randn(6, 4).astype(np.float32)},
+        memories={
+            "w": rng.randn(world, 6, 4).astype(np.float32),
+            "b": rng.randn(world, 4).astype(np.float32),
+        },
+        model_state=None,
+    )
+
+
+def _bytes_of(tree) -> list:
+    return [np.asarray(l).tobytes() for l in jax.tree_util.tree_leaves(tree)]
+
+
+# ---------------------------------------------------------------------------
+# fold geometry + the bit-for-bit sum invariant
+# ---------------------------------------------------------------------------
+
+def test_fold_groups_geometry():
+    assert fold_groups(4, 3) == [[0, 1], [2], [3]]
+    assert fold_groups(4, 1) == [[0, 1, 2, 3]]
+    assert fold_groups(4, 4) == [[0], [1], [2], [3]]
+    assert fold_groups(8, 5) == [[0, 1, 2, 3], [4], [5], [6], [7]]
+    with pytest.raises(ValueError, match="only shrinks"):
+        fold_groups(4, 5)
+    with pytest.raises(ValueError, match=">= 1"):
+        fold_groups(4, 0)
+
+
+def test_fold_memories_sum_bit_for_bit():
+    """The conserved quantity: the strict left-to-right rank-order sum of
+    every memory leaf has IDENTICAL BYTES before and after any fold — the
+    prefix grouping makes it the same chain of fp32 additions, not merely
+    the same real number."""
+    rng = np.random.RandomState(7)
+    world = 8
+    memories = {
+        "conv": (100.0 * rng.randn(world, 3, 5)).astype(np.float32),
+        "dense": {"k": rng.randn(world, 17).astype(np.float32)},
+    }
+    before = _bytes_of(memory_total(memories))
+    for new_world in range(1, world + 1):
+        folded = fold_memories(memories, new_world)
+        for leaf in jax.tree_util.tree_leaves(folded):
+            assert np.asarray(leaf).shape[0] == new_world
+        assert _bytes_of(memory_total(folded)) == before
+
+
+def test_fold_memories_identity_at_same_world():
+    mem = {"m": np.arange(12, dtype=np.float32).reshape(4, 3)}
+    out = fold_memories(mem, 4)
+    np.testing.assert_array_equal(out["m"], mem["m"])
+
+
+# ---------------------------------------------------------------------------
+# per-worker stat merge
+# ---------------------------------------------------------------------------
+
+def test_merge_model_state_weighted_average():
+    arr = np.arange(8, dtype=np.float32).reshape(4, 2)
+    samples = [10, 20, 30, 40]
+    out = merge_model_state({"mean": arr}, 2, samples_per_rank=samples)["mean"]
+    # groups [[0,1,2],[3]]: row 0 = weighted avg of rows 0..2, row 1 = row 3
+    want0 = (10 * arr[0] + 20 * arr[1] + 30 * arr[2]) / 60.0
+    np.testing.assert_allclose(out[0], want0, rtol=1e-6)
+    np.testing.assert_array_equal(out[1], arr[3])
+    assert out.shape == (2, 2) and out.dtype == np.float32
+
+
+def test_merge_model_state_int_and_none():
+    counts = np.array([[5], [6], [7], [8]], dtype=np.int32)
+    out = merge_model_state({"n": counts}, 2)["n"]
+    # non-float leaves keep the first source rank's value per group
+    np.testing.assert_array_equal(out, np.array([[5], [8]], dtype=np.int32))
+    assert merge_model_state(None, 2) is None
+    with pytest.raises(ValueError, match="samples_per_rank"):
+        merge_model_state(
+            {"m": np.zeros((4, 2), np.float32)}, 2, samples_per_rank=[1, 2]
+        )
+
+
+# ---------------------------------------------------------------------------
+# global-batch preservation + RNG lineage
+# ---------------------------------------------------------------------------
+
+def test_rescale_accum_steps_preserves_global_batch():
+    assert rescale_accum_steps(24, 4, 3, 1) == 2
+    assert rescale_accum_steps(24, 4, 4, 1) == 1  # no change, no rescale
+    assert rescale_accum_steps(240, 8, 5, 2) == 4
+    for gb, ow, nw, oa in [(24, 4, 3, 1), (240, 8, 5, 2), (64, 8, 4, 1)]:
+        k = rescale_accum_steps(gb, ow, nw, oa)
+        assert gb % k == 0 and (gb // k) % nw == 0  # trainer batch contract
+        assert gb // k <= gb // oa  # microbatch never grows
+    # infeasible (32 never splits over 3): fall back to the old accumulation
+    assert rescale_accum_steps(32, 4, 3, 1) == 1
+    with pytest.raises(ValueError, match="old_accum"):
+        rescale_accum_steps(24, 4, 3, 0)
+
+
+def test_derive_rank_key_distinct_and_deterministic(devices):
+    keys = {}
+    for rank in range(4):
+        for inc in range(2):
+            k = np.asarray(derive_rank_key(0, rank, inc))
+            keys[(rank, inc)] = k.tobytes()
+    assert len(set(keys.values())) == 8  # all (rank, incarnation) distinct
+    again = np.asarray(derive_rank_key(0, 2, 1)).tobytes()
+    assert again == keys[(2, 1)]
+
+
+# ---------------------------------------------------------------------------
+# elastic data re-split: exactly-once coverage at any world size
+# ---------------------------------------------------------------------------
+
+def test_elastic_assignments_cover_disjointly():
+    n = 120
+    full = set(range(n))
+    for world in (4, 3):
+        parts = elastic_assignments(n, world)
+        assert len(parts) == world
+        flat = [i for p in parts for i in p]
+        assert len(flat) == len(set(flat))  # disjoint
+        assert set(flat) == full  # exactly-once coverage
+    # the W=4 and W'=3 splits cut the SAME permutation — no reshuffle
+    perm4 = [i for p in elastic_assignments(n, 4) for i in p]
+    perm3 = [i for p in elastic_assignments(n, 3) for i in p]
+    assert perm4 == perm3
+
+
+# ---------------------------------------------------------------------------
+# topology protocol: tagged checkpoints refuse silent cross-world restores
+# ---------------------------------------------------------------------------
+
+def test_topology_record_roundtrip(devices, tmp_path):
+    root = str(tmp_path / "ck")
+    topo = make_topology(
+        4, global_batch=24, accum_steps=1, bits_per_step=999, rng_seed=5,
+        epoch_cursor={"epoch": 1, "batches_done": 3},
+    )
+    final = save_checkpoint(root, _mini(4), step=0, topology=topo)
+    back = read_topology(final)
+    assert back["world_size"] == 4
+    assert back["global_batch"] == 24
+    assert back["epoch_cursor"] == {"epoch": 1, "batches_done": 3}
+    assert [s["rank"] for s in back["shard_layout"]] == [0, 1, 2, 3]
+    # untagged directory: None, not an error
+    assert read_topology(str(tmp_path / "nope")) is None
+
+
+def test_cross_topology_restore_refuses(devices, tmp_path):
+    """Satellite: a world-4 tagged checkpoint restored into a world-3
+    template must raise a CLEAR topology-mismatch error from every restore
+    entry point — never garbage, never a deep orbax failure."""
+    root = str(tmp_path / "ck")
+    final = save_checkpoint(root, _mini(4), step=0, topology=make_topology(4))
+    t3 = _mini(3)
+    for restore in (restore_checkpoint, restore_checkpoint_sharded):
+        with pytest.raises(TopologyMismatchError, match="topology mismatch"):
+            restore(final, t3)
+    with pytest.raises(TopologyMismatchError, match="world size 4"):
+        restore_latest(root, t3)
+    # the matching world restores normally
+    state = restore_checkpoint(final, _mini(4, seed=1))
+    assert _bytes_of(state.memories) == _bytes_of(_mini(4).memories)
+
+
+def test_restore_latest_routes_through_resharder(devices, tmp_path):
+    root = str(tmp_path / "ck")
+    state4 = _mini(4)
+    save_checkpoint(root, state4, step=2, topology=make_topology(4))
+    t3 = _mini(3, seed=1)
+
+    def resharder(path, saved_topo):
+        assert saved_topo["world_size"] == 4
+        return reshard_from_checkpoint(path, t3, saved_topology=saved_topo)
+
+    restored, step = restore_latest(root, t3, resharder=resharder)
+    assert step == 2
+    for leaf in jax.tree_util.tree_leaves(restored.memories):
+        assert np.asarray(leaf).shape[0] == 3
+    # replicated leaves pass through; the EF sum is conserved bit-for-bit
+    assert _bytes_of(restored.params) == _bytes_of(state4.params)
+    assert _bytes_of(memory_total(restored.memories)) == _bytes_of(
+        memory_total(state4.memories)
+    )
+
+
+def test_reshard_from_checkpoint_requires_topology(devices, tmp_path):
+    root = str(tmp_path / "ck")
+    final = save_checkpoint(root, _mini(4), step=0)  # untagged
+    with pytest.raises(ValueError, match="no topology record"):
+        reshard_from_checkpoint(final, _mini(3))
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: preempted at W=4, resumed at W'=3
+# ---------------------------------------------------------------------------
+
+IMG = (8, 8, 3)
+GB = 24  # global batch, preserved across the shrink
+N_EX = 120  # dataset size: divides evenly at both W=4 and W'=3
+STEPS_PER_EPOCH = N_EX // GB
+EPOCHS = 2
+
+
+def _global_batches(epoch: int):
+    """Deterministic stream of GLOBAL batches — world-size independent, so
+    the W=4 and W'=3 runs see byte-identical data."""
+    rng = np.random.RandomState(500 + epoch)
+    means = np.random.RandomState(999).randn(10, *IMG)
+    for _ in range(STEPS_PER_EPOCH):
+        y = rng.randint(0, 10, GB)
+        x = (means[y] + 0.5 * rng.randn(GB, *IMG)).astype(np.float32)
+        yield x, y
+
+
+def _batches_fn(accum: int):
+    def gen(epoch: int):
+        for x, y in _global_batches(epoch):
+            if accum > 1:
+                x = x.reshape((accum, GB // accum) + x.shape[1:])
+                y = y.reshape((accum, GB // accum))
+            yield jnp.asarray(x, jnp.float32), jnp.asarray(y)
+
+    return gen
+
+
+def _make_step(mesh, accum: int):
+    model = SmallCNN(width=4)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, *IMG)))["params"]
+
+    def lf(p, b):
+        x, y = b
+        return cross_entropy_loss(model.apply({"params": p}, x), y)
+
+    step = make_train_step(
+        stateless_loss(lf),
+        PowerSGDReducer(random_seed=7, compression_rank=2, matricize="last"),
+        params, learning_rate=0.05, momentum=0.9, algorithm="ef_momentum",
+        mesh=mesh, accum_steps=accum, donate_state=False,
+    )
+    return step, params
+
+
+@pytest.mark.slow
+def test_elastic_shrink_4_to_3_end_to_end(devices, tmp_path):
+    """A rank dies mid-epoch at W=4 (preemption notice → emergency
+    committed checkpoint with an epoch cursor); the run restarts at W'=3
+    from the W=4 checkpoint: exactly-once data coverage, the folded EF
+    sum bit-for-bit, the resumed run equal to an uninterrupted W'=3 run
+    seeded with the same resharded state, and ``resumed``/``resharded``
+    in the JSONL event log."""
+    from network_distributed_pytorch_tpu.experiments.common import (
+        accum_batch_sharding,
+    )
+
+    ckpt = str(tmp_path / "elastic")
+    log_path = str(tmp_path / "events.jsonl")
+
+    # -- phase 1: W=4, preempted mid-epoch 0 --------------------------------
+    mesh4 = make_mesh(devices=devices[:4])
+    step4, params = _make_step(mesh4, accum=1)
+    topo4 = make_topology(
+        4, global_batch=GB, accum_steps=1,
+        bits_per_step=step4.bits_per_step, rng_seed=0,
+    )
+    plan = ChaosPlan([FaultSpec(kind="proc_preempt", step=2)], seed=11)
+    sink4 = MemorySink()
+    tel4 = Telemetry([sink4, JsonlSink(log_path)])
+    with PreemptionGuard(telemetry=tel4) as guard:
+        stopped, _, _ = resilient_train_loop(
+            step4, step4.init_state(params), _batches_fn(1), EPOCHS,
+            checkpoint_dir=ckpt, telemetry=tel4, run_name="w4",
+            chaos_plan=plan, topology=topo4, preemption_guard=guard,
+        )
+    assert guard.checkpoint_saved
+    kinds4 = [r.get("kind") for r in sink4.records if r.get("event") == "failure"]
+    assert "preempt_notice" in kinds4 and "preempt_checkpoint" in kinds4
+    cursor = read_topology(os.path.join(ckpt, "step_0"))["epoch_cursor"]
+    assert cursor == {"epoch": 0, "batches_done": 3}
+    pre_total = memory_total(stopped.memories)
+
+    # -- the survivors' data re-split covers the dataset exactly once -------
+    parts3 = elastic_assignments(N_EX, 3)
+    flat = [i for p in parts3 for i in p]
+    assert sorted(flat) == list(range(N_EX)) and len(set(flat)) == N_EX
+
+    # -- phase 2: reshard to W'=3, global batch preserved via accum ---------
+    accum3 = rescale_accum_steps(GB, 4, 3, 1)
+    assert accum3 == 2
+    mesh3 = make_mesh(devices=devices[:3])
+    step3, _ = _make_step(mesh3, accum=accum3)
+    init3 = step3.init_state(params)
+    shard3 = accum_batch_sharding(mesh3, accum3)
+
+    # direct reshard: the folded EF sum is the W=4 sum, bit-for-bit
+    resharded = reshard_from_checkpoint(os.path.join(ckpt, "step_0"), init3)
+    assert _bytes_of(memory_total(resharded.memories)) == _bytes_of(pre_total)
+    assert _bytes_of(resharded.params) == _bytes_of(stopped.params)
+
+    topo3 = make_topology(
+        3, global_batch=GB, accum_steps=accum3,
+        bits_per_step=step3.bits_per_step, rng_seed=0, incarnation=1,
+    )
+    sink3 = MemorySink()
+    tel3 = Telemetry([sink3, JsonlSink(log_path)])
+    final, logger3, start_epoch = resilient_train_loop(
+        step3, init3, _batches_fn(accum3), EPOCHS,
+        checkpoint_dir=ckpt, telemetry=tel3, run_name="w3",
+        topology=topo3, batch_sharding=shard3, incarnation=1,
+    )
+    assert start_epoch == 0  # re-entered the preempted epoch, mid-way
+    kinds3 = [r.get("kind") for r in sink3.records if r.get("event") == "failure"]
+    assert "resumed" in kinds3 and "resharded" in kinds3
+    resumed_msg = next(
+        r["message"] for r in sink3.records if r.get("kind") == "resumed"
+    )
+    assert "+3 steps" in resumed_msg
+    final_loss = logger3.summary().get("final_loss")
+    assert final_loss is not None and np.isfinite(final_loss)
+
+    # -- oracle: an uninterrupted W'=3 run from the same resharded state ----
+    def skipped_batches(epoch: int):
+        it = _batches_fn(accum3)(epoch)
+        if epoch == 0:
+            for _ in range(cursor["batches_done"]):
+                next(it)
+        return it
+
+    oracle, _ = train_loop(
+        step3, resharded, skipped_batches, EPOCHS, start_epoch=0,
+        batch_sharding=shard3, run_name="oracle",
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(final.params),
+        jax.tree_util.tree_leaves(oracle.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(final.memories),
+        jax.tree_util.tree_leaves(oracle.memories),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # -- the JSONL log carries the whole story ------------------------------
+    with open(log_path) as f:
+        logged = [json.loads(l) for l in f if l.strip()]
+    logged_kinds = {r.get("kind") for r in logged if r.get("event") == "failure"}
+    assert {"preempt_notice", "preempt_checkpoint", "resumed", "resharded"} <= logged_kinds

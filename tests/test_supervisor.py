@@ -20,6 +20,7 @@ import pytest
 from network_distributed_pytorch_tpu.launch import worker_argv_base
 from network_distributed_pytorch_tpu.observe import MemorySink, Telemetry
 from network_distributed_pytorch_tpu.resilience import (
+    PREEMPT_EXIT_CODE,
     ChaosPlan,
     FaultSpec,
     Supervisor,
@@ -41,7 +42,7 @@ def _kinds(sink):
 
 
 def _toy_argv(tmp_path, steps=6, plan_path=None, heartbeat=False,
-              step_seconds=0.01):
+              step_seconds=0.01, graceful_term=False):
     def argv_for_rank(rank, world, incarnation):
         argv = [
             sys.executable, TOY,
@@ -55,6 +56,8 @@ def _toy_argv(tmp_path, steps=6, plan_path=None, heartbeat=False,
             argv += ["--chaos-plan", plan_path]
         if heartbeat:
             argv += ["--heartbeat-dir", str(tmp_path / "hb")]
+        if graceful_term:
+            argv += ["--graceful-term"]
         return argv
 
     return argv_for_rank
@@ -194,6 +197,43 @@ def test_toy_sigkill_shows_negative_returncode(tmp_path):
         if r.get("event") == "failure" and r.get("kind") == "worker_exit"
     ]
     assert any("exit code -9" in e.get("message", "") for e in exits)
+
+
+def test_toy_graceful_vs_hard_death_classification(tmp_path):
+    """Rank 0 gets a preemption notice (self-SIGTERM, honored: state saved,
+    exit ``PREEMPT_EXIT_CODE``); rank 1 is SIGKILLed. Both are restarted
+    and finish, but the supervisor's worker_exit events classify the two
+    deaths differently — graceful vs hard — which is what the report
+    timeline's death tally reads."""
+    plan_path = str(tmp_path / "plan.json")
+    ChaosPlan(
+        [
+            FaultSpec(kind="proc_preempt", step=2, rank=0),
+            FaultSpec(kind="proc_kill", step=1, rank=1),
+        ]
+    ).save(plan_path)
+    telemetry, sink = _telemetry()
+    result = Supervisor(
+        _toy_argv(tmp_path, steps=4, plan_path=plan_path, graceful_term=True),
+        world_size=2,
+        config=SupervisorConfig(
+            max_restarts=2, backoff_base_s=0.01, poll_interval_s=0.02,
+        ),
+        telemetry=telemetry,
+    ).run()
+    assert result.success
+    assert result.total_restarts == 2
+    # the preempted rank saved at the SIGTERM, so no progress was lost
+    r0, r1 = _result(tmp_path, 0), _result(tmp_path, 1)
+    assert r0["value"] == r1["value"] == 4 * 2
+    msgs = [
+        r.get("message", "") for r in sink.records
+        if r.get("event") == "failure" and r.get("kind") == "worker_exit"
+    ]
+    assert any(
+        f"exit code {PREEMPT_EXIT_CODE} (graceful death)" in m for m in msgs
+    )
+    assert any("exit code -9 (hard death)" in m for m in msgs)
 
 
 def test_worker_argv_base_strips_supervisor_flags():

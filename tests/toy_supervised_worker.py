@@ -8,22 +8,29 @@ checkpoints the accumulator atomically every step, beats a heartbeat file,
 and obeys a ``resilience.chaos.ChaosPlan`` for process-level faults
 (exit / SIGKILL / hang). On completion writes a result JSON per rank.
 
+With ``--graceful-term`` the worker installs the PreemptionGuard-style
+SIGTERM contract: persist state, then exit ``PREEMPT_EXIT_CODE`` so the
+supervisor classifies the death as graceful (the ``proc_preempt`` chaos
+fault self-delivers exactly that SIGTERM).
+
 Usage::
 
     python toy_supervised_worker.py --rank R --world W --steps N \
         --state-dir D --result-dir D [--heartbeat-dir D] [--chaos-plan F] \
-        [--step-seconds S]
+        [--step-seconds S] [--graceful-term]
 """
 
 import argparse
 import json
 import os
+import signal
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from network_distributed_pytorch_tpu.resilience.chaos import (  # noqa: E402
+    PREEMPT_EXIT_CODE,
     PROCESS_FAULTS,
     ChaosPlan,
 )
@@ -69,6 +76,7 @@ def main() -> int:
     p.add_argument("--heartbeat-dir", default=None)
     p.add_argument("--chaos-plan", default=None)
     p.add_argument("--step-seconds", type=float, default=0.01)
+    p.add_argument("--graceful-term", action="store_true")
     args = p.parse_args()
 
     incarnation = incarnation_from_env()
@@ -81,6 +89,16 @@ def main() -> int:
     state_path = os.path.join(args.state_dir, f"rank{args.rank}.json")
     state = _load_state(state_path)
 
+    if args.graceful_term:
+        # the PreemptionGuard contract, toy-sized: SIGTERM -> persist the
+        # current state, exit with the sentinel the supervisor classifies
+        # as a graceful death
+        def _on_term(signum, frame):
+            _save_state(state_path, state)
+            os._exit(PREEMPT_EXIT_CODE)
+
+        signal.signal(signal.SIGTERM, _on_term)
+
     while state["step"] < args.steps:
         i = state["step"]
         if args.heartbeat_dir:
@@ -90,11 +108,11 @@ def main() -> int:
             if spec.kind == "proc_exit":
                 os._exit(int(spec.payload.get("exit_code", 43)))
             if spec.kind == "proc_kill":
-                import signal
-
                 os.kill(os.getpid(), signal.SIGKILL)
             if spec.kind == "proc_hang":
                 time.sleep(float(spec.payload.get("hang_seconds", 3600.0)))
+            if spec.kind == "proc_preempt":
+                os.kill(os.getpid(), signal.SIGTERM)
         time.sleep(args.step_seconds)
         state = {"step": i + 1, "value": state["value"] + args.world}
         _save_state(state_path, state)
