@@ -232,6 +232,43 @@ def _init_backend():
     return devices
 
 
+def _cache_dir_entries():
+    """``(dir, n_entries)`` for the persistent compilation cache, or
+    ``(None, 0)`` when no cache is configured (XLA:CPU — ``_init_backend``
+    enables the cache on TPU only)."""
+    import jax
+
+    d = getattr(jax.config, "jax_compilation_cache_dir", None)
+    if not d or not os.path.isdir(d):
+        return None, 0
+    return d, sum(1 for n in os.listdir(d) if not n.startswith("."))
+
+
+class _CacheProbe:
+    """Persistent-compilation-cache accounting around one phase.
+
+    Construct before the phase's compiles, ``report()`` after: a compile
+    served from the cache writes no new entry, so ``new_entries == 0``
+    reads as "hit" and ``> 0`` as "miss" (fresh compiles persisted).
+    ``disabled`` is the honest CPU answer — the cache is TPU-only
+    (see ``_init_backend``), and a smoke run must not publish hit/miss
+    fields that look like warm-cache evidence."""
+
+    def __init__(self):
+        self.dir, self.before = _cache_dir_entries()
+
+    def report(self) -> dict:
+        if self.dir is None:
+            return {"status": "disabled"}
+        _, after = _cache_dir_entries()
+        new = after - self.before
+        return {
+            "status": "miss" if new > 0 else "hit",
+            "new_entries": new,
+            "entries_total": after,
+        }
+
+
 def _peak_flops(device) -> float:
     """Peak bf16 FLOP/s for ``device``, or 0.0 when unknown (CPU smoke tier)."""
     kind = (getattr(device, "device_kind", "") or "").lower()
@@ -293,7 +330,10 @@ def _scanned_cifar_setup(dtype):
     """Build + AOT-compile the CHUNK-scanned CIFAR train step — ONE scaffold
     shared by the flagship (bf16) and fp32 decomposition arms, so the pair
     differs in nothing but dtype and the comparison isolates exactly that.
-    Returns ``(scanned, state, chunk_batch, compiled, batch_size, small)``."""
+    Returns ``(scanned, state, chunk_batch, compiled, batch_size, small,
+    compile_stats)`` where ``compile_stats`` splits the AOT cost into its
+    tracing (``lower_ms``) and XLA-compile (``compile_ms``) components —
+    the compile component is what a warm persistent cache replays."""
     import jax
     import jax.numpy as jnp
 
@@ -318,8 +358,16 @@ def _scanned_cifar_setup(dtype):
         jnp.broadcast_to(batch[0][None], (CHUNK,) + batch[0].shape),
         jnp.broadcast_to(batch[1][None], (CHUNK,) + batch[1].shape),
     )
-    compiled = scanned.fn.lower(state, chunk_batch).compile()
-    return scanned, state, chunk_batch, compiled, batch_size, small
+    t0 = time.perf_counter()
+    lowered = scanned.fn.lower(state, chunk_batch)
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t2 = time.perf_counter()
+    compile_stats = {
+        "lower_ms": round(1000.0 * (t1 - t0), 2),
+        "compile_ms": round(1000.0 * (t2 - t1), 2),
+    }
+    return scanned, state, chunk_batch, compiled, batch_size, small, compile_stats
 
 
 def _default_reps(env_var: str, tpu: str, cpu: str) -> int:
@@ -335,7 +383,12 @@ def _default_reps(env_var: str, tpu: str, cpu: str) -> int:
 
 def _timed_dispatches(compiled, state, chunk_batch, reps):
     """Warmup + ``reps`` fetch-to-observe timed CHUNK-step dispatches.
-    Returns ``(state, times_s)`` in MEASUREMENT order (round-4 verdict weak
+    Returns ``(state, times_s, first_execute_s)`` in MEASUREMENT order —
+    ``first_execute_s`` is the warmup dispatch timed separately: against an
+    AOT executable it contains NO compile (that is ``compile_stats``), only
+    first-run costs (program load, donation setup, allocator warmup), so
+    publishing it apart from the steady-state reps keeps both honest
+    (round-4 verdict weak
     #1: one-shot timings through a contended tunnel showed a 54% spread
     across runs — 22.8k vs 35.0k imgs/sec; every published rate needs
     median + spread, and the published sequence must keep its time order so
@@ -343,15 +396,17 @@ def _timed_dispatches(compiled, state, chunk_batch, reps):
     stays visible; callers sort a local copy for min/median/max)."""
     from network_distributed_pytorch_tpu.utils.timing import wait_result
 
-    state, losses = compiled(state, chunk_batch)  # warmup
+    t0 = time.perf_counter()
+    state, losses = compiled(state, chunk_batch)  # warmup / first execute
     wait_result(losses)
+    first_execute_s = time.perf_counter() - t0
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
         state, losses = compiled(state, chunk_batch)
         wait_result(losses)  # fetch-to-observe-completion, utils.timing
         times.append(time.perf_counter() - t0)
-    return state, times
+    return state, times, first_execute_s
 
 
 def _flops_band(ratio: float, chunk: int):
@@ -391,7 +446,7 @@ def _phase_flagship() -> dict:
     import jax.numpy as jnp
 
     t_phase0 = time.perf_counter()
-    scanned, state, chunk_batch, compiled, batch_size, small = (
+    scanned, state, chunk_batch, compiled, batch_size, small, compile_stats = (
         _scanned_cifar_setup(jnp.bfloat16)
     )
     flops_chunk = 0.0
@@ -402,11 +457,18 @@ def _phase_flagship() -> dict:
     except Exception:  # cost analysis is best-effort; MFU just goes unreported
         pass
     reps = _default_reps("BENCH_FLAGSHIP_REPS", "5", "2")
-    state, times = _timed_dispatches(compiled, state, chunk_batch, reps)
+    state, times, first_exec = _timed_dispatches(compiled, state, chunk_batch, reps)
     ranked = sorted(times)
     dt = _median(times)
     out = {
         "preset": "small" if small else "full",
+        # the one-time costs, split: AOT trace + XLA compile (what the
+        # persistent cache can replay) vs the first executable dispatch
+        # (program load / donation setup — never cacheable). The old record
+        # lumped all three into an invisible warmup.
+        "lower_ms": compile_stats["lower_ms"],
+        "compile_ms": compile_stats["compile_ms"],
+        "first_execute_ms": round(1000.0 * first_exec, 2),
         "flagship_imgs_per_sec": round(batch_size * CHUNK / dt, 2),
         "step_time_ms": round(1000.0 * dt / CHUNK, 4),
         "flagship_reps": reps,
@@ -546,8 +608,13 @@ def _phase_baseline() -> dict:
         variables["params"], model_state={"batch_stats": variables["batch_stats"]}
     )
     batch = _cifar_batch(batch_size)
+    t0 = time.perf_counter()
     state, loss = step(state, batch)  # compile + warmup
     wait_result(loss)
+    # jit path: trace, compile, and first execute are ONE opaque call —
+    # unlike the AOT arms there is no seam to time them apart, so the
+    # field says so instead of pretending to be a pure compile time
+    first_call_ms = round(1000.0 * (time.perf_counter() - t0), 2)
     # three independent timed passes (round-4 verdict weak #5: vs_baseline
     # rested on a single unreplicated pair; with two passes the median IS
     # an endpoint, so three is the floor at which median and spread are
@@ -564,6 +631,8 @@ def _phase_baseline() -> dict:
     med = _median(rates)
     return {
         "baseline_imgs_per_sec": round(med, 2),
+        "baseline_first_call_ms": first_call_ms,
+        "baseline_first_call_note": "jit compile + first execute, unsplittable",
         "baseline_step_time_ms": round(1000.0 * batch_size / med, 4),
         # spread endpoints ride the record like the flagship's — the
         # vs_baseline ratio's denominator needs error bars too
@@ -584,17 +653,21 @@ def _phase_fp32arm() -> dict:
     are the same code)."""
     import jax.numpy as jnp
 
-    _, state, chunk_batch, compiled, batch_size, small = _scanned_cifar_setup(
-        jnp.float32
+    _, state, chunk_batch, compiled, batch_size, small, compile_stats = (
+        _scanned_cifar_setup(jnp.float32)
     )
     reps = _default_reps("BENCH_FP32ARM_REPS", "3", "1")
-    state, times = _timed_dispatches(compiled, state, chunk_batch, reps)
+    state, times, first_exec = _timed_dispatches(compiled, state, chunk_batch, reps)
     ranked = sorted(times)
     dt = _median(times)
     return {
         # same tier-labeling contract as the flagship: a small-preset rate
         # must never be readable as the full ResNet-50/batch-256 number
         "preset": "small" if small else "full",
+        # same one-time-cost split as the flagship's
+        "fp32_lower_ms": compile_stats["lower_ms"],
+        "fp32_compile_ms": compile_stats["compile_ms"],
+        "fp32_first_execute_ms": round(1000.0 * first_exec, 2),
         "fp32_scanned_imgs_per_sec": round(batch_size * CHUNK / dt, 2),
         "fp32_scanned_step_time_ms": round(1000.0 * dt / CHUNK, 4),
         "fp32_scanned_reps": reps,
@@ -655,7 +728,13 @@ def _phase_overlap() -> dict:
     ``n_async_collectives`` is reported as observed and has been 0 — we do
     NOT claim collectives overlap compute. Unless already on a ≥2-chip
     mesh, the step is compiled against an 8-chip v5e topology AOT — the
-    schedule IS the evidence, no execution needed."""
+    schedule IS the evidence, no execution needed.
+
+    A third finding (Round-6): the SAME workload compiled with
+    ``comm_chunks=4`` — per-chunk collectives, their async windows or
+    textual interleaving with compute fusions, and the byte-exact
+    reconciliation of the per-chunk ledger against the compiled HLO —
+    lands under the ``chunked`` key of ``OVERLAP.json``."""
     import jax
     import jax.numpy as jnp
 
@@ -701,7 +780,7 @@ def _phase_overlap() -> dict:
     # scheduled HLO; option sets are tried most-specific first, and an
     # executable with no async windows still yields the combiner evidence
     lowered = step.fn.lower(state_abs, batch_abs)
-    compiled_exe, flags_used, last_opt_err = None, None, None
+    compiled_exe, flags_used, opts_used, last_opt_err = None, None, None, None
     for opts in (
         {
             "xla_tpu_enable_latency_hiding_scheduler": "true",
@@ -716,6 +795,7 @@ def _phase_overlap() -> dict:
                 lowered.compile(compiler_options=opts) if opts else lowered.compile()
             )
             flags_used = sorted(opts) if opts else []
+            opts_used = opts
             break
         except Exception as opt_err:  # noqa: BLE001 — try the next set
             last_opt_err = opt_err
@@ -744,6 +824,49 @@ def _phase_overlap() -> dict:
     rep["combiner_merged"] = aud["count"] < 4
     rep["workload"] = "powersgd_r4_" + ("resnet18" if small else "resnet50")
     rep["compiled_for"] = topology_note
+    # Round-6 chunked-pipeline evidence (DESIGN.md): the SAME workload with
+    # comm_chunks=4 — the schedule must show either async windows with
+    # compute inside them or the chunk collectives textually interleaved
+    # with compute fusions, and the per-chunk ledger must reconcile
+    # byte-exactly against the compiled HLO. Best-effort: a failure here
+    # must not cost the phase its monolithic evidence.
+    try:
+        chunks = max(2, int(os.environ.get("BENCH_COMM_CHUNKS", "4")))
+        cstep = make_train_step(
+            loss_fn,
+            PowerSGDReducer(
+                random_seed=714, compression_rank=4, matricize="last",
+                comm_chunks=chunks,
+            ),
+            variables["params"], learning_rate=0.001, momentum=0.9,
+            algorithm="ef_momentum", mesh=target_mesh, donate_state=False,
+        )
+        clowered = cstep.fn.lower(state_abs, batch_abs)
+        cexe = (
+            clowered.compile(compiler_options=opts_used)
+            if opts_used else clowered.compile()
+        )
+        chlo = hlo_text_of_compiled(cexe)
+        crep = overlap_report(chlo)
+        rec = cstep.ledger.reconcile(chlo)
+        rep["chunked"] = {
+            "comm_chunks": chunks,
+            "ledger_collectives": sum(e.count for e in cstep.ledger.entries),
+            "ledger_bytes": cstep.ledger.total_bytes(),
+            "hlo_collectives": rec["hlo_collective_count"],
+            "hlo_bytes": rec["hlo_bytes"],
+            "ledger_exact": rec["exact"],
+            "n_async_collectives": crep["n_async_collectives"],
+            "n_overlapped": crep["n_overlapped"],
+            "collectives": crep["collectives"],
+            "n_sync_collectives": crep["n_sync_collectives"],
+            "n_sync_gaps_with_compute": crep["n_sync_gaps_with_compute"],
+            "sync_interleaved": crep["sync_interleaved"],
+            "sync_collectives": crep["sync_collectives"],
+            "collective_emitters": crep["collective_emitters"],
+        }
+    except Exception as e:  # noqa: BLE001 — chunked evidence is additive
+        rep["chunked"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     # an AOT-topology schedule is attached-device-independent — say so
     # rather than stamping whatever chip happened to be attached
     rep["device"] = (
@@ -756,14 +879,23 @@ def _phase_overlap() -> dict:
     name = "OVERLAP.json" if jax.devices()[0].platform == "tpu" else "OVERLAP_smoke.json"
     with open(os.path.join(HERE, name), "w") as f:
         json.dump(rep, f, indent=1)
-    return {
-        "overlap": {
-            "n_async_collectives": rep["n_async_collectives"],
-            "n_overlapped": rep["n_overlapped"],
-            "compiled_collectives": aud["count"],
-            "combiner_merged": rep["combiner_merged"],
-        }
+    summary = {
+        "n_async_collectives": rep["n_async_collectives"],
+        "n_overlapped": rep["n_overlapped"],
+        "compiled_collectives": aud["count"],
+        "combiner_merged": rep["combiner_merged"],
     }
+    if "error" not in rep["chunked"]:
+        summary["chunked"] = {
+            k: rep["chunked"][k]
+            for k in (
+                "comm_chunks", "hlo_collectives", "ledger_exact",
+                "n_overlapped", "n_sync_gaps_with_compute", "sync_interleaved",
+            )
+        }
+    else:
+        summary["chunked"] = rep["chunked"]
+    return {"overlap": summary}
 
 
 _PHASE_FNS = {
@@ -875,7 +1007,12 @@ def child_main(phase_list: list) -> int:
             if name == "probe":
                 data = _PHASE_FNS[name]()
             else:
+                # persistent-compilation-cache accounting brackets the
+                # phase: zero new entries after its compiles = served from
+                # cache ("hit"); CPU reports "disabled" (TPU-only cache)
+                cache = _CacheProbe()
                 data = _run_with_deadline(name, _PHASE_FNS[name], budget)
+                data["compilation_cache"] = cache.report()
             if live:
                 data["concurrent_abandoned"] = live
             _child_emit(name, True, data)

@@ -22,6 +22,12 @@ On the 0.4.x line:
 - ``jax.typeof(x)`` is the public spelling of ``jax.core.get_aval`` (used
   here only to read a ``vma`` attribute that pre-varying-types avals don't
   carry — callers already default it to the empty set).
+- ``lax.optimization_barrier`` has no differentiation rule on 0.4.x; newer
+  jax barriers the tangents/cotangents (the barrier is linear). The chunked
+  FSDP parameter gather fences its pipeline inside ``jax.grad``, so the
+  same rules are registered here: without them the transpose that turns the
+  chunked all_gather into the per-chunk gradient reduce-scatter raises
+  ``NotImplementedError``.
 """
 
 from __future__ import annotations
@@ -60,3 +66,30 @@ if not hasattr(jax, "typeof"):
     import jax.core
 
     jax.typeof = jax.core.get_aval
+
+try:  # optimization_barrier AD rules (present upstream from jax 0.4.38)
+    from jax._src.lax.lax import optimization_barrier_p as _opt_barrier_p
+    from jax.interpreters import ad as _ad
+
+    if _opt_barrier_p not in _ad.primitive_jvps:
+
+        def _opt_barrier_jvp(primals, tangents):
+            tangents = [_ad.instantiate_zeros(t) for t in tangents]
+            return (
+                _opt_barrier_p.bind(*primals),
+                _opt_barrier_p.bind(*tangents),
+            )
+
+        _ad.primitive_jvps[_opt_barrier_p] = _opt_barrier_jvp
+
+    if _opt_barrier_p not in _ad.primitive_transposes:
+
+        def _opt_barrier_transpose(cts, *primals):
+            del primals
+            return _opt_barrier_p.bind(
+                *[_ad.instantiate_zeros(ct) for ct in cts]
+            )
+
+        _ad.primitive_transposes[_opt_barrier_p] = _opt_barrier_transpose
+except ImportError:  # pragma: no cover - newer jax moved the private module
+    pass

@@ -75,6 +75,20 @@ def accumulated_batches(
     return gen
 
 
+def reducer_comm_kwargs(config) -> Dict[str, Any]:
+    """The chunked-reduction knobs every reducer constructor shares
+    (``parallel.comm.chunked_all_reduce_mean``): pass as ``**kwargs`` so an
+    experiment's reducer follows ``config.comm_chunks``/``comm_strategy``
+    without each entry point re-spelling the plumbing. Empty when chunking
+    is off, keeping reducer constructors at their historical signature."""
+    if config.comm_chunks is None:
+        return {}
+    return {
+        "comm_chunks": config.comm_chunks,
+        "comm_strategy": config.comm_strategy,
+    }
+
+
 def accum_batch_sharding(mesh, accum_steps: int):
     """Prefetch sharding for accumulated batches: the sharded batch dim sits
     BEHIND the accum axis. None for the unaccumulated default (train_loop

@@ -28,6 +28,7 @@ from .common import (
     accum_batch_sharding,
     accumulated_batches,
     image_classifier_loss,
+    reducer_comm_kwargs,
     summarize,
     train_loop,
 )
@@ -95,6 +96,11 @@ def run(
                 "checkpoint_dir requires strategy='ddp' (the FSDP carry"
                 " restores via restore_checkpoint_sharded, not this loop)"
             )
+        if config.comm_strategy != "interleave":
+            raise ValueError(
+                "strategy='fsdp' pipelines via chunked gathers; only"
+                " comm_strategy='interleave' applies"
+            )
         step = make_fsdp_train_step(
             loss_fn,
             params,
@@ -102,11 +108,12 @@ def run(
             momentum=config.momentum,
             algorithm="sgd",
             mesh=mesh,
+            comm_chunks=config.comm_chunks,
         )
     else:
         step = make_train_step(
             loss_fn,
-            ExactReducer(),
+            ExactReducer(**reducer_comm_kwargs(config)),
             params,
             learning_rate=config.learning_rate,
             momentum=config.momentum,

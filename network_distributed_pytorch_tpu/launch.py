@@ -86,6 +86,17 @@ def build_parser() -> argparse.ArgumentParser:
              " (cifar/imdb experiments)",
     )
     p.add_argument(
+        "--comm-chunks", type=int, default=None,
+        help="split each packed reduction payload into K fenced, software-"
+             "pipelined collectives (cifar experiments; DESIGN.md Round-6)",
+    )
+    p.add_argument(
+        "--comm-strategy", choices=["interleave", "ring"], default=None,
+        help="chunk reduction engine: 'interleave' (per-chunk pmean, bitwise"
+             " == monolithic) or 'ring' (explicit ppermute ring schedule,"
+             " deterministic but reassociated)",
+    )
+    p.add_argument(
         "--remat", action="store_true",
         help="rematerialize transformer blocks in the backward pass"
              " (gpt_lm, powersgd_imdb)",
@@ -262,6 +273,10 @@ def config_from_args(args) -> ExperimentConfig:
         cfg.accum_steps = args.accum_steps
     if args.max_grad_norm is not None:
         cfg.max_grad_norm = args.max_grad_norm
+    if args.comm_chunks is not None:
+        cfg.comm_chunks = args.comm_chunks
+    if args.comm_strategy is not None:
+        cfg.comm_strategy = args.comm_strategy
     cfg.event_log = args.event_log
     cfg.trace_dir = args.trace_dir
     cfg.audit_wire = args.audit_wire
@@ -369,6 +384,17 @@ def main(argv=None) -> dict:
         raise ValueError(
             f"--max-grad-norm is not supported by {args.experiment!r}"
             f" (supported: {', '.join(_ACCUM_OK)})"
+        )
+    _CHUNKS_OK = ("exact_cifar10", "powersgd_cifar10")
+    if cfg.comm_chunks is not None and args.experiment not in _CHUNKS_OK:
+        raise ValueError(
+            f"--comm-chunks is not supported by {args.experiment!r}"
+            f" (supported: {', '.join(_CHUNKS_OK)})"
+        )
+    if cfg.comm_strategy != "interleave" and args.experiment not in _CHUNKS_OK:
+        raise ValueError(
+            f"--comm-strategy is not supported by {args.experiment!r}"
+            f" (supported: {', '.join(_CHUNKS_OK)})"
         )
     if args.remat and args.experiment not in _REMAT_OK:
         raise ValueError(

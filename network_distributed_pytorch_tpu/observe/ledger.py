@@ -239,6 +239,9 @@ def _overlap_extract(report: Dict) -> Dict:
         "n_overlapped",
         "n_async_copy_windows",
         "n_copy_windows_with_compute",
+        "n_sync_collectives",
+        "n_sync_gaps_with_compute",
+        "sync_interleaved",
         "collective_emitters",
     )
     return {k: report[k] for k in keys if k in report}

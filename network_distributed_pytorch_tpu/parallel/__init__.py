@@ -15,6 +15,10 @@ from .comm import (  # noqa: F401
     all_reduce_mean,
     all_gather,
     all_gather_replicated,
+    chunk_bounds,
+    chunked_all_reduce_mean,
+    fence,
+    ring_all_reduce_mean,
 )
 from .packing import TensorPacker  # noqa: F401
 from .hierarchical import HierarchicalReducer  # noqa: F401

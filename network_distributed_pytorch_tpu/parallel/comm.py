@@ -15,13 +15,32 @@ it composes with ``jit`` at zero runtime cost (the reference computes the same
 number at runtime from tensor metadata). Like the reference, bits are counted
 per logical collective payload regardless of world size
 (``reducer.py:127,133,146`` increment unconditionally).
+
+Chunked, software-pipelined reduction (DESIGN.md Round-6): a monolithic
+blocking all-reduce serializes the whole wire time behind compute — the
+regime the paper's slow-network studies care about. :func:`chunk_bounds` +
+:func:`chunked_all_reduce_mean` split a flat payload into K chunks, issue
+one collective per chunk, and fence consecutive chunks with
+``lax.optimization_barrier`` so (a) XLA's all-reduce combiner cannot merge
+them back into one op and (b) the launch order is pinned — chunk *i*'s
+retire compute depends only on chunk *i*'s result, so the latency-hiding
+scheduler is free to run it while chunk *i+1* is on the wire. The default
+``"interleave"`` strategy reduces each chunk with ``pmean`` and is
+**bitwise identical** to the monolithic reduction (an all-reduce is
+elementwise; slicing commutes with it). The opt-in ``"ring"`` strategy
+(:func:`ring_all_reduce_mean`) spells the reduce-scatter/all-gather ring
+out as ``lax.ppermute`` stages — deterministic, but it reassociates the
+cross-worker sum (each shard is summed in a different rotation of rank
+order), so it is exact only on dyadic values and ~1 ulp off otherwise;
+see DESIGN.md Round-6 for why both exist.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 
 
 def n_bits(x: jax.Array | jax.ShapeDtypeStruct) -> int:
@@ -82,6 +101,123 @@ def all_gather_replicated(x: jax.Array, axis_name: Optional[str]) -> jax.Array:
             # the identical op — same wire cost, same stacked result
             all_gather_invariant = jax.lax.all_gather
     return all_gather_invariant(x, axis_name)
+
+
+def chunk_bounds(total: int, n_chunks: int) -> List[Tuple[int, int]]:
+    """Static ``(start, end)`` boundaries splitting ``total`` elements into
+    ``n_chunks`` balanced chunks (the first ``total % n_chunks`` chunks carry
+    one extra element, so the tail chunks are the ragged ones). ``n_chunks``
+    is clamped to ``[1, total]`` — every chunk is non-empty, and the chunk
+    count is exactly ``min(n_chunks, total)``. Pure Python: usable at trace
+    time and in ledger/bits bookkeeping alike."""
+    total = int(total)
+    if total <= 0:
+        return []
+    k = max(1, min(int(n_chunks), total))
+    base, rem = divmod(total, k)
+    bounds = []
+    start = 0
+    for i in range(k):
+        end = start + base + (1 if i < rem else 0)
+        bounds.append((start, end))
+        start = end
+    return bounds
+
+
+def fence(*values):
+    """``lax.optimization_barrier`` over one or more pytrees: the returned
+    values are identical but XLA may neither reorder computations across the
+    barrier nor fuse ops on opposite sides of it. This is the pin that keeps
+    a decomposed chunk schedule decomposed — without it the all-reduce
+    combiner pass is free to re-merge the per-chunk collectives into the
+    monolithic op the decomposition exists to avoid (observed on v5e:
+    4 logical → 2 compiled collectives, OVERLAP.json round-5)."""
+    if not values:
+        return values
+    out = jax.lax.optimization_barrier(values)
+    return out[0] if len(values) == 1 else out
+
+
+def chunked_all_reduce_mean(
+    flat: jax.Array,
+    axis_name: Optional[str],
+    n_chunks: Optional[int],
+    strategy: str = "interleave",
+) -> jax.Array:
+    """Software-pipelined chunked allreduce-mean of a flat buffer.
+
+    ``chunk_bounds`` splits ``flat`` into K chunks; each chunk rides its own
+    collective (``"interleave"`` → ``pmean`` per chunk, bitwise identical to
+    the monolithic reduction; ``"ring"`` → explicit ``ppermute``
+    reduce-scatter/all-gather, see :func:`ring_all_reduce_mean`). Chunk
+    *i*'s payload is fenced against chunk *i-1*'s **result** with
+    ``optimization_barrier``, which (a) stops the combiner from re-fusing
+    the pipeline and (b) orders the launches — while leaving the consumers
+    of chunk *i-1*'s result dependent only on that chunk, so the scheduler
+    overlaps their compute with chunk *i*'s wire time.
+
+    ``n_chunks=None`` (or a single-chunk split) degrades to the plain
+    monolithic path. Wire bytes are invariant in K: the chunk payloads are
+    a partition of the flat buffer.
+    """
+    assert strategy in ("interleave", "ring"), strategy
+    reduce_one = ring_all_reduce_mean if strategy == "ring" else all_reduce_mean
+    bounds = chunk_bounds(flat.size, n_chunks if n_chunks is not None else 1)
+    if len(bounds) <= 1:
+        return reduce_one(flat, axis_name)
+    prev = None
+    outs = []
+    for start, end in bounds:
+        chunk = jax.lax.slice(flat, (start,), (end,))
+        if prev is not None:
+            chunk, prev = fence(chunk, prev)
+        prev = reduce_one(chunk, axis_name)
+        outs.append(prev)
+    return jnp.concatenate(outs)
+
+
+def ring_all_reduce_mean(x: jax.Array, axis_name: Optional[str]) -> jax.Array:
+    """Allreduce-mean spelled out as the classic bidirectional-bandwidth-
+    optimal ring: a ``ppermute`` reduce-scatter (W-1 rotations with
+    in-transit accumulation) followed by a ``ppermute`` all-gather (W-1 more
+    rotations), each stage data-dependent on the previous so the schedule IS
+    the ring. The payload is padded to ``W·ceil(n/W)`` and sliced back.
+
+    Determinism/exactness: every device applies the SAME rotation schedule,
+    so results are deterministic and identical across devices — but shard
+    *s* is summed in rank order ``s, s-1, …`` (a rotation of ``0…W-1`` that
+    differs per shard), which REASSOCIATES the floating-point sum relative
+    to ``pmean``. Exact on dyadic values (integers in float), within ~1 ulp
+    otherwise. The default chunk strategy is ``"interleave"`` for exactly
+    this reason; the ring is the explicit-schedule variant for meshes whose
+    native all-reduce underperforms (or for studying the schedule itself).
+
+    Identity when ``axis_name`` is None or the axis has a single worker.
+    """
+    if axis_name is None:
+        return x
+    world = axis_size(axis_name)
+    if world == 1 or x.size == 0:
+        return x
+    n = int(x.size)
+    shard = -(-n // world)  # ceil: per-device shard length
+    buf = jnp.pad(x.reshape(-1), (0, world * shard - n)).reshape(world, shard)
+    forward = [(j, (j + 1) % world) for j in range(world)]
+    i = jax.lax.axis_index(axis_name)
+    # reduce-scatter: at step t device i sends its running shard (i - t) and
+    # folds the received shard (i - t - 1) into its accumulator; after W-1
+    # steps shard (i + 1) % W is fully summed on device i
+    for t in range(world - 1):
+        send = jnp.take(buf, (i - t) % world, axis=0)
+        recv = jax.lax.ppermute(send, axis_name, forward)
+        buf = buf.at[(i - t - 1) % world].add(recv)
+    # all-gather: rotate the completed shard around the ring; at step t
+    # device i receives shard (i - t) % W, completed W-1 hops upstream
+    cur = jnp.take(buf, (i + 1) % world, axis=0)
+    for t in range(world - 1):
+        cur = jax.lax.ppermute(cur, axis_name, forward)
+        buf = buf.at[(i - t) % world].set(cur)
+    return (buf.reshape(-1)[:n] / world).astype(x.dtype).reshape(x.shape)
 
 
 def axis_size(axis_name: Optional[str]) -> int:

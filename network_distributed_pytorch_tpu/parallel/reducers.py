@@ -53,10 +53,19 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.orthogonalize import orthogonalize
-from .comm import all_reduce_mean, n_bits
+from .comm import all_reduce_mean, chunk_bounds, chunked_all_reduce_mean, n_bits
 from .packing import TensorPacker
 
 PyTree = Any
+
+
+def _n_chunk_collectives(total_size: int, comm_chunks: Optional[int]) -> int:
+    """How many collectives a flat payload of ``total_size`` elements costs
+    under the chunk engine (1 when chunking is off or the payload is empty
+    enough that ``chunk_bounds`` clamps)."""
+    if comm_chunks is None or total_size <= 0:
+        return 1
+    return len(chunk_bounds(total_size, comm_chunks))
 
 
 class ExactReducer:
@@ -70,17 +79,43 @@ class ExactReducer:
     collective count drops from O(#params) to 1. ``packed=False`` restores
     the reference's one-collective-per-tensor structure (for the bandwidth
     study's latency-term comparison).
+
+    ``comm_chunks=K`` splits the packed flat buffer into K chunks riding K
+    fenced collectives (``comm.chunked_all_reduce_mean``): chunk *i*'s
+    unpack/astype retire compute overlaps chunk *i+1*'s wire time under the
+    latency-hiding scheduler. Bitwise identical to the monolithic path and
+    byte-invariant on the ledger (the chunks partition the same buffer).
+    ``comm_strategy="ring"`` swaps each chunk's pmean for the explicit
+    ``ppermute`` ring schedule (deterministic, reassociated — see
+    ``comm.ring_all_reduce_mean``).
     """
 
-    def __init__(self, packed: bool = True):
+    def __init__(
+        self,
+        packed: bool = True,
+        comm_chunks: Optional[int] = None,
+        comm_strategy: str = "interleave",
+    ):
+        assert comm_strategy in ("interleave", "ring"), comm_strategy
+        assert comm_chunks is None or comm_chunks >= 1
+        # chunking decomposes the ONE packed collective; the unpacked path
+        # is already per-tensor (the latency-study structure) and has no
+        # flat buffer to split
+        assert comm_chunks is None or packed, "comm_chunks requires packed=True"
         self.packed = packed
+        self.comm_chunks = comm_chunks
+        self.comm_strategy = comm_strategy
+
+    def _n_chunks(self, leaves) -> int:
+        total = sum(int(l.size) for l in leaves)
+        return _n_chunk_collectives(total, self.comm_chunks)
 
     def init(self, grads_template: PyTree) -> dict:
         return {}
 
     def n_collectives(self, grads_template: PyTree) -> int:
-        n_leaves = len(jax.tree_util.tree_leaves(grads_template))
-        return 1 if self.packed else n_leaves
+        leaves = jax.tree_util.tree_leaves(grads_template)
+        return self._n_chunks(leaves) if self.packed else len(leaves)
 
     def reduce(
         self, state: dict, send: PyTree, axis_name: Optional[str]
@@ -91,7 +126,12 @@ class ExactReducer:
         if self.packed:
             packer = TensorPacker.for_arrays(leaves)
             flat = packer.pack(leaves)
-            reduced = all_reduce_mean(flat, axis_name)
+            if self.comm_chunks is not None:
+                reduced = chunked_all_reduce_mean(
+                    flat, axis_name, self.comm_chunks, self.comm_strategy
+                )
+            else:
+                reduced = all_reduce_mean(flat, axis_name)
             bits = packer.bits()
             out_leaves = [
                 o.astype(l.dtype) for o, l in zip(packer.unpack(reduced), leaves)
@@ -108,7 +148,9 @@ class ExactReducer:
     def ledger_entries(self, grads_template: PyTree, axis: str = "", n_workers: int = 1):
         """Wire-ledger itemization of one exact reduction: the whole gradient
         as one flat-packed all-reduce (or, unpacked, one per-tensor all-reduce
-        batch). Sums to ``reduce``'s analytic ``bits``."""
+        batch; chunked, one all-reduce per chunk — the chunk payloads
+        partition the flat buffer, so ``payload_bytes`` is K-invariant).
+        Sums to ``reduce``'s analytic ``bits``."""
         from ..observe.ledger import LedgerEntry
 
         leaves = jax.tree_util.tree_leaves(grads_template)
@@ -125,7 +167,7 @@ class ExactReducer:
                 # per-leaf analytic bytes (the trainer's bits_per_step model);
                 # equals the packed flat buffer for uniform-dtype params
                 payload_bytes=sum(n_bits(l) for l in leaves) // 8,
-                count=1 if self.packed else len(leaves),
+                count=self._n_chunks(leaves) if self.packed else len(leaves),
             )
         ]
 
@@ -166,6 +208,15 @@ class PowerSGDReducer:
     ``"last"`` = ``reshape(-1, shape[-1])``, the flax/TPU-natural rule
     (HWIO conv kernels / (in, out) dense kernels put output features last).
     Both give the same (n+m)·r wire cost up to transposition.
+
+    ``comm_chunks=K`` runs every payload (P, Q, rank-1) through the fenced
+    chunk engine (``comm.chunked_all_reduce_mean``): each buffer splits into
+    up to K per-chunk collectives whose retire compute — unpacking and the
+    per-bucket Gram-Schmidt for P, the decompress matmuls for Q — depends
+    only on its own chunk, so it overlaps the later chunks' wire time.
+    Bitwise identical to the monolithic path; ledger bytes are K-invariant.
+    ``comm_strategy="ring"`` swaps each chunk's pmean for the explicit
+    ``ppermute`` ring (deterministic, reassociated).
     """
 
     def __init__(
@@ -177,6 +228,8 @@ class PowerSGDReducer:
         matricize: str = "first",
         orthogonalize_impl: str = "xla",
         compression_dtype=None,
+        comm_chunks: Optional[int] = None,
+        comm_strategy: str = "interleave",
     ):
         # The reference asserts n_power_iterations == 0 (reducer.py:30 — "0"
         # meaning the single fused iteration). Beyond parity, we support k
@@ -187,6 +240,10 @@ class PowerSGDReducer:
         assert n_power_iterations >= 0
         assert matricize in ("first", "last")
         assert orthogonalize_impl in ("xla", "pallas")
+        assert comm_strategy in ("interleave", "ring"), comm_strategy
+        assert comm_chunks is None or comm_chunks >= 1
+        self.comm_chunks = comm_chunks
+        self.comm_strategy = comm_strategy
         self.n_power_iterations = n_power_iterations
         self.random_seed = random_seed
         self.reuse_query = reuse_query
@@ -277,6 +334,14 @@ class PowerSGDReducer:
         rank1_packer = TensorPacker([tuple(leaves[i].shape) for i in rank1], dtype=dtype)
         return p_packer, q_packer, rank1_packer
 
+    def _reduce_flat(self, flat: jax.Array, axis_name: Optional[str]) -> jax.Array:
+        """One packed payload through the configured reduction engine."""
+        if self.comm_chunks is None:
+            return all_reduce_mean(flat, axis_name)
+        return chunked_all_reduce_mean(
+            flat, axis_name, self.comm_chunks, self.comm_strategy
+        )
+
     # ---- state -----------------------------------------------------------
 
     def init(self, grads_template: PyTree) -> PowerSGDState:
@@ -346,7 +411,7 @@ class PowerSGDReducer:
             # Step 4: ALL_REDUCE_MEAN(P) — ONE collective for all Ps
             # (reducer.py:125-128)
             if ps:
-                p_flat = all_reduce_mean(p_packer.pack(ps), axis_name)
+                p_flat = self._reduce_flat(p_packer.pack(ps), axis_name)
                 bits += n_bits(p_flat)
                 math_dtype = matrices[0].dtype
                 ps = [p.astype(math_dtype) for p in p_packer.unpack(p_flat)]
@@ -358,7 +423,7 @@ class PowerSGDReducer:
             # issue ORDER is mirrored.
             if it == 0 and rank1_idx:
                 rank1_flat = rank1_packer.pack([leaves[i] for i in rank1_idx])
-                rank1_reduced = all_reduce_mean(rank1_flat, axis_name)
+                rank1_reduced = self._reduce_flat(rank1_flat, axis_name)
                 bits += rank1_packer.bits()
                 rank1_out = [
                     o.astype(leaves[i].dtype)
@@ -384,7 +449,7 @@ class PowerSGDReducer:
             # Step 7: ALL_REDUCE_MEAN(Q) — ONE collective for all Qs
             # (reducer.py:144-147)
             if qs:
-                q_flat = all_reduce_mean(q_packer.pack(qs), axis_name)
+                q_flat = self._reduce_flat(q_packer.pack(qs), axis_name)
                 bits += n_bits(q_flat)
                 qs = [q.astype(matrices[0].dtype) for q in q_packer.unpack(q_flat)]
                 new_q_memory = q_flat
@@ -431,7 +496,9 @@ class PowerSGDReducer:
     def ledger_entries(self, grads_template: PyTree, axis: str = "", n_workers: int = 1):
         """Wire-ledger itemization of one compressed reduction: the P and Q
         factor all-reduces (one each per power-iteration round) and the
-        uncompressed rank-1 payload. Sums to :meth:`bits_per_step`."""
+        uncompressed rank-1 payload. With ``comm_chunks`` each payload's
+        ``count`` multiplies by its chunk count while ``payload_bytes`` stays
+        put (the chunks partition the buffer). Sums to :meth:`bits_per_step`."""
         from ..observe.ledger import LedgerEntry
 
         leaves = jax.tree_util.tree_leaves(grads_template)
@@ -439,12 +506,13 @@ class PowerSGDReducer:
         p_packer, q_packer, rank1_packer = self._packers(leaves, metas)
         rounds = 1 + self.n_power_iterations
         entries = []
-        for tag, packer, count in (
+        for tag, packer, repeats in (
             ("powersgd.P", p_packer, rounds),
             ("powersgd.Q", q_packer, rounds),
             ("powersgd.rank1", rank1_packer, 1),
         ):
             if packer.bits():
+                chunks = _n_chunk_collectives(packer.total_size, self.comm_chunks)
                 entries.append(
                     LedgerEntry(
                         tag=tag,
@@ -452,8 +520,8 @@ class PowerSGDReducer:
                         op="all-reduce",
                         axis=axis,
                         dtype=str(packer.dtype),
-                        payload_bytes=count * packer.bits() // 8,
-                        count=count,
+                        payload_bytes=repeats * packer.bits() // 8,
+                        count=repeats * chunks,
                     )
                 )
         return entries

@@ -545,6 +545,12 @@ def make_train_step(
     ``accum_steps > 1``: gradient accumulation (see :func:`make_step_fn`);
     batch leaves then carry a leading ``accum_steps`` axis ahead of the
     sharded batch axis.
+
+    Chunked pipelined reduction rides the REDUCER, not this builder:
+    construct it with ``comm_chunks=K`` (ExactReducer / PowerSGDReducer)
+    and the step's ledger itemizes the per-chunk collectives automatically
+    (``ledger_entries`` counts chunks; payload bytes and ``bits_per_step``
+    are K-invariant, so the ``step_ledger`` equality assert still pins them).
     """
     if mesh is None:
         body = make_step_fn(

@@ -40,6 +40,16 @@ class ExperimentConfig:
     log_every: int = 10
     accum_steps: int = 1  # gradient accumulation microbatches per step
     max_grad_norm: Optional[float] = None  # global-norm gradient clipping
+    # chunked software-pipelined reduction (parallel.comm, DESIGN.md
+    # Round-6): split each reducer payload into K fenced chunk collectives
+    # so chunk i's retire compute overlaps chunk i+1's wire time. None =
+    # today's monolithic collectives; worth trying on slow-interconnect
+    # (DCN / sub-ICI) meshes where wire time dominates the step.
+    comm_chunks: Optional[int] = None
+    # "interleave" (default; per-chunk pmean, bitwise identical to the
+    # monolithic path) or "ring" (explicit ppermute reduce-scatter/
+    # all-gather schedule — deterministic but reassociated, ~1 ulp)
+    comm_strategy: str = "interleave"
 
     # observability (observe/): structured JSONL run log, jax.profiler trace
     # directory, and the compile-time wire-ledger-vs-HLO audit. audit_wire

@@ -22,6 +22,14 @@ them — both are extracted here. Generic ``async-start`` wrappers (the
 async-collective-fusion form) are recognized too, classified by the
 wrapped collective. On CPU the backend emits synchronous collectives and
 no DMA windows, so the report honestly zeroes those fields.
+
+For the chunked pipelined schedules (``parallel.comm``, DESIGN.md Round-6)
+the report also attributes evidence to SPECIFIC collectives: every async
+window carries the ``name`` of its start op, and synchronous collectives
+(the CPU backend, and any TPU op the emitter keeps synchronous) are listed
+in schedule order with the compute ops scheduled between each and the
+next — ``n_sync_gaps_with_compute > 0`` is the textual-interleave proof
+that the chunk collectives did not compile back into one blocking op.
 """
 
 from __future__ import annotations
@@ -57,6 +65,12 @@ _EMITTER_RE = re.compile(r'"emitter":"(\w+)","strategy":"(\w+)"')
 # ops that do real work while a collective is in flight; fusions are where
 # XLA puts elementwise/reduction compute, dot/conv are the MXU ops
 _COMPUTE_RE = re.compile(r"= [^=]*?(?:fusion|dot|convolution)\(")
+# a SYNCHRONOUS collective: the kind immediately followed by its operand
+# paren (the -start/-done forms have a suffix there, so they can't match)
+_SYNC_RE = re.compile(
+    r"%(?P<name>[\w.\-]+) = [^=]*?\b"
+    r"(?P<kind>all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)\("
+)
 
 
 @dataclass
@@ -66,18 +80,39 @@ class AsyncCollective:
     done_line: int
     ops_between: int
     compute_ops_between: int
+    name: str = ""  # HLO name of the start op — ties evidence to a chunk
 
     @property
     def overlapped(self) -> bool:
         return self.compute_ops_between > 0
 
 
+def _entry_mask(lines: List[str]) -> List[bool]:
+    """True for lines inside an ``ENTRY`` computation (the scheduled body;
+    collectives inside async-wrapper sub-computations must not be counted
+    twice). Multiple modules may be concatenated, so there may be several
+    entry blocks."""
+    mask = [False] * len(lines)
+    inside = False
+    for i, line in enumerate(lines):
+        if line.lstrip().startswith("ENTRY"):
+            inside = True
+            continue
+        if inside and line.rstrip() == "}":
+            inside = False
+            continue
+        mask[i] = inside
+    return mask
+
+
 def overlap_report(hlo_text: str) -> Dict[str, object]:
     """Scan the scheduled entry computation for ``-start``/``-done`` pairs
     and count the (compute) instructions scheduled inside each window."""
     lines = hlo_text.splitlines()
+    entry = _entry_mask(lines)
     pending: Dict[str, tuple] = {}  # %name -> (kind, line_no)
     collectives: List[AsyncCollective] = []
+    sync: List[Dict[str, object]] = []  # schedule-ordered sync collectives
     n_copy_windows = 0
     n_copy_windows_with_compute = 0
     for i, line in enumerate(lines):
@@ -101,7 +136,8 @@ def overlap_report(hlo_text: str) -> Dict[str, object]:
             continue
         dm = re.search(r"-done\(%?([\w.\-]+)", line)
         if dm and dm.group(1) in pending:
-            kind, start = pending.pop(dm.group(1))
+            name = dm.group(1)
+            kind, start = pending.pop(name)
             if kind == "async-compute":
                 continue  # generic async wrapper around non-collective work
             window = lines[start + 1 : i]
@@ -121,8 +157,27 @@ def overlap_report(hlo_text: str) -> Dict[str, object]:
                     compute_ops_between=sum(
                         1 for w in window if _COMPUTE_RE.search(w)
                     ),
+                    name=name,
                 )
             )
+            continue
+        if entry[i]:
+            sm = _SYNC_RE.search(line)
+            if sm:
+                sync.append(
+                    {"name": sm.group("name"), "kind": sm.group("kind"), "line": i}
+                )
+    # attribute in-schedule compute to the sync collective it follows: the
+    # ops between collective j and j+1 are what the backend can run while
+    # j's successor chunk has not yet been launched — on sync backends this
+    # textual interleaving IS the decomposed-pipeline evidence
+    for j, op in enumerate(sync):
+        end = sync[j + 1]["line"] if j + 1 < len(sync) else len(lines)
+        gap = lines[op["line"] + 1 : end]
+        op["compute_ops_after"] = sum(1 for w in gap if _COMPUTE_RE.search(w))
+    interior_gaps_with_compute = sum(
+        1 for op in sync[:-1] if op["compute_ops_after"] > 0
+    )
     overlapped = [c for c in collectives if c.overlapped]
     return {
         "scheduled": "is_scheduled=true" in hlo_text,
@@ -134,6 +189,14 @@ def overlap_report(hlo_text: str) -> Dict[str, object]:
         # how many have real compute scheduled inside them
         "n_async_copy_windows": n_copy_windows,
         "n_copy_windows_with_compute": n_copy_windows_with_compute,
+        # synchronous collectives in schedule order, each with the compute
+        # scheduled between it and the next collective; gaps-with-compute
+        # counts the INTERIOR gaps only (compute after the last collective
+        # proves nothing about interleaving)
+        "n_sync_collectives": len(sync),
+        "sync_collectives": sync,
+        "n_sync_gaps_with_compute": interior_gaps_with_compute,
+        "sync_interleaved": len(sync) >= 2 and interior_gaps_with_compute > 0,
         # which TPU collective emitter/strategy runs the (synchronous-in-
         # HLO) collectives — e.g. RotatedPincerShortEmitter / StrategyRing:
         # the op's async-ness lives in the emitter on the ICI ring, not in

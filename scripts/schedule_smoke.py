@@ -1,0 +1,109 @@
+"""CI schedule smoke (Round-6): AOT-compile one chunked step per reducer on
+the CPU backend — NO execution — and assert the compiled executable still
+carries the decomposed pipeline:
+
+  1. compiled collective count == Σ ledger entry counts (the barrier-fenced
+     chunks must not be re-fused into one blocking op), and
+  2. HLO collective payload bytes == ledger bytes (per-chunk itemization
+     stays byte-exact against the analytic bits_per_step model).
+
+Fails loudly on either drift — this is the cheap canary for an XLA upgrade
+(or a comm.py edit) silently un-pipelining the chunk schedule. Runs in a
+few seconds: tiny MLP, ``lower().compile()`` on abstract args only.
+
+Invoked by run_tests.sh before the pytest tier with the same CPU/8-device
+environment; standalone use needs that env too::
+
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \\
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python scripts/schedule_smoke.py
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import network_distributed_pytorch_tpu._jax_compat  # noqa: F401 — shard_map shims
+
+import jax
+import jax.numpy as jnp
+
+from network_distributed_pytorch_tpu.parallel import (
+    ExactReducer,
+    PowerSGDReducer,
+    make_mesh,
+)
+from network_distributed_pytorch_tpu.parallel.trainer import (
+    make_train_step,
+    stateless_loss,
+)
+from network_distributed_pytorch_tpu.utils.hlo_audit import (
+    collective_summary,
+    hlo_text_of_compiled,
+)
+from network_distributed_pytorch_tpu.utils.overlap import overlap_report
+
+
+def check(label, reducer, params, mesh):
+    loss = stateless_loss(
+        lambda p, b: jnp.mean((b[0] @ p["w"] + p["b"] - b[1]) ** 2)
+    )
+    step = make_train_step(
+        loss, reducer, params, 0.05, mesh=mesh, donate_state=False
+    )
+    state_abs = jax.eval_shape(step.init_state, params)
+    batch_abs = (
+        jax.ShapeDtypeStruct((16, 32), jnp.float32),
+        jax.ShapeDtypeStruct((16, 16), jnp.float32),
+    )
+    hlo = hlo_text_of_compiled(step.fn.lower(state_abs, batch_abs).compile())
+    summary = collective_summary(hlo)
+    ledger_count = sum(e.count for e in step.ledger.entries)
+    ledger_bytes = step.ledger.total_bytes()
+    errors = []
+    if summary["count"] != ledger_count:
+        errors.append(
+            f"collective count drifted: compiled {summary['count']} != "
+            f"ledger {ledger_count} — the fenced chunks were re-fused "
+            f"(by_kind: {summary['by_kind']})"
+        )
+    if int(summary["total_payload_bytes"]) != ledger_bytes:
+        errors.append(
+            f"payload bytes drifted: compiled {summary['total_payload_bytes']}"
+            f" != ledger {ledger_bytes}"
+        )
+    rep = overlap_report(hlo)
+    interleaved = rep["sync_interleaved"] or rep["n_overlapped"] >= 2
+    status = "ok" if not errors else "FAIL"
+    sys.stderr.write(
+        f"# schedule-smoke {label}: {status} — {summary['count']} collectives"
+        f" ({summary['by_kind']}), {ledger_bytes} bytes,"
+        f" interleaved={interleaved}\n"
+    )
+    return [f"{label}: {e}" for e in errors]
+
+
+def main() -> int:
+    mesh = make_mesh()
+    params = {"w": jnp.zeros((32, 16)), "b": jnp.zeros((16,))}
+    errors = []
+    # K=3 on a 528-element gradient: ragged chunks (176 each here; the
+    # reducers clamp if a payload is smaller than K)
+    errors += check("exact-k3", ExactReducer(comm_chunks=3), params, mesh)
+    errors += check(
+        "powersgd-k2",
+        PowerSGDReducer(
+            random_seed=7, compression_rank=2, matricize="last", comm_chunks=2
+        ),
+        params,
+        mesh,
+    )
+    for e in errors:
+        sys.stderr.write(f"# schedule-smoke ERROR: {e}\n")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

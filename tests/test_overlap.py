@@ -125,3 +125,128 @@ def test_overlap_report_copy_windows_counted():
     assert rep["collectives"] == []
     assert rep["n_async_copy_windows"] == 2
     assert rep["n_copy_windows_with_compute"] == 1
+
+
+def test_overlap_report_async_compute_wrapper_skipped():
+    """A generic async-start wrapping NON-collective work (no collective
+    kind named on the line) must be dropped at its -done, not reported as
+    an async collective — and must not shadow a real window around it."""
+    hlo = "\n".join([
+        "HloModule m, is_scheduled=true",
+        "ENTRY %main () -> f32[8] {",
+        "  %p = f32[8]{0} parameter(0)",
+        "  %ac = ((f32[8]), f32[8]) async-start(%p), calls=%wrapped_fusion.3",
+        "  %ar-start = f32[96]{0} all-reduce-start(%p), to_apply=%add",
+        "  %f1 = f32[8]{0} fusion(%p), kind=kLoop",
+        "  %acd = f32[8]{0} async-done(%ac)",
+        "  %ar-done = f32[96]{0} all-reduce-done(%ar-start)",
+        "}",
+    ])
+    rep = overlap_report(hlo)
+    # only the real collective window is reported; the compute wrapper is
+    # skipped silently (its window would otherwise double-count the fusion)
+    assert rep["n_async_collectives"] == 1
+    assert rep["collectives"][0]["kind"] == "all-reduce"
+    assert rep["collectives"][0]["name"] == "ar-start"
+    assert rep["n_overlapped"] == 1
+
+
+_CHUNKED_SYNC_HLO = """\
+HloModule jit_step, is_scheduled=true
+
+%wrapped_ar (x: f32[8]) -> f32[8] {
+  %x = f32[8]{0} parameter(0)
+  ROOT %inner = f32[8]{0} all-reduce(%x), to_apply=%add
+}
+
+ENTRY %main (p0: f32[24]) -> f32[24] {
+  %p0 = f32[24]{0} parameter(0)
+  %ar.1 = f32[8]{0} all-reduce(%s0), replica_groups={}, to_apply=%add
+  %retire.1 = f32[8]{0} fusion(%ar.1), kind=kLoop, calls=%unpack1
+  %ar.2 = f32[8]{0} all-reduce(%s1), replica_groups={}, to_apply=%add
+  %retire.2 = f32[8]{0} fusion(%ar.2), kind=kLoop, calls=%unpack2
+  %ar.3 = f32[8]{0} all-reduce(%s2), replica_groups={}, to_apply=%add
+  ROOT %out = f32[24]{0} fusion(%retire.1, %retire.2, %ar.3), kind=kOutput
+}
+"""
+
+
+def test_overlap_report_sync_interleave_fields():
+    """Synchronous chunk collectives (the CPU backend, Round-6 pipeline)
+    are listed in schedule order with the compute between each and the
+    next; only INTERIOR gaps count toward the interleave verdict, and the
+    all-reduce inside the non-ENTRY wrapper computation is not counted."""
+    rep = overlap_report(_CHUNKED_SYNC_HLO)
+    assert rep["n_sync_collectives"] == 3
+    names = [op["name"] for op in rep["sync_collectives"]]
+    assert names == ["ar.1", "ar.2", "ar.3"]
+    gaps = [op["compute_ops_after"] for op in rep["sync_collectives"]]
+    # ar.1 -> retire.1; ar.2 -> retire.2; ar.3 -> the ROOT fusion (tail)
+    assert gaps == [1, 1, 1]
+    assert rep["n_sync_gaps_with_compute"] == 2  # interior gaps only
+    assert rep["sync_interleaved"]
+
+
+def test_overlap_report_sync_single_collective_not_interleaved():
+    """One collective cannot interleave with itself: compute after the
+    LAST collective proves nothing, so the verdict stays False."""
+    hlo = "\n".join([
+        "HloModule m, is_scheduled=true",
+        "ENTRY %main () -> f32[8] {",
+        "  %ar = f32[8]{0} all-reduce(%p), to_apply=%add",
+        "  %f = f32[8]{0} fusion(%ar), kind=kLoop",
+        "}",
+    ])
+    rep = overlap_report(hlo)
+    assert rep["n_sync_collectives"] == 1
+    assert rep["n_sync_gaps_with_compute"] == 0
+    assert not rep["sync_interleaved"]
+
+
+def test_overlap_report_sync_ignores_start_done_forms():
+    """The sync matcher must not re-count async -start/-done pairs (the
+    kind is followed by '-start('/'-done(' there, never '(')."""
+    hlo = "\n".join([
+        "HloModule m, is_scheduled=true",
+        "ENTRY %main () -> f32[8] {",
+        "  %ar-start = f32[96]{0} all-reduce-start(%x), to_apply=%add",
+        "  %f1 = f32[8]{0} fusion(%x), kind=kLoop",
+        "  %ar-done = f32[96]{0} all-reduce-done(%ar-start)",
+        "}",
+    ])
+    rep = overlap_report(hlo)
+    assert rep["n_async_collectives"] == 1
+    assert rep["n_sync_collectives"] == 0
+    assert not rep["sync_interleaved"]
+
+
+def test_overlap_report_chunked_cpu_step_interleaves(devices):
+    """End-to-end Round-6 evidence on a REAL compiled chunked step: the CPU
+    backend keeps the K fenced chunk all-reduces separate, schedule order
+    interleaves them with retire compute, and every window carries a name."""
+    import jax.numpy as jnp
+
+    from network_distributed_pytorch_tpu.parallel import ExactReducer, make_mesh
+    from network_distributed_pytorch_tpu.parallel.trainer import (
+        make_train_step,
+        stateless_loss,
+    )
+    from network_distributed_pytorch_tpu.utils.hlo_audit import compiled_hlo_text
+
+    params = {"w": jnp.zeros((32, 16)), "b": jnp.zeros((16,))}
+    loss = stateless_loss(
+        lambda p, b: jnp.mean((b[0] @ p["w"] + p["b"] - b[1]) ** 2)
+    )
+    step = make_train_step(
+        loss, ExactReducer(comm_chunks=3), params, 0.05,
+        mesh=make_mesh(), donate_state=False,
+    )
+    state = step.init_state(params)
+    batch = (jnp.zeros((16, 32)), jnp.zeros((16, 16)))
+    rep = overlap_report(compiled_hlo_text(step.fn, state, batch))
+    # 3 grad chunks + the loss-sync pmean, all synchronous on CPU
+    assert rep["n_sync_collectives"] == 4
+    assert rep["n_async_collectives"] == 0
+    assert rep["sync_interleaved"]
+    assert rep["n_sync_gaps_with_compute"] >= 2
+    assert all(op["name"] for op in rep["sync_collectives"])
