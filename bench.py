@@ -1412,6 +1412,7 @@ def orchestrate() -> int:
     out["partial"] = False
     out["wall_s"] = round(time.time() - t_start, 1)
     _persist_midround(out, status)
+    _record_gate_baseline(out, status)
     _emit(out)
     # the full record above stays the authoritative line; the bounded
     # summary AFTER it is what a fixed-size tail is guaranteed to hold
@@ -1464,6 +1465,37 @@ def _persist_midround(out: dict, status: dict) -> None:
             json.dump(rec, f, indent=1)
         os.replace(tmp, path)
     except OSError:  # persistence is best-effort; the line already printed
+        pass
+
+
+def _record_gate_baseline(out: dict, status: dict) -> None:
+    """Record the round's headline throughput as the perf-gate baseline
+    (artifacts/GATE_BASELINE.json, read by scripts/gate.py). Any round
+    with a plain-ok flagship qualifies — unlike the midround artifact the
+    gate compares like-for-like on whatever hardware CI runs, so a CPU
+    smoke baseline is still a valid regression reference for CPU CI."""
+    if status.get("flagship") != "ok" or not out.get("flagship_imgs_per_sec"):
+        return
+    rec = {
+        "schema": 1,
+        "source": "bench.py",
+        "recorded_unix": int(time.time()),
+        "platform": out.get("platform"),
+        "preset": out.get("preset"),
+        "value_tier": out.get("value_tier"),
+        "flagship_imgs_per_sec": out.get("flagship_imgs_per_sec"),
+        "value": out.get("value"),
+        "vs_baseline": out.get("vs_baseline"),
+        "phases": {k: str(v)[:60] for k, v in status.items()},
+    }
+    path = os.path.join(HERE, "artifacts", "GATE_BASELINE.json")
+    try:
+        os.makedirs(os.path.join(HERE, "artifacts"), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f, indent=1)
+        os.replace(tmp, path)
+    except OSError:  # best-effort, like the midround artifact
         pass
 
 

@@ -291,6 +291,12 @@ def audited_carry_loop(
                         "n_overlapped",
                         "n_async_copy_windows",
                         "n_copy_windows_with_compute",
+                        # the sync-interleave keys: what comm_attribution
+                        # (and observe.analytics' bandwidth estimator)
+                        # charges to the critical path
+                        "n_sync_collectives",
+                        "n_sync_gaps_with_compute",
+                        "sync_interleaved",
                         "collective_emitters",
                     )
                     if k in ov

@@ -239,6 +239,14 @@ def build_parser() -> argparse.ArgumentParser:
              " audits) to this path; read it back with scripts/report.py",
     )
     p.add_argument(
+        "--run-dir", type=str, default=None,
+        help="run-level observability directory: the supervising parent"
+             " writes the run manifest (observe.runlog) and its own event"
+             " shard there, each worker appends events_rank<R>.jsonl; merge"
+             " with scripts/report.py --run-dir (use a FRESH directory per"
+             " run)",
+    )
+    p.add_argument(
         "--trace-dir", type=str, default=None,
         help="capture a jax.profiler trace of the run under this directory",
     )
@@ -320,7 +328,10 @@ def worker_argv_base(argv) -> list:
 def _supervise(args, argv) -> dict:
     """Run as the supervising parent: every worker is this same CLI with
     ``--process-id``/``--num-processes`` rewritten per (rank, world)."""
-    from .observe import telemetry_for_run
+    import os
+
+    from .observe import MarkerEvent, telemetry_for_run
+    from .observe import runlog as _runlog
     from .resilience.supervisor import Supervisor, SupervisorConfig
 
     base = worker_argv_base(argv)
@@ -331,9 +342,14 @@ def _supervise(args, argv) -> dict:
             *base, "--process-id", str(rank), "--num-processes", str(world),
         ]
 
-    telemetry = telemetry_for_run(event_log=args.event_log)
+    # with a run dir, the parent's own events land in the conventional
+    # supervisor shard so the merged timeline includes the failure domain
+    event_log = args.event_log
+    if args.run_dir and not event_log:
+        event_log = os.path.join(args.run_dir, _runlog.SUPERVISOR_LOG)
+    telemetry = telemetry_for_run(event_log=event_log)
     with telemetry:
-        result = Supervisor(
+        supervisor = Supervisor(
             argv_for_rank,
             world_size=args.num_processes,
             config=SupervisorConfig(
@@ -348,7 +364,16 @@ def _supervise(args, argv) -> dict:
             ),
             telemetry=telemetry,
             log_dir=args.worker_log_dir,
-        ).run()
+            run_dir=args.run_dir,
+        )
+        if args.run_dir:
+            telemetry.emit(
+                MarkerEvent(
+                    kind="run_start", run_id=supervisor.run_id or "",
+                    world_size=args.num_processes,
+                )
+            )
+        result = supervisor.run()
     summary = {
         "supervised": True,
         "experiment": args.experiment,
@@ -358,6 +383,9 @@ def _supervise(args, argv) -> dict:
         "degraded": result.degraded,
         "reason": result.reason,
     }
+    if args.run_dir:
+        summary["run_dir"] = args.run_dir
+        summary["run_id"] = supervisor.run_id
     if args.json:
         Telemetry([StreamJsonSink(sys.stdout)]).emit(RawEvent(summary))
     if not result.success:
@@ -369,6 +397,23 @@ def main(argv=None) -> dict:
     args = build_parser().parse_args(argv)
     if args.supervise:
         return _supervise(args, argv if argv is not None else sys.argv[1:])
+    if args.run_dir:
+        # a worker rank of a run-dir launch: derive this rank's event shard,
+        # and make sure the run env is present so telemetry_for_run leads
+        # the shard with the run_start marker (supervised workers inherit
+        # the env from the parent — setdefault keeps the parent's run id)
+        import os
+
+        from .observe import runlog as _runlog
+
+        os.environ.setdefault(_runlog.ENV_RUN_DIR, args.run_dir)
+        os.environ.setdefault(
+            _runlog.ENV_RUN_ID, _runlog.default_run_id(args.run_dir)
+        )
+        os.environ.setdefault("RESILIENCE_RANK", str(args.process_id))
+        os.environ.setdefault("RESILIENCE_WORLD", str(args.num_processes))
+        if not args.event_log:
+            args.event_log = _runlog.shard_path(args.run_dir, args.process_id)
     cfg = config_from_args(args)
 
     # reject silently-ignored flags BEFORE any rendezvous: a pure-CLI error

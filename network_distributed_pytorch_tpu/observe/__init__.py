@@ -19,15 +19,27 @@ that loop as a first-class subsystem instead of scattered fragments:
   collective a compiled step issues, tagged with (layer, op, axis, dtype,
   payload bytes), reconciled byte-exactly against the compiled HLO via
   ``utils.hlo_audit`` at trainer-compile time.
+- :mod:`observe.runlog`    — the RUN level: the manifest a supervised
+  launch writes (run id, world size, shard layout, spawn records) and the
+  merger that aligns per-rank shards into one supervisor-clock-ordered
+  timeline (run-start-marker clock-offset correction, torn-tail
+  tolerance).
+- :mod:`observe.analytics` — straggler detection (typed
+  ``StragglerEvent``) and the effective-bandwidth estimator joining
+  ledger bytes, measured step times, and schedule overlap attribution.
 
 ``scripts/report.py`` turns a JSONL run log back into a human report
 (step-time percentiles, bytes/step by tag, compression ratio,
-analytic-vs-HLO delta, overlap stats).
+analytic-vs-HLO delta, overlap stats) — and with ``--run-dir``, a whole
+run directory into the merged multi-rank report plus
+``artifacts/run_report.json``, which ``scripts/gate.py`` compares against
+the recorded baseline.
 
 Everything imported here is jax-free, so the bench parent orchestrator
 (which deliberately imports no jax) can use the same sinks.
 """
 
+from . import analytics, runlog  # noqa: F401
 from .events import (  # noqa: F401
     SCHEMA_VERSION,
     CollectiveEvent,
@@ -35,9 +47,11 @@ from .events import (  # noqa: F401
     EpochEvent,
     Event,
     FailureEvent,
+    MarkerEvent,
     NoteEvent,
     RawEvent,
     StepEvent,
+    StragglerEvent,
 )
 from .ledger import LedgerEntry, WireLedger  # noqa: F401
 from .sinks import (  # noqa: F401

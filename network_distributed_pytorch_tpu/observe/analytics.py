@@ -1,0 +1,248 @@
+"""Run-level analytics over a merged cross-rank timeline.
+
+Two estimators close the loops the ROADMAP's "fast as the hardware allows"
+north star needs closed:
+
+**Straggler detection.** Per-rank steady-state step-duration distributions
+(the first timed step per rank pays jit compilation and is dropped, the
+same convention as ``scripts/report.py``); a rank whose p50 exceeds the
+cross-rank median p50 by more than a configurable factor is flagged as a
+typed :class:`observe.events.StragglerEvent`. The median is the baseline —
+robust to the stragglers themselves — and the default factor of 1.5x sits
+above same-host scheduling jitter (tens of percent) but below the 2x+
+signature of a genuinely slow or contended rank (see DESIGN.md).
+
+**Effective bandwidth.** The wire ledger says how many bytes each
+collective moves per step (exact — reconciled against the compiled HLO);
+the measured step time says how long a step takes; the schedule's overlap
+extract (``utils.overlap.comm_attribution``) says what fraction of the
+collectives are exposed on the critical path. ``bytes / (step_p50 ×
+exposed_fraction)`` is the achieved wire rate, compared against every
+``FABRICS_BYTES_PER_S`` line rate as a utilization fraction and against
+the ring model (``utils.bandwidth.allreduce_time_s``) as the
+measured-vs-modeled verdict — the accounting PowerSGD's speedup claims
+rest on, finally computed from a real multi-rank run.
+
+jax-free: ``utils.overlap`` / ``utils.bandwidth`` are themselves stdlib-only
+but live in a package whose ``__init__`` imports jax, so they are loaded by
+file path here — observe (and ``scripts/report.py``) must import cleanly on
+a machine that only has the log files.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import Dict, List, Optional, Sequence
+
+from .events import StragglerEvent
+
+DEFAULT_STRAGGLER_FACTOR = 1.5
+
+_UTILS_CACHE: Dict[str, object] = {}
+
+
+def _load_utils_module(name: str):
+    """Load ``utils/<name>.py`` WITHOUT executing the package ``__init__``
+    (which imports jax): both modules are stdlib-only by design."""
+    if name not in _UTILS_CACHE:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "utils",
+            name + ".py",
+        )
+        modname = f"_observe_analytics_{name}"
+        spec = importlib.util.spec_from_file_location(modname, path)
+        mod = importlib.util.module_from_spec(spec)
+        # dataclass processing resolves cls.__module__ through sys.modules
+        sys.modules[modname] = mod
+        spec.loader.exec_module(mod)
+        _UTILS_CACHE[name] = mod
+    return _UTILS_CACHE[name]
+
+
+def __getattr__(name: str):
+    # surface the fabric line-rate table without a jax-pulling package
+    # import (PEP 562 lazy attribute)
+    if name == "FABRICS_BYTES_PER_S":
+        return _load_utils_module("bandwidth").FABRICS_BYTES_PER_S
+    raise AttributeError(name)
+
+
+def percentile(values: Sequence[float], p: float) -> float:
+    """Nearest-rank percentile (exact for the small samples a run has)."""
+    if not values:
+        return float("nan")
+    ordered = sorted(values)
+    k = max(0, min(len(ordered) - 1, round(p / 100 * (len(ordered) - 1))))
+    return ordered[int(k)]
+
+
+# ---------------------------------------------------------------------------
+# straggler detection
+# ---------------------------------------------------------------------------
+
+
+def rank_step_stats(events: List[Dict], drop_first: bool = True) -> Dict[int, Dict]:
+    """Per-rank step-duration distributions from merged (rank-tagged)
+    events: ``{rank: {n, p50_s, p95_s, mean_s}}``. Only ``valid`` steps
+    count; with ``drop_first`` the first timed step per rank (jit compile)
+    is excluded when the rank has more than one."""
+    durations: Dict[int, List[float]] = {}
+    for e in events:
+        if e.get("event") != "step" or not e.get("valid", True):
+            continue
+        rank = e.get("rank")
+        dt = e.get("step_time_s")
+        if rank is None or not isinstance(dt, (int, float)):
+            continue
+        durations.setdefault(int(rank), []).append(float(dt))
+    stats: Dict[int, Dict] = {}
+    for rank, d in sorted(durations.items()):
+        steady = d[1:] if drop_first and len(d) > 1 else d
+        stats[rank] = {
+            "n": len(steady),
+            "p50_s": percentile(steady, 50),
+            "p95_s": percentile(steady, 95),
+            "mean_s": sum(steady) / len(steady),
+        }
+    return stats
+
+
+def detect_stragglers(
+    stats: Dict[int, Dict],
+    factor: float = DEFAULT_STRAGGLER_FACTOR,
+    min_steps: int = 2,
+) -> List[StragglerEvent]:
+    """Flag every rank whose steady-state p50 exceeds ``factor`` times the
+    cross-rank median p50. Needs at least two ranks with ``min_steps``
+    timed steps each — a one-rank run has no peer to lag behind."""
+    eligible = {
+        r: s for r, s in stats.items()
+        if s["n"] >= min_steps and s["p50_s"] == s["p50_s"]  # not NaN
+    }
+    if len(eligible) < 2:
+        return []
+    median = percentile([s["p50_s"] for s in eligible.values()], 50)
+    if not median > 0:
+        return []
+    out: List[StragglerEvent] = []
+    for rank, s in sorted(eligible.items()):
+        ratio = s["p50_s"] / median
+        if ratio > factor:
+            out.append(
+                StragglerEvent(
+                    rank=rank,
+                    p50_s=s["p50_s"],
+                    median_p50_s=median,
+                    factor=ratio,
+                    threshold=factor,
+                    n_steps=s["n"],
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# effective bandwidth
+# ---------------------------------------------------------------------------
+
+
+def _dedupe_collectives(collectives: List[Dict]) -> List[Dict]:
+    """The wire ledger is replicated: every rank (and every incarnation)
+    emits the SAME per-step CollectiveEvents for a compiled step. Keep the
+    first record per (label, tag, op, dtype) — summing across shards would
+    multiply bytes by world size × restarts."""
+    seen = set()
+    out: List[Dict] = []
+    for c in collectives:
+        key = (c.get("label"), c.get("tag"), c.get("op"), c.get("dtype"))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(c)
+    return out
+
+
+def effective_bandwidth(
+    step_time_s: float,
+    collectives: List[Dict],
+    n_workers: int,
+    overlap: Optional[Dict] = None,
+    fabrics: Optional[Sequence[str]] = None,
+) -> Optional[Dict]:
+    """Achieved wire rate and per-fabric utilization for one run.
+
+    ``step_time_s`` is the measured steady-state step time (cross-rank
+    median p50); ``collectives`` are CollectiveEvent records (deduped here
+    across rank shards); ``overlap`` is a CompileEvent's overlap extract
+    (None ⇒ all collectives treated as exposed). Returns None when there
+    is nothing to estimate."""
+    collectives = _dedupe_collectives(
+        [c for c in collectives if isinstance(c.get("payload_bytes"), (int, float))]
+    )
+    if not collectives or not isinstance(step_time_s, (int, float)):
+        return None
+    if not step_time_s > 0:
+        return None
+    bw = _load_utils_module("bandwidth")
+    ov = _load_utils_module("overlap")
+    fabrics = list(fabrics) if fabrics else list(bw.FABRICS_BYTES_PER_S)
+
+    attribution = ov.comm_attribution(overlap or {})
+    # the exposed-comm budget: with no schedule evidence every collective
+    # is charged to the critical path (exposed_fraction 1.0 — the honest
+    # lower bound on achieved bandwidth)
+    exposed = (
+        attribution["exposed_fraction"] if attribution["n_collectives"] else 1.0
+    )
+    budget_s = step_time_s * exposed
+    if not budget_s > 0:
+        budget_s = step_time_s
+
+    total_bytes = sum(float(c["payload_bytes"]) for c in collectives)
+    total_count = sum(int(c.get("count", 1)) for c in collectives)
+    achieved = total_bytes / budget_s
+
+    def _fabric_views(payload_bytes: float, count: int) -> Dict[str, Dict]:
+        util = {}
+        modeled = {}
+        for f in fabrics:
+            util[f] = achieved / bw.FABRICS_BYTES_PER_S[f]
+            modeled[f] = bw.allreduce_time_s(
+                payload_bytes, max(n_workers, 1), f, n_collectives=max(count, 1)
+            )
+        return {"utilization": util, "modeled_comm_s": modeled}
+
+    by_tag = []
+    for c in collectives:
+        payload = float(c["payload_bytes"])
+        count = int(c.get("count", 1))
+        share = payload / total_bytes if total_bytes else 0.0
+        by_tag.append(
+            {
+                "tag": c.get("tag"),
+                "op": c.get("op"),
+                "label": c.get("label"),
+                "payload_bytes": payload,
+                "count": count,
+                "comm_time_s": budget_s * share,
+                "achieved_bytes_per_s": achieved,
+                **_fabric_views(payload, count),
+            }
+        )
+    return {
+        "step_time_s": step_time_s,
+        "n_workers": n_workers,
+        "comm_budget_s": budget_s,
+        "attribution": attribution,
+        "total": {
+            "payload_bytes": total_bytes,
+            "count": total_count,
+            "comm_time_s": budget_s,
+            "achieved_bytes_per_s": achieved,
+            **_fabric_views(total_bytes, total_count),
+        },
+        "by_tag": by_tag,
+    }

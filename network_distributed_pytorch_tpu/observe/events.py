@@ -188,6 +188,51 @@ class FailureEvent(Event):
 
 
 @dataclass
+class MarkerEvent(Event):
+    """A run-lifecycle marker. The ``run_start`` marker is the shared
+    alignment anchor of :mod:`observe.runlog`: emitted as the FIRST record
+    of every per-rank JSONL shard (``telemetry_for_run`` auto-emits it when
+    the supervisor's run env is present), it pins a (wall clock, monotonic
+    clock) pair per (rank, incarnation). The merger matches the marker's
+    wall time against the supervisor's recorded spawn time to estimate each
+    rank's clock offset, then places every later event on the supervisor's
+    clock via its monotonic delta from the marker. Silent on stdout."""
+
+    KIND: ClassVar[str] = "marker"
+
+    kind: str = "run_start"
+    run_id: str = ""
+    rank: Optional[int] = None
+    world_size: Optional[int] = None
+    incarnation: Optional[int] = None
+
+
+@dataclass
+class StragglerEvent(Event):
+    """A straggler verdict from :mod:`observe.analytics`: this rank's
+    steady-state p50 step duration exceeds the cross-rank median by more
+    than the configured ``threshold`` factor. ``factor`` is the measured
+    ratio (p50 / median); the banner is the report's one-line verdict."""
+
+    KIND: ClassVar[str] = "straggler"
+
+    rank: int
+    p50_s: float
+    median_p50_s: float
+    factor: float  # measured p50 / cross-rank median p50
+    threshold: float  # the configured flag factor
+    n_steps: int = 0
+
+    def banner(self) -> str:
+        return (
+            f"[observe] straggler: rank {self.rank} p50 "
+            f"{self.p50_s * 1e3:.1f} ms = {self.factor:.2f}x cross-rank "
+            f"median {self.median_p50_s * 1e3:.1f} ms "
+            f"(threshold {self.threshold:.2f}x, n={self.n_steps})"
+        )
+
+
+@dataclass
 class NoteEvent(Event):
     """A free-form human banner (init lifecycle, dropped-batch notes,
     study tables) that should also land in the structured log."""

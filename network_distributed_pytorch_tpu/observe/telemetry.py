@@ -36,6 +36,11 @@ class Telemetry:
         record = event.record()
         if event.STAMP_TS:
             record.setdefault("ts", time.time())
+            # the monotonic twin: within-process ordering and durations
+            # survive a wall-clock step (NTP slew, VM migration), and
+            # observe.runlog aligns cross-rank timelines from the
+            # (ts, ts_mono) pair its run-start marker pins
+            record.setdefault("ts_mono", time.monotonic())
         for sink in self.sinks:
             sink.emit(event, record)
         return event
@@ -69,11 +74,24 @@ def telemetry_for_run(
     append: bool = True,
 ) -> Telemetry:
     """A fresh registry for one run: stdout banners plus (when
-    ``event_log`` is set) a JSONL sink at that path."""
+    ``event_log`` is set) a JSONL sink at that path.
+
+    When the process is a rank of a managed run (the supervisor exported
+    ``observe.runlog.ENV_RUN_ID``), the registry's first emission is the
+    ``run_start`` marker — every shard of a supervised run leads with the
+    clock-alignment anchor ``observe.runlog.merge_run`` needs. Unmanaged
+    runs are byte-identical to before (no marker)."""
     sinks: list = [StdoutSink()] if stdout else []
     if event_log:
         sinks.append(JsonlSink(event_log, append=append))
-    return Telemetry(sinks)
+    telemetry = Telemetry(sinks)
+    if event_log:
+        from .runlog import run_marker_from_env
+
+        marker = run_marker_from_env()
+        if marker is not None:
+            telemetry.emit(marker)
+    return telemetry
 
 
 def telemetry_from_config(config) -> Telemetry:

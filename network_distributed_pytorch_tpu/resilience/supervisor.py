@@ -114,6 +114,8 @@ class Supervisor:
         telemetry: Any = None,
         env: Optional[Dict[str, str]] = None,
         log_dir: Optional[str] = None,
+        run_dir: Optional[str] = None,
+        run_id: Optional[str] = None,
     ):
         self.argv_for_rank = argv_for_rank
         self.world_size = world_size
@@ -125,6 +127,23 @@ class Supervisor:
         self.degraded = False
         self._incarnations: Dict[int, int] = {}  # next incarnation per rank
         self._rng = random.Random(self.config.seed)
+        # run-level observability (observe.runlog): with a run_dir the
+        # supervisor maintains the run manifest — identity, shard layout,
+        # and a parent-clock spawn record per (rank, incarnation), the
+        # reference times the shard merger aligns worker clocks against —
+        # and exports the run env so every worker's telemetry leads its
+        # shard with the run_start marker
+        self.run_dir = run_dir
+        self.run_id: Optional[str] = None
+        self._manifest = None
+        if run_dir is not None:
+            from ..observe import runlog
+
+            self.run_id = run_id or (
+                f"{runlog.default_run_id(run_dir)}.{int(time.time())}"
+            )
+            self._manifest = runlog.new_manifest(self.run_id, world_size)
+            self._manifest.save(run_dir)
 
     # -- telemetry ----------------------------------------------------------
     def _emit(self, kind: str, rank: Optional[int] = None, message: str = "",
@@ -149,6 +168,16 @@ class Supervisor:
         env[ENV_INCARNATION] = str(incarnation)
         env[ENV_RANK] = str(rank)
         env[ENV_WORLD] = str(world_size)
+        if self._manifest is not None:
+            from ..observe import runlog
+
+            env[runlog.ENV_RUN_DIR] = self.run_dir
+            env[runlog.ENV_RUN_ID] = self.run_id
+            self._manifest.record_spawn(
+                rank=rank, incarnation=incarnation,
+                world_size=world_size, spawned_unix=time.time(),
+            )
+            self._manifest.save(self.run_dir)
         stdout = stderr = None
         if self.log_dir is not None:
             os.makedirs(self.log_dir, exist_ok=True)

@@ -205,3 +205,35 @@ def overlap_report(hlo_text: str) -> Dict[str, object]:
             {f"{e}/{s}" for e, s in _EMITTER_RE.findall(hlo_text)}
         ),
     }
+
+
+def comm_attribution(overlap: Dict) -> Dict[str, float]:
+    """Count-weighted comm-time attribution from an overlap extract (the
+    full :func:`overlap_report` dict, or the subset a ``CompileEvent``
+    carries): how many of the step's collectives have compute scheduled
+    inside/behind their window (``hidden``) vs serialized on the critical
+    path (``exposed``).
+
+    Async collectives are hidden when compute sits between ``-start`` and
+    ``-done``; synchronous chunk collectives are hidden when the INTERIOR
+    gap after them holds compute (the pipelined-chunk evidence; the last
+    collective of a sync chain has no successor to hide behind, so it is
+    always exposed). The fractions are count-weighted — the schedule
+    proves WHICH collectives overlap, not for how long — which makes
+    ``exposed_fraction × step_time`` an upper bound on the step's exposed
+    communication time, the honest budget ``observe.analytics`` divides
+    measured bytes by."""
+    n_async = int(overlap.get("n_async_collectives") or 0)
+    n_over = int(overlap.get("n_overlapped") or 0)
+    n_sync = int(overlap.get("n_sync_collectives") or 0)
+    interior = max(0, n_sync - 1)
+    gaps = min(int(overlap.get("n_sync_gaps_with_compute") or 0), interior)
+    total = n_async + n_sync
+    hidden = min(n_over, n_async) + gaps
+    hidden_fraction = hidden / total if total else 0.0
+    return {
+        "n_collectives": total,
+        "n_hidden": hidden,
+        "hidden_fraction": hidden_fraction,
+        "exposed_fraction": 1.0 - hidden_fraction,
+    }

@@ -34,7 +34,21 @@ env -u PALLAS_AXON_POOL_IPS \
 # tests run in the fast tier (-m "not slow" compatible); the full chaos
 # matrix on a real training loop and the SIGKILL-and-resume determinism
 # test are @slow like the other end-to-end drives.
-exec env -u PALLAS_AXON_POOL_IPS \
+set +e
+env -u PALLAS_AXON_POOL_IPS \
     JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/ --junitxml=artifacts/junit.xml "$@"
+rc=$?
+set -e
+
+# Observability probe + perf gate: record a tiny supervised run so every
+# CI pass leaves a fresh artifacts/run_report.json, then gate it against
+# the recorded baseline (bench.py's artifacts/GATE_BASELINE.json or the
+# newest BENCH_r*.json). Advisory here — shared CI boxes have noisy step
+# times — so a regression warns without masking the pytest exit code;
+# drop --advisory on dedicated perf hardware to make it blocking.
+python scripts/run_probe.py || true
+python scripts/gate.py --advisory --report artifacts/run_report.json || true
+
+exit $rc

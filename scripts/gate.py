@@ -1,0 +1,260 @@
+"""Perf regression gate: compare a run report against a recorded baseline.
+
+Stdlib-only (no jax). Reads the machine-readable report that
+``scripts/report.py --run-dir`` (or ``scripts/run_probe.py``) writes to
+``artifacts/run_report.json``, resolves a baseline, and exits nonzero when
+any comparable metric regresses beyond the tolerance. Designed to ride in
+CI after the test suite (``run_tests.sh`` runs it with ``--advisory`` so a
+slow shared-CI box warns instead of failing the build) and against the
+baselines ``bench.py`` records.
+
+Baseline resolution order:
+
+1. ``--baseline PATH`` — an explicit report/baseline JSON.
+2. ``artifacts/GATE_BASELINE.json`` — recorded by ``bench.py`` after a
+   successful flagship round.
+3. The newest ``BENCH_r*.json`` history file — the trailing summary line
+   that carries ``flagship_imgs_per_sec``/``value``.
+
+Metrics compared (only those present in BOTH report and baseline):
+
+- ``step_p50_s``            lower is better
+- ``achieved_bytes_per_s``  higher is better (from ``bandwidth.total``)
+- ``flagship_imgs_per_sec`` higher is better (bench baselines)
+- ``value``                 higher is better (bench value-tier score)
+
+Usage::
+
+    python scripts/gate.py --report artifacts/run_report.json \
+        [--baseline F] [--tolerance 0.2] [--advisory]
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# metric name -> direction ("lower" / "higher" is better)
+METRICS: Dict[str, str] = {
+    "step_p50_s": "lower",
+    "achieved_bytes_per_s": "higher",
+    "flagship_imgs_per_sec": "higher",
+    "value": "higher",
+}
+
+BASELINE_NAME = "GATE_BASELINE.json"
+
+
+def _say(msg: str) -> None:
+    sys.stderr.write(f"# gate: {msg}\n")
+
+
+def extract_metrics(doc: Dict) -> Dict[str, float]:
+    """Pull the comparable scalar metrics out of a report/baseline dict."""
+    out: Dict[str, float] = {}
+    for name in ("step_p50_s", "flagship_imgs_per_sec", "value"):
+        v = doc.get(name)
+        if isinstance(v, (int, float)) and v == v and v > 0:
+            out[name] = float(v)
+    bw = doc.get("bandwidth")
+    if isinstance(bw, dict):
+        total = bw.get("total", {})
+        v = total.get("achieved_bytes_per_s")
+        if isinstance(v, (int, float)) and v == v and v > 0:
+            out["achieved_bytes_per_s"] = float(v)
+    # bench baselines store the achieved rate flat as well
+    v = doc.get("achieved_bytes_per_s")
+    if isinstance(v, (int, float)) and v == v and v > 0:
+        out.setdefault("achieved_bytes_per_s", float(v))
+    return out
+
+
+def _load_json(path: str) -> Optional[Dict]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def _summary_from_lines(lines: List[str]) -> Optional[Dict]:
+    """Last parseable dict carrying a bench headline, scanning backwards
+    (the compact summary is the round's very last stdout line; earlier
+    tail lines may be truncated mid-object)."""
+    for line in reversed(lines):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(doc, dict) and (
+            "flagship_imgs_per_sec" in doc or "value" in doc
+        ):
+            return doc
+    return None
+
+
+def _from_bench_history(root: str) -> Optional[Tuple[str, Dict]]:
+    """Newest BENCH_r*.json whose recorded stdout tail carries a usable
+    summary dict. Each history file is a driver record: a JSON document
+    whose ``tail`` field holds the round's final stdout (JSONL) and whose
+    ``parsed`` field may already hold the parsed summary."""
+    paths = sorted(
+        glob.glob(os.path.join(root, "BENCH_r*.json")),
+        key=lambda p: os.path.getmtime(p),
+        reverse=True,
+    )
+    for path in paths:
+        try:
+            with open(path) as f:
+                raw = f.read()
+        except OSError:
+            continue
+        doc = None
+        try:
+            rec = json.loads(raw)
+        except ValueError:
+            rec = None
+        if isinstance(rec, dict):
+            parsed = rec.get("parsed")
+            if isinstance(parsed, dict) and extract_metrics(parsed):
+                doc = parsed
+            elif isinstance(rec.get("tail"), str):
+                doc = _summary_from_lines(rec["tail"].splitlines())
+            elif extract_metrics(rec):
+                doc = rec
+        else:  # plain JSONL history
+            doc = _summary_from_lines(raw.splitlines())
+        if doc is not None and extract_metrics(doc):
+            return path, doc
+    return None
+
+
+def resolve_baseline(
+    explicit: Optional[str], root: str
+) -> Optional[Tuple[str, Dict]]:
+    if explicit:
+        doc = _load_json(explicit)
+        return (explicit, doc) if doc is not None else None
+    recorded = os.path.join(root, "artifacts", BASELINE_NAME)
+    doc = _load_json(recorded)
+    if doc is not None:
+        return recorded, doc
+    return _from_bench_history(root)
+
+
+def compare(
+    current: Dict[str, float], baseline: Dict[str, float], tolerance: float
+) -> List[Dict]:
+    """Per-metric verdicts for metrics present on both sides."""
+    verdicts: List[Dict] = []
+    for name, direction in METRICS.items():
+        if name not in current or name not in baseline:
+            continue
+        cur, base = current[name], baseline[name]
+        if direction == "lower":
+            limit = base * (1.0 + tolerance)
+            regressed = cur > limit
+            ratio = cur / base if base else float("inf")
+        else:
+            limit = base * (1.0 - tolerance)
+            regressed = cur < limit
+            ratio = cur / base if base else 0.0
+        verdicts.append(
+            {
+                "metric": name,
+                "direction": direction,
+                "current": cur,
+                "baseline": base,
+                "limit": limit,
+                "ratio": ratio,
+                "regressed": regressed,
+            }
+        )
+    return verdicts
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--report",
+        default=os.path.join("artifacts", "run_report.json"),
+        help="run report to gate (from report.py --run-dir / run_probe.py)",
+    )
+    parser.add_argument(
+        "--baseline", default=None, help="explicit baseline JSON to compare to"
+    )
+    parser.add_argument(
+        "--tolerance", type=float, default=0.2,
+        help="allowed fractional regression before failing (default 0.2)",
+    )
+    parser.add_argument(
+        "--advisory", action="store_true",
+        help="report regressions but always exit 0 (CI-on-shared-hardware mode)",
+    )
+    parser.add_argument(
+        "--root", default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="repo root for baseline discovery (BENCH_r*.json, artifacts/)",
+    )
+    args = parser.parse_args(argv)
+
+    report = _load_json(args.report)
+    if report is None:
+        _say(f"no readable report at {args.report}; nothing to gate")
+        sys.stdout.write(json.dumps({"gate": "skipped", "reason": "no_report"}) + "\n")
+        return 0
+
+    current = extract_metrics(report)
+    resolved = resolve_baseline(args.baseline, args.root)
+    if resolved is None:
+        _say("no baseline found (artifacts/GATE_BASELINE.json or BENCH_r*.json); pass")
+        sys.stdout.write(
+            json.dumps({"gate": "skipped", "reason": "no_baseline"}) + "\n"
+        )
+        return 0
+    baseline_path, baseline_doc = resolved
+    baseline = extract_metrics(baseline_doc)
+
+    verdicts = compare(current, baseline, args.tolerance)
+    if not verdicts:
+        _say(
+            f"baseline {baseline_path} shares no comparable metrics with "
+            f"{args.report}; pass"
+        )
+        sys.stdout.write(
+            json.dumps({"gate": "skipped", "reason": "no_common_metrics"}) + "\n"
+        )
+        return 0
+
+    regressions = [v for v in verdicts if v["regressed"]]
+    for v in verdicts:
+        status = "REGRESSED" if v["regressed"] else "ok"
+        _say(
+            f"{v['metric']}: current {v['current']:.6g} vs baseline "
+            f"{v['baseline']:.6g} ({v['ratio']:.2f}x, {v['direction']} is "
+            f"better, tol {args.tolerance:.0%}) -> {status}"
+        )
+    result = {
+        "gate": "fail" if regressions else "pass",
+        "advisory": bool(args.advisory),
+        "baseline": baseline_path,
+        "report": args.report,
+        "tolerance": args.tolerance,
+        "verdicts": verdicts,
+    }
+    sys.stdout.write(json.dumps(result) + "\n")
+    if regressions and not args.advisory:
+        _say(f"{len(regressions)} metric(s) regressed beyond tolerance")
+        return 1
+    if regressions:
+        _say(f"{len(regressions)} regression(s) noted (advisory mode: exit 0)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

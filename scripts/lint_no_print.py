@@ -6,9 +6,14 @@ Every human-facing line the framework emits must flow through
 can never drift apart. This walks the package AST and fails (exit 1) on
 any other ``print`` call site.
 
+The default run also lints ``scripts/``: new tooling there must write
+human lines to stderr (``print(..., file=sys.stderr)`` is permitted) and
+machine output via ``sys.stdout.write`` so piped JSON stays clean. A few
+legacy stdout-printing scripts are grandfathered in ``SCRIPT_ALLOWED``.
+
 Usage::
 
-    python scripts/lint_no_print.py            # lint the package
+    python scripts/lint_no_print.py            # lint package + scripts/
     python scripts/lint_no_print.py path [..]  # lint specific trees
 """
 
@@ -21,13 +26,32 @@ import sys
 # the one sanctioned print site (see observe/sinks.py docstring)
 ALLOWED = {os.path.join("observe", "sinks.py")}
 
-PACKAGE = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "network_distributed_pytorch_tpu",
-)
+# legacy scripts that print reports/artifacts straight to stdout; new
+# scripts must not join this list (stderr for humans, stdout for JSON)
+SCRIPT_ALLOWED = {
+    "accuracy_study.py",
+    "bandwidth_artifact.py",
+    "tpu_evidence.py",
+}
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "network_distributed_pytorch_tpu")
+SCRIPTS = os.path.join(REPO, "scripts")
 
 
-def print_calls(path: str):
+def _is_stderr_print(node: ast.Call) -> bool:
+    """True for ``print(..., file=sys.stderr)`` — stderr chatter is fine."""
+    for kw in node.keywords:
+        if (
+            kw.arg == "file"
+            and isinstance(kw.value, ast.Attribute)
+            and kw.value.attr == "stderr"
+        ):
+            return True
+    return False
+
+
+def print_calls(path: str, permit_stderr: bool = False):
     with open(path, "rb") as f:
         tree = ast.parse(f.read(), filename=path)
     for node in ast.walk(tree):
@@ -36,26 +60,40 @@ def print_calls(path: str):
             and isinstance(node.func, ast.Name)
             and node.func.id == "print"
         ):
+            if permit_stderr and _is_stderr_print(node):
+                continue
             yield node.lineno
 
 
-def lint(roots) -> int:
+def lint_tree(root: str, allowed, permit_stderr: bool = False):
     violations = []
-    for root in roots:
-        for dirpath, _dirnames, filenames in os.walk(root):
-            for fname in sorted(filenames):
-                if not fname.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, fname)
-                rel = os.path.relpath(path, root)
-                if rel in ALLOWED:
-                    continue
-                for lineno in print_calls(path):
-                    violations.append(f"{path}:{lineno}")
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root)
+            if rel in allowed:
+                continue
+            for lineno in print_calls(path, permit_stderr=permit_stderr):
+                violations.append(f"{path}:{lineno}")
+    return violations
+
+
+def lint(roots) -> int:
+    if roots:
+        violations = []
+        for root in roots:
+            violations.extend(lint_tree(root, ALLOWED))
+    else:
+        violations = lint_tree(PACKAGE, ALLOWED)
+        violations.extend(
+            lint_tree(SCRIPTS, SCRIPT_ALLOWED, permit_stderr=True)
+        )
     if violations:
         sys.stderr.write(
             "bare print() outside observe/sinks.py — route it through an "
-            "observe event/sink instead:\n"
+            "observe event/sink (or sys.stderr in scripts/) instead:\n"
         )
         for v in violations:
             sys.stderr.write(f"  {v}\n")
@@ -64,4 +102,4 @@ def lint(roots) -> int:
 
 
 if __name__ == "__main__":
-    raise SystemExit(lint(sys.argv[1:] or [PACKAGE]))
+    raise SystemExit(lint(sys.argv[1:]))

@@ -7,26 +7,52 @@ about: step-time percentiles, bytes/step itemized by wire-ledger tag,
 compression ratio, the analytic-vs-compiled-HLO reconciliation, and the
 overlap evidence from the scheduled HLO.
 
-stdlib-only and jax-free — runs anywhere the log file can be copied.
+With ``--run-dir`` the input is a whole run directory (manifest + per-rank
+shards, ``launch.py --supervise --run-dir``): the shards are merged into
+one supervisor-clock-ordered timeline (``observe.runlog``), and the report
+adds per-rank step-time skew, straggler verdicts, and the achieved-vs-
+modeled bandwidth table (``observe.analytics``) — emitted as text AND as a
+machine-readable ``artifacts/run_report.json`` for ``scripts/gate.py``.
+
+stdlib-only and jax-free — runs anywhere the log files can be copied
+(``--run-dir`` imports ``observe``, which is itself jax-free).
 
 Usage::
 
     python scripts/report.py runs/exact.jsonl
     python scripts/report.py runs/*.jsonl      # one report per file
+    python scripts/report.py --run-dir runs/r7 --json-out artifacts/run_report.json
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
-from typing import Dict, List
+import time
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def load_events(path: str) -> List[Dict]:
-    """Parse a JSONL event log, skipping lines that are not JSON objects
-    (a log interleaved with foreign stdout stays readable)."""
+def _observe_modules():
+    """The run-dir mode's merger/analytics — jax-free by the observe
+    package's own contract (pinned by tests/test_observe.py)."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from network_distributed_pytorch_tpu.observe import analytics, runlog
+
+    return runlog, analytics
+
+
+def load_events_counted(path: str) -> Tuple[List[Dict], int]:
+    """Parse a JSONL event log, skipping lines that are not JSON objects —
+    foreign stdout interleaved into the log, and the torn/half-written
+    final line of a killed rank — and COUNTING the skips so the report can
+    warn instead of silently pretending the log is whole."""
     events = []
+    skipped = 0
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -35,10 +61,18 @@ def load_events(path: str) -> List[Dict]:
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError:
+                skipped += 1
                 continue
             if isinstance(rec, dict):
                 events.append(rec)
-    return events
+            else:
+                skipped += 1
+    return events, skipped
+
+
+def load_events(path: str) -> List[Dict]:
+    """Backward-compatible single-value form of :func:`load_events_counted`."""
+    return load_events_counted(path)[0]
 
 
 def percentile(values: List[float], p: float) -> float:
@@ -158,7 +192,7 @@ def render_failure_timeline(failures: List[Dict]) -> List[str]:
     return lines
 
 
-def render_report(events: List[Dict], name: str = "") -> str:
+def render_report(events: List[Dict], name: str = "", skipped_lines: int = 0) -> str:
     by_kind: Dict[str, List[Dict]] = {}
     for e in events:
         by_kind.setdefault(e.get("event", "raw"), []).append(e)
@@ -169,6 +203,11 @@ def render_report(events: List[Dict], name: str = "") -> str:
     lines.append("=" * len(title))
     kinds = ", ".join(f"{k}={len(v)}" for k, v in sorted(by_kind.items()))
     lines.append(f"{len(events)} events ({kinds})")
+    if skipped_lines:
+        lines.append(
+            f"  warning: {skipped_lines} unparseable/torn line(s) skipped"
+            " (foreign stdout or a killed rank's half-written tail)"
+        )
 
     steps = by_kind.get("step", [])
     valid = [s for s in steps if s.get("valid", True)]
@@ -283,17 +322,207 @@ def render_report(events: List[Dict], name: str = "") -> str:
     return "\n".join(lines) + "\n"
 
 
+def _fmt_rate(bps: float) -> str:
+    if bps >= 1e9:
+        return f"{bps / 1e9:.2f} GB/s"
+    if bps >= 1e6:
+        return f"{bps / 1e6:.2f} MB/s"
+    return f"{bps / 1e3:.2f} KB/s"
+
+
+def render_run_sections(
+    merged, stats: Dict[int, Dict], stragglers: List, bandwidth: Optional[Dict],
+    straggler_factor: float,
+) -> List[str]:
+    """The multi-rank sections: per-rank step-time skew, straggler
+    verdicts, and the achieved-vs-modeled bandwidth table."""
+    lines: List[str] = []
+    p50s = [s["p50_s"] for s in stats.values() if s["n"]]
+    median_p50 = percentile(p50s, 50) if p50s else float("nan")
+
+    lines.append("")
+    lines.append("per-rank step time (steady-state)")
+    lines.append("---------------------------------")
+    for rank in sorted(merged.per_rank):
+        pr = merged.per_rank[rank]
+        s = stats.get(rank)
+        if pr.get("missing"):
+            lines.append(f"  rank {rank}: shard missing")
+            continue
+        torn = f", {pr['torn_lines']} torn" if pr.get("torn_lines") else ""
+        if s is None or not s["n"]:
+            lines.append(
+                f"  rank {rank}: {pr['events']} events, no timed steps{torn}"
+            )
+            continue
+        skew = s["p50_s"] / median_p50 if median_p50 and median_p50 > 0 else float("nan")
+        lines.append(
+            f"  rank {rank}: n={s['n']:<3} p50 {s['p50_s'] * 1e3:8.1f} ms  "
+            f"p95 {s['p95_s'] * 1e3:8.1f} ms  skew {skew:5.2f}x  "
+            f"clock offset {pr['clock_offset_s']:+.3f}s{torn}"
+        )
+    if p50s:
+        worst = max(p50s) / median_p50 if median_p50 > 0 else float("nan")
+        lines.append(
+            f"  cross-rank median p50 {median_p50 * 1e3:.1f} ms; "
+            f"max/median skew {worst:.2f}x"
+        )
+
+    lines.append("")
+    lines.append(f"stragglers (threshold {straggler_factor:.2f}x median p50)")
+    lines.append("-" * 42)
+    if stragglers:
+        for ev in stragglers:
+            lines.append(f"  {ev.banner()}")
+    else:
+        lines.append("  none")
+
+    if bandwidth:
+        attr = bandwidth["attribution"]
+        lines.append("")
+        lines.append("effective bandwidth (measured bytes / measured seconds)")
+        lines.append("-------------------------------------------------------")
+        if attr["n_collectives"]:
+            lines.append(
+                f"  comm budget {bandwidth['comm_budget_s'] * 1e3:.1f} ms/step "
+                f"(exposed fraction {attr['exposed_fraction']:.2f} of "
+                f"{attr['n_collectives']} scheduled collectives)"
+            )
+        else:
+            lines.append(
+                f"  comm budget {bandwidth['comm_budget_s'] * 1e3:.1f} ms/step "
+                "(no schedule evidence: every collective charged as exposed)"
+            )
+        for row in bandwidth["by_tag"] + [dict(bandwidth["total"], tag="total", op="")]:
+            util = " | ".join(
+                f"{f} {100 * u:.2f}%" for f, u in row["utilization"].items()
+            )
+            lines.append(
+                f"  {row['tag']:<18} {row.get('op', ''):<14} "
+                f"{_fmt_bytes(row['payload_bytes']):>12}/step x{row['count']:<3} "
+                f"achieved {_fmt_rate(row['achieved_bytes_per_s'])}"
+            )
+            lines.append(f"      line-rate utilization: {util}")
+    return lines
+
+
+def run_report(
+    run_dir: str, straggler_factor: float = 1.5
+) -> Tuple[str, Dict]:
+    """The multi-rank run report: merge the run directory's shards, run
+    the analytics, and return (text, machine-readable report dict)."""
+    runlog, analytics = _observe_modules()
+    merged = runlog.merge_run(run_dir)
+    stats = analytics.rank_step_stats(merged.events)
+    stragglers = analytics.detect_stragglers(stats, factor=straggler_factor)
+    p50s = [s["p50_s"] for s in stats.values() if s["n"]]
+    step_p50 = analytics.percentile(p50s, 50) if p50s else None
+    step_p95 = (
+        analytics.percentile(
+            [s["p95_s"] for s in stats.values() if s["n"]], 50
+        )
+        if p50s else None
+    )
+    overlap = next(
+        (e.get("overlap") for e in merged.events if e.get("event") == "compile"),
+        None,
+    )
+    collectives = [e for e in merged.events if e.get("event") == "collective"]
+    bandwidth = (
+        analytics.effective_bandwidth(
+            step_p50, collectives, merged.manifest.world_size, overlap=overlap
+        )
+        if collectives and step_p50
+        else None
+    )
+
+    sections = render_run_sections(
+        merged, stats, stragglers, bandwidth, straggler_factor
+    )
+    text = (
+        render_report(merged.events, name=run_dir, skipped_lines=merged.torn_lines)
+        .rstrip("\n") + "\n" + "\n".join(sections) + "\n"
+    )
+
+    failures = [e for e in merged.events if e.get("event") == "failure"]
+    deaths = _death_counts(failures)
+    report = {
+        "schema": 1,
+        "run_dir": os.path.abspath(run_dir),
+        "run_id": merged.manifest.run_id,
+        "world_size": merged.manifest.world_size,
+        "generated_unix": time.time(),
+        "n_events": len(merged.events),
+        "torn_lines": merged.torn_lines,
+        "startup_s": merged.startup_s,
+        "ranks": {
+            str(r): {**merged.per_rank[r], **stats.get(r, {})}
+            for r in sorted(merged.per_rank)
+        },
+        "step_p50_s": step_p50,
+        "step_p95_s": step_p95,
+        "step_skew": (
+            max(p50s) / step_p50 if p50s and step_p50 and step_p50 > 0 else None
+        ),
+        "straggler_factor": straggler_factor,
+        "stragglers": [ev.record() for ev in stragglers],
+        "bandwidth": bandwidth,
+        "failures": {
+            **deaths,
+            "restarts": sum(
+                1 for f in failures if f.get("kind") == "worker_restart"
+            ),
+        },
+    }
+    return text, report
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    parser.add_argument("logs", nargs="+", help="telemetry JSONL file(s)")
+    parser.add_argument("logs", nargs="*", help="telemetry JSONL file(s)")
+    parser.add_argument(
+        "--run-dir", default=None,
+        help="merge a supervised run directory (manifest + per-rank shards)"
+             " into one multi-rank report",
+    )
+    parser.add_argument(
+        "--json-out", default=None,
+        help="run-dir mode: write the machine-readable report here"
+             " (default artifacts/run_report.json)",
+    )
+    parser.add_argument(
+        "--straggler-factor", type=float, default=1.5,
+        help="flag ranks whose p50 step time exceeds the cross-rank median"
+             " by this factor",
+    )
     parser.add_argument(
         "--json",
         action="store_true",
-        help="emit the aggregated per-kind event counts as JSON instead of text",
+        help="emit the aggregated per-kind event counts (or the run-dir"
+             " report dict) as JSON instead of text",
     )
     args = parser.parse_args(argv)
+    if not args.logs and not args.run_dir:
+        parser.error("need JSONL file(s) or --run-dir")
+
+    if args.run_dir:
+        text, report = run_report(
+            args.run_dir, straggler_factor=args.straggler_factor
+        )
+        if args.json:
+            sys.stdout.write(json.dumps(report) + "\n")
+        else:
+            sys.stdout.write(text)
+        json_out = args.json_out or os.path.join("artifacts", "run_report.json")
+        parent = os.path.dirname(json_out)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(json_out, "w") as f:
+            json.dump(report, f, indent=1)
+        sys.stderr.write(f"# report: wrote {json_out}\n")
+
     for path in args.logs:
-        events = load_events(path)
+        events, skipped = load_events_counted(path)
         if args.json:
             counts: Dict[str, int] = {}
             for e in events:
@@ -301,7 +530,9 @@ def main(argv=None) -> int:
                 counts[k] = counts.get(k, 0) + 1
             sys.stdout.write(json.dumps({"log": path, "events": counts}) + "\n")
         else:
-            sys.stdout.write(render_report(events, name=path))
+            sys.stdout.write(
+                render_report(events, name=path, skipped_lines=skipped)
+            )
             if len(args.logs) > 1:
                 sys.stdout.write("\n")
     return 0

@@ -1,0 +1,102 @@
+"""CI observability probe: tiny supervised run -> merged run report.
+
+Stdlib-only parent (workers are the jax-free toy worker), cheap enough to
+ride at the end of ``run_tests.sh``: spawns a 2-rank supervised run of
+``tests/toy_supervised_worker.py`` into ``artifacts/toy_run/``, then runs
+``scripts/report.py --run-dir`` over it so every CI pass leaves a fresh
+``artifacts/run_report.json`` for the perf gate to inspect.
+
+Usage::
+
+    python scripts/run_probe.py [--out-dir artifacts/toy_run] [--steps 5]
+"""
+
+import argparse
+import importlib.util
+import os
+import shutil
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from network_distributed_pytorch_tpu.observe import (  # noqa: E402
+    telemetry_for_run,
+)
+from network_distributed_pytorch_tpu.observe.runlog import (  # noqa: E402
+    SUPERVISOR_LOG,
+)
+from network_distributed_pytorch_tpu.resilience.supervisor import (  # noqa: E402
+    Supervisor,
+    SupervisorConfig,
+)
+
+
+def _load_report_module():
+    path = os.path.join(REPO, "scripts", "report.py")
+    spec = importlib.util.spec_from_file_location("_ci_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_ci_report"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--out-dir", default=os.path.join(REPO, "artifacts", "toy_run")
+    )
+    parser.add_argument(
+        "--json-out", default=os.path.join(REPO, "artifacts", "run_report.json")
+    )
+    parser.add_argument("--world", type=int, default=2)
+    parser.add_argument("--steps", type=int, default=5)
+    parser.add_argument("--step-seconds", type=float, default=0.01)
+    args = parser.parse_args(argv)
+
+    run_dir = args.out_dir
+    shutil.rmtree(run_dir, ignore_errors=True)
+    os.makedirs(run_dir, exist_ok=True)
+
+    worker = os.path.join(REPO, "tests", "toy_supervised_worker.py")
+
+    def argv_for_rank(rank, world_size, incarnation):
+        return [
+            sys.executable, worker,
+            "--rank", str(rank),
+            "--world", str(world_size),
+            "--steps", str(args.steps),
+            "--state-dir", os.path.join(run_dir, "state"),
+            "--result-dir", os.path.join(run_dir, "results"),
+            "--step-seconds", str(args.step_seconds),
+        ]
+
+    telemetry = telemetry_for_run(
+        event_log=os.path.join(run_dir, SUPERVISOR_LOG), stdout=False
+    )
+    supervisor = Supervisor(
+        argv_for_rank=argv_for_rank,
+        world_size=args.world,
+        config=SupervisorConfig(
+            max_restarts=1, backoff_base_s=0.05, poll_interval_s=0.05
+        ),
+        telemetry=telemetry,
+        run_dir=run_dir,
+    )
+    result = supervisor.run()
+    telemetry.close()
+    if not result.success:
+        sys.stderr.write(f"# run_probe: toy run failed: {result}\n")
+        return 1
+
+    report = _load_report_module()
+    rc = report.main(["--run-dir", run_dir, "--json-out", args.json_out])
+    sys.stderr.write(
+        f"# run_probe: {args.world}-rank x {args.steps}-step run recorded at "
+        f"{run_dir}; report -> {args.json_out}\n"
+    )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

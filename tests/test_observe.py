@@ -492,3 +492,105 @@ def test_report_cli_json_mode(tmp_path, capsys):
     assert report.main([path, "--json"]) == 0
     out = json.loads(capsys.readouterr().out)
     assert out["events"] == {"note": 2}
+
+
+# ---------------------------------------------------------------------------
+# satellite coverage: ts_mono stamping, sink edges, torn-tail tolerance
+# ---------------------------------------------------------------------------
+
+
+def test_emit_stamps_monotonic_twin():
+    """Every stamped record carries (ts, ts_mono); RawEvent's verbatim
+    driver contract stays a ts-free pass-through."""
+    sink = MemorySink()
+    t = Telemetry([sink])
+    t.emit(NoteEvent("hello"))
+    rec = sink.records[-1]
+    assert isinstance(rec["ts"], float) and isinstance(rec["ts_mono"], float)
+
+    t.emit(RawEvent({"summary": True, "metric": "x"}))
+    raw = sink.records[-1]
+    assert "ts" not in raw and "ts_mono" not in raw
+
+    # caller-provided stamps win over emit-time stamping
+    class _Pinned(NoteEvent):
+        def record(self):
+            rec = super().record()
+            rec["ts"] = 123.0
+            rec["ts_mono"] = 4.0
+            return rec
+
+    t.emit(_Pinned("pinned"))
+    assert sink.records[-1]["ts"] == 123.0
+    assert sink.records[-1]["ts_mono"] == 4.0
+
+
+def test_stream_json_sink_prefix_round_trips():
+    """Prefixed lines (the @BENCH@ child protocol) must parse back to the
+    exact record after the prefix is stripped — across multiple lines."""
+    buf = io.StringIO()
+    t = Telemetry([StreamJsonSink(buf, prefix="@BENCH@")])
+    t.emit(NoteEvent("one"))
+    t.emit(NoteEvent("two"))
+    lines = buf.getvalue().splitlines()
+    assert len(lines) == 2
+    msgs = []
+    for line in lines:
+        assert line.startswith("@BENCH@")
+        rec = json.loads(line[len("@BENCH@"):])
+        msgs.append(rec["message"])
+    assert msgs == ["one", "two"]
+
+
+def test_jsonl_sink_append_vs_truncate(tmp_path):
+    path = str(tmp_path / "log.jsonl")
+    with telemetry_for_run(event_log=path, stdout=False) as t:
+        t.emit(NoteEvent("first"))
+    with telemetry_for_run(event_log=path, stdout=False) as t:
+        t.emit(NoteEvent("second"))  # append=True default: extends
+    with open(path) as f:
+        assert len(f.read().splitlines()) == 2
+    with telemetry_for_run(event_log=path, stdout=False, append=False) as t:
+        t.emit(NoteEvent("fresh"))  # truncate: restarts the log
+    with open(path) as f:
+        lines = f.read().splitlines()
+    assert len(lines) == 1 and json.loads(lines[0])["message"] == "fresh"
+
+
+def test_memory_sink_of_kind_filters():
+    sink = MemorySink()
+    t = Telemetry([sink])
+    t.emit(NoteEvent("a"))
+    t.emit(StepEvent(step=0, epoch=0, loss=1.0, step_time_s=0.1,
+                     bits_cumulative=8))
+    t.emit(NoteEvent("b"))
+    assert [r["message"] for r in sink.of_kind("note")] == ["a", "b"]
+    assert len(sink.of_kind("step")) == 1
+    assert sink.of_kind("failure") == []
+
+
+def test_telemetry_close_is_idempotent(tmp_path):
+    path = str(tmp_path / "log.jsonl")
+    t = telemetry_for_run(event_log=path, stdout=False)
+    t.emit(NoteEvent("x"))
+    t.close()
+    t.close()  # second close must not raise on the closed stream
+    jsonl = next(s for s in t.sinks if isinstance(s, JsonlSink))
+    assert jsonl.stream.closed
+
+
+def test_report_counts_torn_tail_line(tmp_path):
+    """A SIGKILLed rank's half-written final line is skipped and COUNTED —
+    the report warns instead of raising or silently dropping it."""
+    report = _load_report_module()
+    path = str(tmp_path / "run.jsonl")
+    with telemetry_for_run(event_log=path, stdout=False) as t:
+        t.emit(NoteEvent("whole"))
+    with open(path, "a") as f:
+        f.write('{"event": "step", "step": 7, "ts": 1.0, "step_ti')
+    events, skipped = report.load_events_counted(path)
+    assert len(events) == 1 and skipped == 1
+    text = report.render_report(events, skipped_lines=skipped)
+    assert "1 unparseable/torn line(s) skipped" in text
+    # and the zero case emits no warning line
+    assert "torn" not in report.render_report(events, skipped_lines=0)

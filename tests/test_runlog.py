@@ -1,0 +1,347 @@
+"""Run-level observability: manifest, shard merger, analytics, gate.
+
+The unit tests pin the clock-alignment math on synthetic shards (a rank
+whose wall clock is 3 s ahead must still interleave correctly in the merged
+timeline) and the straggler/bandwidth analytics on hand-built events. The
+end-to-end test is the ISSUE's acceptance bar: a 4-rank supervised toy run
+with one SIGKILLed rank and one synthetically slow rank produces per-rank
+shards plus a manifest; ``report.py --run-dir`` merges them into one
+timeline with a straggler verdict and per-collective bandwidth utilization;
+and ``gate.py`` passes the recorded run but fails a synthetically
+regressed copy. Everything here is jax-free.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+from network_distributed_pytorch_tpu.observe import analytics, runlog
+from network_distributed_pytorch_tpu.resilience import (
+    ChaosPlan,
+    FaultSpec,
+    Supervisor,
+    SupervisorConfig,
+)
+from network_distributed_pytorch_tpu.observe import telemetry_for_run
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TESTS_DIR)
+TOY = os.path.join(TESTS_DIR, "toy_supervised_worker.py")
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        f"_runlog_test_{name}", os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[f"_runlog_test_{name}"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# manifest
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_roundtrip(tmp_path):
+    m = runlog.new_manifest("runA", world_size=2)
+    m.record_spawn(rank=0, incarnation=0, world_size=2, spawned_unix=100.0)
+    m.record_spawn(rank=1, incarnation=0, world_size=2, spawned_unix=100.5)
+    m.record_spawn(rank=1, incarnation=1, world_size=2, spawned_unix=103.0)
+    m.save(str(tmp_path))
+
+    back = runlog.RunManifest.load(str(tmp_path))
+    assert back.run_id == "runA"
+    assert back.world_size == 2
+    # JSON forces string keys; load() must restore ints
+    assert back.shards == {0: "events_rank0.jsonl", 1: "events_rank1.jsonl"}
+    assert back.incarnations == {0: 1, 1: 2}
+    assert back.spawn_time(1, 1) == 103.0
+    assert back.spawn_time(1, 7) is None
+
+
+def test_marker_and_shard_from_env(tmp_path):
+    env = {
+        runlog.ENV_RUN_DIR: str(tmp_path),
+        runlog.ENV_RUN_ID: "runB",
+        "RESILIENCE_RANK": "3",
+        "RESILIENCE_WORLD": "4",
+        "RESILIENCE_INCARNATION": "1",
+    }
+    marker = runlog.run_marker_from_env(env)
+    assert marker is not None
+    assert (marker.run_id, marker.rank, marker.world_size,
+            marker.incarnation) == ("runB", 3, 4, 1)
+    assert runlog.shard_event_log_from_env(env) == str(
+        tmp_path / "events_rank3.jsonl"
+    )
+    # outside a managed run: no marker, no shard
+    assert runlog.run_marker_from_env({}) is None
+    assert runlog.shard_event_log_from_env({}) is None
+
+
+# ---------------------------------------------------------------------------
+# the merger
+# ---------------------------------------------------------------------------
+
+
+def _write_shard(path, events):
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+
+def _synthetic_run(tmp_path, rank1_clock_offset=3.0):
+    """Two ranks spawned simultaneously (parent clock 1000.0); rank 1's
+    wall clock runs ``rank1_clock_offset`` seconds ahead. Steps genuinely
+    interleave in real time: rank0 at +0.1/+0.3, rank1 at +0.2/+0.4."""
+    m = runlog.new_manifest("sync", world_size=2)
+    m.record_spawn(rank=0, incarnation=0, world_size=2, spawned_unix=1000.0)
+    m.record_spawn(rank=1, incarnation=0, world_size=2, spawned_unix=1000.0)
+    m.save(str(tmp_path))
+    off = rank1_clock_offset
+    _write_shard(
+        runlog.shard_path(str(tmp_path), 0),
+        [
+            {"event": "marker", "kind": "run_start", "incarnation": 0,
+             "ts": 1000.1, "ts_mono": 50.0},
+            {"event": "step", "step": 0, "step_time_s": 0.1,
+             "ts": 1000.2, "ts_mono": 50.1},
+            {"event": "step", "step": 1, "step_time_s": 0.1,
+             "ts": 1000.4, "ts_mono": 50.3},
+        ],
+    )
+    _write_shard(
+        runlog.shard_path(str(tmp_path), 1),
+        [
+            {"event": "marker", "kind": "run_start", "incarnation": 0,
+             "ts": 1000.1 + off, "ts_mono": 80.0},
+            {"event": "step", "step": 0, "step_time_s": 0.1,
+             "ts": 1000.3 + off, "ts_mono": 80.2},
+            {"event": "step", "step": 1, "step_time_s": 0.1,
+             "ts": 1000.5 + off, "ts_mono": 80.4},
+        ],
+    )
+    return m
+
+
+def test_merge_corrects_skewed_clock(tmp_path):
+    """Rank 1's wall clock is 3 s ahead; sorting by raw ``ts`` would dump
+    all its events after rank 0's. The marker-anchored merge recovers the
+    true interleaving and reports the offset."""
+    _synthetic_run(tmp_path, rank1_clock_offset=3.0)
+    merged = runlog.merge_run(str(tmp_path))
+
+    steps = [e for e in merged.events if e.get("event") == "step"]
+    assert [e["rank"] for e in steps] == [0, 1, 0, 1]
+    # per-spawn deltas are [0.1, 3.1]; the median picks the honest rank's
+    # startup, so rank 0 reads as offset 0 and rank 1 as +3 s
+    assert merged.startup_s == pytest.approx(0.1)
+    assert merged.per_rank[0]["clock_offset_s"] == pytest.approx(0.0)
+    assert merged.per_rank[1]["clock_offset_s"] == pytest.approx(3.0)
+    # aligned times are on the parent clock
+    assert steps[0]["t_run"] == pytest.approx(1000.2)
+    assert steps[1]["t_run"] == pytest.approx(1000.3)
+    # raw-ts ordering really is wrong — the correction is load-bearing
+    raw = sorted(steps, key=lambda e: e["ts"])
+    assert [e["rank"] for e in raw] == [0, 0, 1, 1]
+
+
+def test_merge_falls_back_to_offset_corrected_ts(tmp_path):
+    """Events lacking ``ts_mono`` (pre-existing logs, STAMP_TS opt-outs
+    with a manual ts) still land via ``ts - offset``."""
+    _synthetic_run(tmp_path, rank1_clock_offset=3.0)
+    # strip ts_mono from rank 1's step events only
+    path = runlog.shard_path(str(tmp_path), 1)
+    evs, _ = runlog.load_shard(path)
+    for e in evs:
+        if e["event"] == "step":
+            e.pop("ts_mono")
+    _write_shard(path, evs)
+
+    merged = runlog.merge_run(str(tmp_path))
+    steps = [e for e in merged.events if e.get("event") == "step"]
+    assert [e["rank"] for e in steps] == [0, 1, 0, 1]
+    assert steps[1]["t_run"] == pytest.approx(1000.3)
+
+
+def test_merge_tolerates_torn_tail_and_missing_shard(tmp_path):
+    m = _synthetic_run(tmp_path, rank1_clock_offset=0.0)
+    # a SIGKILLed rank's half-written final line
+    with open(runlog.shard_path(str(tmp_path), 0), "a") as f:
+        f.write('{"event": "step", "step": 2, "ts": 1000.6, "step_t')
+    # and a third rank whose shard never appeared
+    m.record_spawn(rank=2, incarnation=0, world_size=3, spawned_unix=1000.0)
+    m.save(str(tmp_path))
+
+    merged = runlog.merge_run(str(tmp_path))
+    assert merged.torn_lines == 1
+    assert merged.per_rank[0]["torn_lines"] == 1
+    assert merged.per_rank[2]["missing"] is True
+    # the readable events all survived
+    assert sum(1 for e in merged.events if e.get("event") == "step") == 4
+
+
+# ---------------------------------------------------------------------------
+# analytics
+# ---------------------------------------------------------------------------
+
+
+def _step(rank, i, dt):
+    return {"event": "step", "rank": rank, "step": i, "step_time_s": dt}
+
+
+def test_straggler_detection_flags_slow_rank():
+    events = [
+        _step(r, i, 0.08 if r == 2 else 0.01)
+        for r in range(4) for i in range(6)
+    ]
+    stats = analytics.rank_step_stats(events)
+    assert stats[0]["n"] == 5  # first timed step dropped (compile-ish)
+    flags = analytics.detect_stragglers(stats, factor=1.5)
+    assert [ev.rank for ev in flags] == [2]
+    ev = flags[0]
+    assert ev.factor == pytest.approx(8.0)
+    assert "rank 2" in ev.banner() and "8.00x" in ev.banner()
+    # the event round-trips through the telemetry record contract
+    rec = ev.record()
+    assert rec["event"] == "straggler" and rec["rank"] == 2
+
+
+def test_straggler_detection_needs_quorum():
+    # a single rank can't straggle relative to itself
+    events = [_step(0, i, 0.08) for i in range(6)]
+    stats = analytics.rank_step_stats(events)
+    assert analytics.detect_stragglers(stats, factor=1.5) == []
+
+
+def test_effective_bandwidth_dedupes_replicated_ledger():
+    """Every rank (and every incarnation) re-emits the same wire ledger;
+    the estimator must count each collective once, not world_size times."""
+    coll = {
+        "event": "collective", "label": "toy", "tag": "toy.grads",
+        "op": "all-reduce", "dtype": "float32", "payload_bytes": 1 << 20,
+        "count": 1,
+    }
+    out = analytics.effective_bandwidth(
+        step_time_s=0.01,
+        collectives=[dict(coll, rank=r) for r in range(4)],
+        n_workers=4,
+    )
+    assert out["total"]["payload_bytes"] == 1 << 20
+    assert out["total"]["achieved_bytes_per_s"] == pytest.approx((1 << 20) / 0.01)
+    # utilization is achieved / line rate for every fabric in the table
+    for fabric, rate in analytics.FABRICS_BYTES_PER_S.items():
+        assert out["total"]["utilization"][fabric] == pytest.approx(
+            (1 << 20) / 0.01 / rate
+        )
+    # overlap evidence shrinks the comm budget and raises achieved rate
+    overlap = {
+        "n_async_collectives": 1, "n_overlapped": 1,
+        "n_sync_collectives": 1, "n_sync_gaps_with_compute": 0,
+    }
+    hidden = analytics.effective_bandwidth(
+        step_time_s=0.01,
+        collectives=[coll],
+        n_workers=4,
+        overlap=overlap,
+    )
+    assert hidden["comm_budget_s"] == pytest.approx(0.005)
+    assert hidden["total"]["achieved_bytes_per_s"] == pytest.approx(
+        2 * out["total"]["achieved_bytes_per_s"]
+    )
+
+
+def test_effective_bandwidth_degenerate_inputs():
+    assert analytics.effective_bandwidth(0.01, [], 4) is None
+    assert analytics.effective_bandwidth(0.0, [{"payload_bytes": 1}], 4) is None
+
+
+# ---------------------------------------------------------------------------
+# end to end: supervised run -> report -> gate
+# ---------------------------------------------------------------------------
+
+
+def test_supervised_run_report_and_gate(tmp_path, capsys):
+    """4 ranks, rank 1 SIGKILLed at step 2 (restarted), rank 3 running 8x
+    slow. The run dir must hold a manifest + per-rank shards; the merged
+    report must flag rank 3 as the straggler and price the toy collective
+    against every fabric; the gate must pass the recorded run and fail a
+    synthetically regressed copy of it."""
+    run_dir = str(tmp_path / "run")
+    plan_path = str(tmp_path / "plan.json")
+    ChaosPlan([FaultSpec(kind="proc_kill", step=2, rank=1)]).save(plan_path)
+
+    def argv_for_rank(rank, world, incarnation):
+        return [
+            sys.executable, TOY,
+            "--rank", str(rank), "--world", str(world),
+            "--steps", "6",
+            "--state-dir", str(tmp_path / "state"),
+            "--result-dir", str(tmp_path / "results"),
+            "--step-seconds", "0.08" if rank == 3 else "0.01",
+            "--chaos-plan", plan_path,
+        ]
+
+    telemetry = telemetry_for_run(
+        event_log=os.path.join(run_dir, runlog.SUPERVISOR_LOG), stdout=False
+    )
+    result = Supervisor(
+        argv_for_rank,
+        world_size=4,
+        config=SupervisorConfig(
+            max_restarts=2, backoff_base_s=0.01, poll_interval_s=0.02,
+        ),
+        telemetry=telemetry,
+        run_dir=run_dir,
+    ).run()
+    telemetry.close()
+    assert result.success and result.total_restarts == 1
+
+    # manifest + one shard per rank, with rank 1 spawned twice
+    manifest = runlog.RunManifest.load(run_dir)
+    assert manifest.world_size == 4
+    assert manifest.incarnations[1] == 2
+    for rank in range(4):
+        assert os.path.exists(runlog.shard_path(run_dir, rank))
+
+    merged = runlog.merge_run(run_dir)
+    assert merged.per_rank[1]["markers"] == 2  # one run_start per life
+    kinds = {e.get("event") for e in merged.events}
+    assert {"marker", "step", "collective", "failure"} <= kinds
+
+    # report --run-dir: one timeline, straggler verdict, bandwidth table
+    report = _load_script("report")
+    json_out = str(tmp_path / "run_report.json")
+    rc = report.main(["--run-dir", run_dir, "--json-out", json_out])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "per-rank step time" in text
+    assert "straggler: rank 3" in text
+    assert "effective bandwidth" in text and "1GbE" in text
+
+    with open(json_out) as f:
+        rep = json.load(f)
+    assert rep["world_size"] == 4
+    assert [s["rank"] for s in rep["stragglers"]] == [3]
+    assert rep["bandwidth"]["total"]["achieved_bytes_per_s"] > 0
+    assert set(rep["bandwidth"]["total"]["utilization"]) == set(
+        analytics.FABRICS_BYTES_PER_S
+    )
+    assert rep["failures"]["restarts"] == 1
+
+    # gate: identical run passes; a 2x-slower copy fails; advisory never fails
+    gate = _load_script("gate")
+    assert gate.main(["--report", json_out, "--baseline", json_out]) == 0
+    regressed = dict(rep)
+    regressed["step_p50_s"] = rep["step_p50_s"] * 2
+    bad = str(tmp_path / "regressed.json")
+    with open(bad, "w") as f:
+        json.dump(regressed, f)
+    assert gate.main(["--report", bad, "--baseline", json_out]) == 1
+    assert gate.main(["--report", bad, "--baseline", json_out, "--advisory"]) == 0
+    capsys.readouterr()  # drain the gate's stdout verdicts

@@ -13,11 +13,18 @@ SIGTERM contract: persist state, then exit ``PREEMPT_EXIT_CODE`` so the
 supervisor classifies the death as graceful (the ``proc_preempt`` chaos
 fault self-delivers exactly that SIGTERM).
 
+With ``--event-log`` (or a supervisor-exported run dir, resolved via
+``observe.runlog.shard_event_log_from_env``) the worker also emits real
+telemetry into its per-rank shard: the auto run-start marker, one
+CollectiveEvent (the toy "wire ledger" — a fixed per-step payload), and a
+timed StepEvent per step — what the run-level merger, straggler detector,
+and bandwidth estimator consume in tests.
+
 Usage::
 
     python toy_supervised_worker.py --rank R --world W --steps N \
         --state-dir D --result-dir D [--heartbeat-dir D] [--chaos-plan F] \
-        [--step-seconds S] [--graceful-term]
+        [--step-seconds S] [--graceful-term] [--event-log F]
 """
 
 import argparse
@@ -34,9 +41,21 @@ from network_distributed_pytorch_tpu.resilience.chaos import (  # noqa: E402
     PROCESS_FAULTS,
     ChaosPlan,
 )
+from network_distributed_pytorch_tpu.observe import (  # noqa: E402
+    CollectiveEvent,
+    StepEvent,
+    telemetry_for_run,
+)
+from network_distributed_pytorch_tpu.observe.runlog import (  # noqa: E402
+    shard_event_log_from_env,
+)
 from network_distributed_pytorch_tpu.resilience.supervisor import (  # noqa: E402
     incarnation_from_env,
 )
+
+# the toy "wire ledger": a fixed per-step all-reduce payload, so the
+# bandwidth estimator has real bytes to join with measured step times
+TOY_PAYLOAD_BYTES = 1 << 20
 
 
 def _load_state(path):
@@ -77,6 +96,7 @@ def main() -> int:
     p.add_argument("--chaos-plan", default=None)
     p.add_argument("--step-seconds", type=float, default=0.01)
     p.add_argument("--graceful-term", action="store_true")
+    p.add_argument("--event-log", default=None)
     args = p.parse_args()
 
     incarnation = incarnation_from_env()
@@ -88,6 +108,22 @@ def main() -> int:
 
     state_path = os.path.join(args.state_dir, f"rank{args.rank}.json")
     state = _load_state(state_path)
+
+    # per-rank telemetry shard: explicit --event-log wins, else the
+    # supervisor-exported run dir (run_start marker auto-emitted from env)
+    event_log = args.event_log or shard_event_log_from_env()
+    telemetry = (
+        telemetry_for_run(event_log=event_log, stdout=False)
+        if event_log else None
+    )
+    if telemetry is not None:
+        telemetry.emit(
+            CollectiveEvent(
+                label="toy", tag="toy.grads", layer="reducer",
+                op="all-reduce", axis="data", dtype="float32",
+                payload_bytes=TOY_PAYLOAD_BYTES,
+            )
+        )
 
     if args.graceful_term:
         # the PreemptionGuard contract, toy-sized: SIGTERM -> persist the
@@ -113,10 +149,21 @@ def main() -> int:
                 time.sleep(float(spec.payload.get("hang_seconds", 3600.0)))
             if spec.kind == "proc_preempt":
                 os.kill(os.getpid(), signal.SIGTERM)
+        t0 = time.monotonic()
         time.sleep(args.step_seconds)
         state = {"step": i + 1, "value": state["value"] + args.world}
         _save_state(state_path, state)
+        if telemetry is not None:
+            telemetry.emit(
+                StepEvent(
+                    step=i, epoch=0, loss=1.0 / (i + 1),
+                    step_time_s=time.monotonic() - t0,
+                    bits_cumulative=8 * TOY_PAYLOAD_BYTES * (i + 1),
+                )
+            )
 
+    if telemetry is not None:
+        telemetry.close()
     with open(
         os.path.join(args.result_dir, f"rank{args.rank}.json"), "w"
     ) as f:
