@@ -110,23 +110,6 @@ PHASES = ("probe", "flagship", "baseline", "gpt", "fp32arm", "overlap")
 # fallback would engage late or never.
 INIT_GRACE_S = int(os.environ.get("BENCH_INIT_GRACE_S", "300"))
 
-# Peak dense bf16 FLOP/s per chip by device_kind substring (public spec
-# sheets). Longest match wins ("v5 lite" before "v5").
-_PEAK_BF16_FLOPS = {
-    "v2": 45e12,
-    "v3": 123e12,
-    "v4": 275e12,
-    "v5 lite": 197e12,
-    "v5litepod": 197e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v5": 459e12,
-    "v6 lite": 918e12,
-    "v6e": 918e12,
-    "v6": 918e12,
-}
-
-
 # Driver-facing JSON lines flow through the observe sinks (the same event
 # model the experiments log through). observe is jax-free by design, so the
 # parent orchestrator still imports no jax. RawEvent keeps each payload
@@ -270,14 +253,14 @@ class _CacheProbe:
 
 
 def _peak_flops(device) -> float:
-    """Peak bf16 FLOP/s for ``device``, or 0.0 when unknown (CPU smoke tier)."""
-    kind = (getattr(device, "device_kind", "") or "").lower()
-    if device.platform != "tpu":
-        return 0.0
-    for key in sorted(_PEAK_BF16_FLOPS, key=len, reverse=True):
-        if key in kind:
-            return _PEAK_BF16_FLOPS[key]
-    return 0.0
+    """Peak bf16 FLOP/s for ``device``, or 0.0 when unknown (CPU smoke
+    tier). The table lives in ``observe.mfu`` — one provenance for the
+    numbers both the bench MFU and the run report's roofline use."""
+    from network_distributed_pytorch_tpu.observe.mfu import peak_flops
+
+    return peak_flops(
+        getattr(device, "device_kind", "") or "", device.platform
+    )
 
 
 def _small_preset() -> bool:
@@ -1230,7 +1213,8 @@ _SUMMARY_PRIORITY = (
     "flagship_imgs_per_sec", "flagship_imgs_per_sec_min",
     "flagship_imgs_per_sec_max", "baseline_imgs_per_sec",
     "baseline_imgs_per_sec_min", "baseline_imgs_per_sec_max", "mfu",
-    "fp32_scanned_imgs_per_sec", "tpu_error", "flops_chunk_ratio",
+    "fp32_scanned_imgs_per_sec", "tpu_error", "orchestrator_error",
+    "flops_chunk_ratio",
 )
 
 
@@ -1306,117 +1290,136 @@ def orchestrate() -> int:
     cpu_fallback = bool(os.environ.get("BENCH_PLATFORM"))  # pinned = no fallback
     fallback_engaged = False  # flipped only when we DEGRADE mid-run — a
     # deliberately pinned platform (BENCH_PLATFORM=cpu smoke) is not tagged
-    while pending and left() > 45:
-        child = _ChildProc(pending)
-        child_events = 0
-        gave_up = False  # parent-side timeout: the child is WEDGED — the
-        # kill backstop must fire immediately, not after a drain wait
-        window_spent = False  # global window ran out with phases pending:
-        # the child may be mid-drain; give it the last few seconds
-        try:
-            while pending:
-                budget = min(
-                    PHASE_BUDGET_S.get(pending[0], 240)
-                    + (INIT_GRACE_S if child_events == 0 else 0),
-                    left() - 15,
-                )
-                if budget <= 0:
-                    window_spent = True
-                    break
-                try:
-                    ev = child.next_event(budget)
-                except Exception:  # queue.Empty — child wedged (compile hang)
-                    status[pending[0]] = f"timeout after {int(budget)}s"
-                    pending.pop(0)
-                    gave_up = True
-                    break
-                if ev is None:  # child exited
-                    if child_events == 0:
-                        # died before ANY marker line — a native crash
-                        # inside backend init (segfault/OOM in the PJRT
-                        # client emits no Python exception, so the child
-                        # can't report __init__ itself). Count it as an
-                        # init failure so the CPU fallback policy engages
-                        # instead of burning one phase per crash.
-                        init_failures += 1
-                        out.setdefault(
-                            "tpu_error", "child process died during backend init"
-                        )
-                    elif pending:
-                        status.setdefault(pending[0], "child exited early")
+    crashed = None  # orchestrator-level exception, re-raised AFTER the
+    # bounded summary line lands (satellite: a phase raising must never
+    # leave the round's stdout tail without a valid standalone summary)
+    try:
+        while pending and left() > 45:
+            child = _ChildProc(pending)
+            child_events = 0
+            gave_up = False  # parent-side timeout: the child is WEDGED — the
+            # kill backstop must fire immediately, not after a drain wait
+            window_spent = False  # global window ran out with phases pending:
+            # the child may be mid-drain; give it the last few seconds
+            try:
+                while pending:
+                    budget = min(
+                        PHASE_BUDGET_S.get(pending[0], 240)
+                        + (INIT_GRACE_S if child_events == 0 else 0),
+                        left() - 15,
+                    )
+                    if budget <= 0:
+                        window_spent = True
+                        break
+                    try:
+                        ev = child.next_event(budget)
+                    except Exception:  # queue.Empty — child wedged (compile hang)
+                        status[pending[0]] = f"timeout after {int(budget)}s"
                         pending.pop(0)
-                    break
-                child_events += 1
-                if ev["phase"] == "__init__":
-                    err = str(ev["data"].get("error", "?"))[:300]
-                    # an init HANG (_InitTimeout after the 240 s watchdog)
-                    # is the wedged-tunnel signature and is decisive: a
-                    # second probe would hang the same way and burn another
-                    # 240 s of the driver's window for the same verdict.
-                    # Transient errors (UNAVAILABLE etc.) return fast and
-                    # keep the two-strike budget.
-                    init_failures += 2 if "_InitTimeout" in err else 1
-                    out["tpu_error"] = err
-                    break
-                if ev["phase"] == "__drain__":
-                    # the child's end-of-run report on abandoned-compile
-                    # drains — informational, not a measurement phase
-                    out["abandoned_drain"] = ev["data"]
+                        gave_up = True
+                        break
+                    if ev is None:  # child exited
+                        if child_events == 0:
+                            # died before ANY marker line — a native crash
+                            # inside backend init (segfault/OOM in the PJRT
+                            # client emits no Python exception, so the child
+                            # can't report __init__ itself). Count it as an
+                            # init failure so the CPU fallback policy engages
+                            # instead of burning one phase per crash.
+                            init_failures += 1
+                            out.setdefault(
+                                "tpu_error", "child process died during backend init"
+                            )
+                        elif pending:
+                            status.setdefault(pending[0], "child exited early")
+                            pending.pop(0)
+                        break
+                    child_events += 1
+                    if ev["phase"] == "__init__":
+                        err = str(ev["data"].get("error", "?"))[:300]
+                        # an init HANG (_InitTimeout after the 240 s watchdog)
+                        # is the wedged-tunnel signature and is decisive: a
+                        # second probe would hang the same way and burn another
+                        # 240 s of the driver's window for the same verdict.
+                        # Transient errors (UNAVAILABLE etc.) return fast and
+                        # keep the two-strike budget.
+                        init_failures += 2 if "_InitTimeout" in err else 1
+                        out["tpu_error"] = err
+                        break
+                    if ev["phase"] == "__drain__":
+                        # the child's end-of-run report on abandoned-compile
+                        # drains — informational, not a measurement phase
+                        out["abandoned_drain"] = ev["data"]
+                        _emit(out)
+                        continue
+                    init_failures = 0
+                    if ev["phase"] in pending:
+                        pending.remove(ev["phase"])
+                    _merge(
+                        out, ev["phase"], ev["ok"], ev["data"], status,
+                        tier="cpu-smoke-fallback" if fallback_engaged else "",
+                    )
                     _emit(out)
-                    continue
-                init_failures = 0
-                if ev["phase"] in pending:
-                    pending.remove(ev["phase"])
-                _merge(
-                    out, ev["phase"], ev["ok"], ev["data"], status,
-                    tier="cpu-smoke-fallback" if fallback_engaged else "",
+            finally:
+                if (not pending and not gave_up) or window_spent:
+                    # normal completion (or window exhaustion with the child
+                    # possibly mid-drain): let the child drain + exit on its
+                    # own. Killing it while an abandoned phase's daemon thread
+                    # is mid-remote-compile wedges the tunnel for HOURS (the
+                    # 03:37 run's GPT compile did exactly that) — the kill
+                    # below must only ever be a no-op or a backstop. On
+                    # window exhaustion _await_child_exit self-bounds to the
+                    # last ~left()-10 seconds.
+                    _await_child_exit(child, out, left)
+                child.kill()
+            if init_failures >= 2 and not cpu_fallback:
+                if os.environ.get("BENCH_NO_CPU_FALLBACK") == "1":
+                    break
+                # TPU init budget spent — one decisive hang, or two transient
+                # failures: degrade to the CPU smoke tier, clearly labeled;
+                # the TPU error stays on the line
+                print(
+                    "# bench: TPU init failure budget exhausted (a hang is "
+                    "decisive; transient errors take two); falling back to CPU "
+                    "smoke tier",
+                    file=sys.stderr, flush=True,
                 )
-                _emit(out)
-        finally:
-            if (not pending and not gave_up) or window_spent:
-                # normal completion (or window exhaustion with the child
-                # possibly mid-drain): let the child drain + exit on its
-                # own. Killing it while an abandoned phase's daemon thread
-                # is mid-remote-compile wedges the tunnel for HOURS (the
-                # 03:37 run's GPT compile did exactly that) — the kill
-                # below must only ever be a no-op or a backstop. On
-                # window exhaustion _await_child_exit self-bounds to the
-                # last ~left()-10 seconds.
-                _await_child_exit(child, out, left)
-            child.kill()
-        if init_failures >= 2 and not cpu_fallback:
-            if os.environ.get("BENCH_NO_CPU_FALLBACK") == "1":
+                os.environ["BENCH_PLATFORM"] = "cpu"
+                os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+                cpu_fallback = True
+                fallback_engaged = True
+                init_failures = 0  # the CPU tier gets its own failure budget —
+                # otherwise one early CPU hiccup would hit `>= 2` and abort
+                pending = [
+                    p for p in PHASES if not str(status.get(p, "")).startswith("ok")
+                ]
+            elif init_failures >= 2:
                 break
-            # TPU init budget spent — one decisive hang, or two transient
-            # failures: degrade to the CPU smoke tier, clearly labeled;
-            # the TPU error stays on the line
-            print(
-                "# bench: TPU init failure budget exhausted (a hang is "
-                "decisive; transient errors take two); falling back to CPU "
-                "smoke tier",
-                file=sys.stderr, flush=True,
-            )
-            os.environ["BENCH_PLATFORM"] = "cpu"
-            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-            cpu_fallback = True
-            fallback_engaged = True
-            init_failures = 0  # the CPU tier gets its own failure budget —
-            # otherwise one early CPU hiccup would hit `>= 2` and abort
-            pending = [
-                p for p in PHASES if not str(status.get(p, "")).startswith("ok")
-            ]
-        elif init_failures >= 2:
-            break
+    except BaseException as exc:  # noqa: B036 — even SystemExit must
+        # not skip the summary emission; re-raised below
+        crashed = exc
+    reason = "skipped: out of budget" if crashed is None else (
+        "skipped: orchestrator error"
+    )
     for p in pending:
-        status.setdefault(p, "skipped: out of budget")
-    out["partial"] = False
+        status.setdefault(p, reason)
+    out["partial"] = crashed is not None
+    if crashed is not None:
+        out["orchestrator_error"] = (
+            f"{type(crashed).__name__}: {crashed}"[:300]
+        )
     out["wall_s"] = round(time.time() - t_start, 1)
     _persist_midround(out, status)
     _record_gate_baseline(out, status)
     _emit(out)
     # the full record above stays the authoritative line; the bounded
     # summary AFTER it is what a fixed-size tail is guaranteed to hold
+    # — and it must land even on a crash: round 5's driver record ended
+    # in a front-truncated full record and "parsed": null because the
+    # exception path skipped this line entirely
     _emit(_compact_summary(out, status))
+    if crashed is not None:
+        raise crashed
     return 0
 
 
@@ -1488,6 +1491,12 @@ def _record_gate_baseline(out: dict, status: dict) -> None:
         "vs_baseline": out.get("vs_baseline"),
         "phases": {k: str(v)[:60] for k, v in status.items()},
     }
+    # flagship MFU (when the round derived one) rides along so gate.py can
+    # compare a run report's mfu_headline like-for-like (ROADMAP item 2:
+    # gate on MFU, not just imgs/sec)
+    mfu = out.get("mfu")
+    if isinstance(mfu, (int, float)) and mfu > 0:
+        rec["mfu"] = float(mfu)
     path = os.path.join(HERE, "artifacts", "GATE_BASELINE.json")
     try:
         os.makedirs(os.path.join(HERE, "artifacts"), exist_ok=True)

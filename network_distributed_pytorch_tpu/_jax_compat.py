@@ -28,6 +28,11 @@ On the 0.4.x line:
   same rules are registered here: without them the transpose that turns the
   chunked all_gather into the per-chunk gradient reduce-scatter raises
   ``NotImplementedError``.
+- ``compiled_cost`` (a helper, not a monkey-patch): ``Compiled
+  .cost_analysis()`` changed return shape across jaxlib versions (dict vs
+  one-element list of dicts) and raises outright on backends without an XLA
+  cost model. ``observe.mfu`` wants "XLA's FLOPs number or None", never an
+  exception, so the version/backed variance is absorbed here.
 """
 
 from __future__ import annotations
@@ -93,3 +98,28 @@ try:  # optimization_barrier AD rules (present upstream from jax 0.4.38)
         _ad.primitive_transposes[_opt_barrier_p] = _opt_barrier_transpose
 except ImportError:  # pragma: no cover - newer jax moved the private module
     pass
+
+
+def compiled_cost(compiled):
+    """XLA's cost model for a ``jax.stages.Compiled``, normalized.
+
+    Returns a flat ``{metric: float}`` dict (keys like ``"flops"``,
+    ``"bytes accessed"``, ``"utilization"``) or ``None`` when the backend
+    has no cost model, the call raises, or it reports no flops — callers
+    (``observe.mfu`` via ``observe.ledger``) then fall back to the
+    analytic count.
+    """
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    if not isinstance(cost, dict):
+        return None
+    out = {
+        k: float(v) for k, v in cost.items() if isinstance(v, (int, float))
+    }
+    if not out.get("flops"):
+        return None
+    return out

@@ -17,6 +17,8 @@ from typing import Iterator, Sequence, Tuple
 
 import numpy as np
 
+from ..observe.spans import span
+
 
 def epoch_order(
     n: int,
@@ -52,7 +54,12 @@ def iterate_batches(
     idx = epoch_order(n, batch_size, seed, epoch, shuffle, drop_last)
     for start in range(0, len(idx), batch_size):
         sel = idx[start : start + batch_size]
-        yield tuple(a[sel] for a in arrays)
+        # ambient span: gather cost of assembling one batch on the host
+        # (runs inside the consumer's next(), so it nests under the
+        # training loop's data_load span)
+        with span("data_load/assemble"):
+            batch = tuple(a[sel] for a in arrays)
+        yield batch
 
 
 def steps_per_epoch(n: int, batch_size: int, drop_last: bool = True) -> int:
@@ -75,9 +82,12 @@ def device_prefetch(batches, sharding=None, depth: int = 2):
     import jax
 
     def stage(batch):
-        return jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, sharding), batch
-        )
+        # dispatch only — the copy itself overlaps compute; a long span
+        # here means device_put is blocking (e.g. committed-layout reshard)
+        with span("data_load/stage"):
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, sharding), batch
+            )
 
     queue = deque()
     for batch in batches:

@@ -150,6 +150,7 @@ def train_loop(
 
     from ..data import device_prefetch
     from ..observe import FailureEvent
+    from ..observe.spans import recording, span
     from ..parallel.mesh import DATA_AXIS, data_sharding
     from ..utils.profiling import step_annotation, trace
 
@@ -172,13 +173,22 @@ def train_loop(
     )
     audit_pending = audit
     trace_ctx = trace(trace_dir) if trace_dir else contextlib.nullcontext()
-    with trace_ctx:
+    # recording(telemetry) installs the ambient span recorder for the loop's
+    # dynamic extent: the loader, checkpointing, and the audit path emit
+    # spans with no telemetry plumbing of their own
+    with trace_ctx, recording(telemetry):
         for epoch in range(start_epoch, epochs):
-            batches = batches_for_epoch(epoch)
+            batches = iter(batches_for_epoch(epoch))
             if prefetch:
                 batches = device_prefetch(batches, sharding, depth=prefetch)
             steps_done = 0
-            for batch in batches:
+            while True:
+                # span the fetch itself: with prefetch on, a long data_load
+                # span IS the "input pipeline can't keep up" verdict
+                with span("data_load", step=logger._step):
+                    batch = next(batches, None)
+                if batch is None:
+                    break
                 if audit_pending:
                     # must precede the first execution: donate_argnums
                     # invalidates the state buffers the lowering would need
@@ -204,9 +214,15 @@ def train_loop(
                     if watchdog is not None
                     else contextlib.nullcontext()
                 )
-                with ctx, step_annotation(run_name, logger._step):
-                    state, loss = step(state, batch)
-                    loss = jax.device_get(loss)
+                with ctx, step_annotation(run_name, logger._step), span(
+                    "step", step=logger._step
+                ):
+                    with span("step/compute", step=logger._step):
+                        state, loss = step(state, batch)
+                    # the device_get blocks until the step (and its
+                    # collectives) retires: host-visible step tail
+                    with span("step/loss_sync", step=logger._step):
+                        loss = jax.device_get(loss)
                 logger.end_step(epoch, loss)
                 steps_done += 1
                 if heartbeat is not None:
@@ -217,7 +233,8 @@ def train_loop(
                     return state, logger
             logger.end_epoch(epoch, rank=rank)
             if on_epoch_end is not None:
-                on_epoch_end(epoch, state)
+                with span("epoch_hook", step=epoch):
+                    on_epoch_end(epoch, state)
     return state, logger
 
 
@@ -249,7 +266,8 @@ def audited_carry_loop(
     import jax as _jax
 
     from ..observe import CompileEvent
-    from ..observe.ledger import ledger_from_hlo_summary
+    from ..observe.ledger import device_cost_fields, ledger_from_hlo_summary
+    from ..observe.spans import recording, span
     from ..utils.hlo_audit import collective_summary, hlo_text_of_compiled
     from ..utils.overlap import overlap_report
 
@@ -265,8 +283,9 @@ def audited_carry_loop(
             carry, resumed_epoch = resumed
             start_epoch = resumed_epoch + 1
 
-    compiled = jitted.lower(carry, *example_batch).compile()
-    hlo_text = hlo_text_of_compiled(compiled)
+    with span("audit/compile", telemetry=telemetry):
+        compiled = jitted.lower(carry, *example_batch).compile()
+        hlo_text = hlo_text_of_compiled(compiled)
     audit = collective_summary(hlo_text)
     if telemetry is not None:
         ledger = ledger_from_hlo_summary(audit, layer=ledger_layer)
@@ -301,6 +320,7 @@ def audited_carry_loop(
                     )
                     if k in ov
                 },
+                **device_cost_fields(compiled),
             )
         )
     logger = MetricsLogger(
@@ -308,16 +328,21 @@ def audited_carry_loop(
         log_every=log_every,
         telemetry=telemetry,
     )
-    for epoch in range(start_epoch, epochs):
-        for batch in batches_for_epoch(epoch):
-            logger.start_step()
-            carry, loss = compiled(carry, *batch)
-            logger.end_step(epoch, float(_jax.device_get(loss)))
-        logger.end_epoch(epoch, rank=rank)
-        if checkpoint_dir is not None:
-            from ..utils.checkpoint import save_checkpoint
+    with recording(telemetry):
+        for epoch in range(start_epoch, epochs):
+            for batch in batches_for_epoch(epoch):
+                logger.start_step()
+                with span("step", step=logger._step):
+                    with span("step/compute", step=logger._step):
+                        carry, loss = compiled(carry, *batch)
+                    with span("step/loss_sync", step=logger._step):
+                        loss = float(_jax.device_get(loss))
+                logger.end_step(epoch, loss)
+            logger.end_epoch(epoch, rank=rank)
+            if checkpoint_dir is not None:
+                from ..utils.checkpoint import save_checkpoint
 
-            save_checkpoint(checkpoint_dir, carry, step=epoch)
+                save_checkpoint(checkpoint_dir, carry, step=epoch)
     return carry, logger, audit
 
 

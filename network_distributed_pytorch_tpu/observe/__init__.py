@@ -27,6 +27,14 @@ that loop as a first-class subsystem instead of scattered fragments:
 - :mod:`observe.analytics` — straggler detection (typed
   ``StragglerEvent``) and the effective-bandwidth estimator joining
   ledger bytes, measured step times, and schedule overlap attribution.
+- :mod:`observe.spans`     — nested, thread-safe host-side spans
+  (``with span("step/compute"): ...``) emitting typed ``SpanEvent``
+  records through the ambient recorder and mirrored into
+  ``jax.profiler.TraceAnnotation`` when jax is loaded.
+- :mod:`observe.mfu`       — per-phase MFU accounting: peak-FLOPs/HBM
+  device tables, the analytic-vs-``cost_analysis`` FLOPs join, and the
+  roofline verdict (compute / hbm / comm-exposed) as typed ``MfuEvent``
+  records.
 
 ``scripts/report.py`` turns a JSONL run log back into a human report
 (step-time percentiles, bytes/step by tag, compression ratio,
@@ -39,7 +47,7 @@ Everything imported here is jax-free, so the bench parent orchestrator
 (which deliberately imports no jax) can use the same sinks.
 """
 
-from . import analytics, runlog  # noqa: F401
+from . import analytics, mfu, runlog, spans  # noqa: F401
 from .events import (  # noqa: F401
     SCHEMA_VERSION,
     CollectiveEvent,
@@ -48,12 +56,15 @@ from .events import (  # noqa: F401
     Event,
     FailureEvent,
     MarkerEvent,
+    MfuEvent,
     NoteEvent,
     RawEvent,
+    SpanEvent,
     StepEvent,
     StragglerEvent,
 )
 from .ledger import LedgerEntry, WireLedger  # noqa: F401
+from .spans import recording, set_ambient, span  # noqa: F401
 from .sinks import (  # noqa: F401
     JsonlSink,
     MemorySink,

@@ -141,6 +141,16 @@ class CompileEvent(Event):
     dense_grad_bytes: Optional[int] = None  # uncompressed gradient size
     compression_ratio: Optional[float] = None  # dense / reducer payload
     overlap: Dict = field(default_factory=dict)  # utils.overlap extract
+    # device-cost extension (observe.mfu): per-step FLOPs/bytes recorded at
+    # compile time so a jax-free report can join them with measured step
+    # times. ``flops_source`` says where the count came from —
+    # "cost_analysis" (XLA's own model via _jax_compat.compiled_cost) or
+    # "analytic" (the model's hand count). All None when unknown.
+    flops_per_step: Optional[float] = None
+    bytes_accessed_per_step: Optional[float] = None
+    flops_source: Optional[str] = None
+    device_kind: Optional[str] = None
+    peak_flops_per_s: Optional[float] = None
 
     def banner(self) -> str:
         tail = "byte-exact" if self.exact else f"delta {self.delta_bytes:+d} B"
@@ -229,6 +239,68 @@ class StragglerEvent(Event):
             f"{self.p50_s * 1e3:.1f} ms = {self.factor:.2f}x cross-rank "
             f"median {self.median_p50_s * 1e3:.1f} ms "
             f"(threshold {self.threshold:.2f}x, n={self.n_steps})"
+        )
+
+
+@dataclass
+class SpanEvent(Event):
+    """One closed host-side span (:mod:`observe.spans`): a named, nested
+    phase of the run (``data_load``, ``step/compute``, ``checkpoint/save``).
+    Emitted ONCE at close in complete-event form — duration measured on the
+    monotonic clock, the emit-time ``ts``/``ts_mono`` stamp marks the END of
+    the span, so a timeline places the start at ``t_end − dur_s``.
+    ``parent_id`` links the enclosing span (None = top level) and ``depth``
+    is the nesting level, which is what lets ``scripts/report.py
+    --trace-out`` render the spans as a nested Perfetto flamegraph without
+    re-deriving containment. Silent on stdout — a span per step would drown
+    the banners."""
+
+    KIND: ClassVar[str] = "span"
+
+    name: str
+    span_id: int
+    parent_id: Optional[int]
+    depth: int
+    dur_s: float
+    step: Optional[int] = None
+    rank: Optional[int] = None
+
+
+@dataclass
+class MfuEvent(Event):
+    """A per-window MFU + roofline verdict (:mod:`observe.mfu`): measured
+    steady-state step time joined with the compile-time FLOPs record and the
+    per-device peak table. ``bound`` is the roofline classification —
+    ``compute`` / ``hbm`` / ``comm-exposed`` / ``unknown`` — with the
+    numbers it was derived from carried alongside so the verdict is
+    auditable rather than oracular."""
+
+    KIND: ClassVar[str] = "mfu"
+
+    label: str
+    window: str  # e.g. "steady-state"
+    n_steps: int
+    step_time_s: float
+    flops_per_step: float
+    flops_source: str  # "cost_analysis" | "analytic"
+    peak_flops_per_s: float  # 0.0 = unknown device (CPU smoke)
+    mfu: Optional[float]  # None when peak is unknown
+    bound: str  # compute | hbm | comm-exposed | unknown
+    device_kind: str = ""
+    bytes_accessed_per_step: Optional[float] = None
+    arithmetic_intensity: Optional[float] = None  # flops / bytes accessed
+    ridge_flops_per_byte: Optional[float] = None  # peak / HBM bytes/s
+    hbm_bytes_per_s: Optional[float] = None
+    exposed_comm_fraction: Optional[float] = None
+
+    def banner(self) -> str:
+        mfu = f"{self.mfu:.4f}" if self.mfu is not None else "n/a"
+        bound = f"{self.bound}-bound" if self.bound in ("compute", "hbm") else self.bound
+        return (
+            f"[observe] mfu {self.label} ({self.window}, n={self.n_steps}): "
+            f"{mfu} at {self.step_time_s * 1e3:.1f} ms/step, "
+            f"{self.flops_per_step / 1e9:.2f} GF/step ({self.flops_source})"
+            f" -> {bound}"
         )
 
 

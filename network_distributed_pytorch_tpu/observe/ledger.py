@@ -247,9 +247,45 @@ def _overlap_extract(report: Dict) -> Dict:
     return {k: report[k] for k in keys if k in report}
 
 
+def device_cost_fields(compiled, analytic_flops: Optional[float] = None) -> Dict:
+    """The ``CompileEvent`` device-cost extension for an AOT executable:
+    XLA's own per-execution cost model when the backend provides one
+    (``_jax_compat.compiled_cost``), else the caller's analytic FLOPs
+    count, plus the device identity the peak-FLOPs table is keyed on.
+    Returns kwargs for ``CompileEvent`` (possibly just ``device_kind``
+    when neither source knows a FLOPs number)."""
+    import jax
+
+    from .._jax_compat import compiled_cost
+    from .mfu import peak_flops
+
+    try:
+        dev = jax.devices()[0]
+        device_kind, platform = dev.device_kind, dev.platform
+    except Exception:
+        device_kind, platform = "", ""
+    cost = compiled_cost(compiled) if compiled is not None else None
+    if cost is not None:
+        flops, source = cost["flops"], "cost_analysis"
+        bytes_accessed = cost.get("bytes accessed")
+    elif analytic_flops and analytic_flops > 0:
+        flops, source, bytes_accessed = float(analytic_flops), "analytic", None
+    else:
+        return {"device_kind": device_kind}
+    peak = peak_flops(device_kind, platform)
+    return {
+        "flops_per_step": flops,
+        "bytes_accessed_per_step": bytes_accessed,
+        "flops_source": source,
+        "device_kind": device_kind,
+        "peak_flops_per_s": peak if peak > 0 else None,
+    }
+
+
 def audit_compiled_step(step, *args, label: str = "train_step", telemetry=None) -> CompileEvent:
     """AOT-compile ``step.fn(*args)``, reconcile the step's wire ledger
-    against the executable's HLO, extract the overlap evidence, and emit
+    against the executable's HLO, extract the overlap evidence and the
+    device-cost fields (``observe.mfu``'s FLOPs join inputs), and emit
     the result (one ``CollectiveEvent`` per ledger line + a
     ``CompileEvent``) through ``telemetry``.
 
@@ -258,6 +294,7 @@ def audit_compiled_step(step, *args, label: str = "train_step", telemetry=None) 
     config's audit flag."""
     from ..utils.hlo_audit import hlo_text_of_compiled
     from ..utils.overlap import overlap_report
+    from .spans import span
 
     ledger = getattr(step, "ledger", None)
     if ledger is None:
@@ -275,7 +312,9 @@ def audit_compiled_step(step, *args, label: str = "train_step", telemetry=None) 
                 )
             ]
         )
-    hlo_text = hlo_text_of_compiled(step.fn.lower(*args).compile())
+    with span("audit/compile"):
+        compiled = step.fn.lower(*args).compile()
+        hlo_text = hlo_text_of_compiled(compiled)
     rec = ledger.reconcile(hlo_text)
     event = CompileEvent(
         label=label,
@@ -290,6 +329,9 @@ def audit_compiled_step(step, *args, label: str = "train_step", telemetry=None) 
         ),
         compression_ratio=ledger.compression_ratio(),
         overlap=_overlap_extract(overlap_report(hlo_text)),
+        **device_cost_fields(
+            compiled, getattr(step, "flops_per_step", None)
+        ),
     )
     if telemetry is not None:
         for ce in ledger.collective_events(label):

@@ -117,6 +117,10 @@ class ExactReducer:
         leaves = jax.tree_util.tree_leaves(grads_template)
         return self._n_chunks(leaves) if self.packed else len(leaves)
 
+    # named_scope: label the reduction's HLO so device traces attribute
+    # collective/compress time to the reducer (pairs with the host-side
+    # "step/compute" span)
+    @jax.named_scope("reduce.exact")
     def reduce(
         self, state: dict, send: PyTree, axis_name: Optional[str]
     ) -> Tuple[dict, PyTree, PyTree, int]:
@@ -334,6 +338,7 @@ class PowerSGDReducer:
         rank1_packer = TensorPacker([tuple(leaves[i].shape) for i in rank1], dtype=dtype)
         return p_packer, q_packer, rank1_packer
 
+    @jax.named_scope("reduce.collective")
     def _reduce_flat(self, flat: jax.Array, axis_name: Optional[str]) -> jax.Array:
         """One packed payload through the configured reduction engine."""
         if self.comm_chunks is None:
@@ -365,6 +370,7 @@ class PowerSGDReducer:
 
     # ---- the hot path ----------------------------------------------------
 
+    @jax.named_scope("reduce.powersgd")
     def reduce(
         self, state: PowerSGDState, send: PyTree, axis_name: Optional[str]
     ) -> Tuple[PowerSGDState, PyTree, PyTree, int]:

@@ -291,9 +291,12 @@ def make_step_fn(
             diff_params = jax.tree_util.tree_map(
                 lambda p: jax.lax.pcast(p, axis_name, to="varying"), state.params
             )
-        (loss, model_state), grads = grads_of(
-            diff_params, state.model_state, batch
-        )
+        # named_scope: label the HLO so device traces (and span-mirrored
+        # host annotations) attribute op time to grads / reduce / update
+        with jax.named_scope("step.grads"):
+            (loss, model_state), grads = grads_of(
+                diff_params, state.model_state, batch
+            )
         # non-gradient state (BN running stats) stays PER-WORKER, exactly
         # like torch DDP (the reference never syncs running stats); it is
         # collapsed only at eval time via CompiledStep.eval_model_state.
@@ -350,7 +353,8 @@ def make_step_fn(
 
         # report the globally-averaged loss (the reference prints per-rank
         # epoch means, ddp_init.py:183; global mean is strictly more useful)
-        loss = all_reduce_mean(loss, axis_name)
+        with jax.named_scope("step.loss_sync"):
+            loss = all_reduce_mean(loss, axis_name)
         return TrainState(params, momenta, memories, reducer_state, model_state), loss
 
     return step

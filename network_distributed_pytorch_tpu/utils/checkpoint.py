@@ -39,6 +39,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import orbax.checkpoint as ocp
 
+from ..observe.spans import span
+
 COMMITTED_MARKER = "_COMMITTED"
 CHECKSUM_MANIFEST = "_CHECKSUMS.json"
 TOPOLOGY_RECORD = "_TOPOLOGY.json"
@@ -211,12 +213,15 @@ def save_checkpoint(
     if os.path.isdir(tmp):
         shutil.rmtree(tmp)
     os.makedirs(parent, exist_ok=True)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(tmp, jax.device_get(state))
-        # context exit waits for the async write — data is on disk here
-    if _abort_before_commit:
-        return tmp
-    _commit(tmp, final, step, topology=topology)
+    # ambient span: the epoch-boundary save is a classic hidden time sink
+    # (blocking device_get + disk), attributed here with zero plumbing
+    with span("checkpoint/save", step=step):
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(tmp, jax.device_get(state))
+            # context exit waits for the async write — data is on disk here
+        if _abort_before_commit:
+            return tmp
+        _commit(tmp, final, step, topology=topology)
     if keep_last is not None and step is not None:
         gc_checkpoints(root, keep_last)
     return final
@@ -334,11 +339,13 @@ def restore_latest(
         ok, reason = verify_checkpoint(path)
         if ok:
             try:
-                return restore(path, template), step
+                with span("checkpoint/restore", step=step):
+                    return restore(path, template), step
             except TopologyMismatchError:
                 if resharder is None:
                     raise
-                return resharder(path, read_topology(path)), step
+                with span("checkpoint/reshard", step=step):
+                    return resharder(path, read_topology(path)), step
             except Exception as e:  # torn payload orbax can't parse
                 reason = f"restore failed: {type(e).__name__}: {e}"
         if telemetry is not None:

@@ -43,12 +43,13 @@ rc=$?
 set -e
 
 # Observability probe + perf gate: record a tiny supervised run so every
-# CI pass leaves a fresh artifacts/run_report.json, then gate it against
-# the recorded baseline (bench.py's artifacts/GATE_BASELINE.json or the
-# newest BENCH_r*.json). Advisory here — shared CI boxes have noisy step
-# times — so a regression warns without masking the pytest exit code;
-# drop --advisory on dedicated perf hardware to make it blocking.
+# CI pass leaves a fresh artifacts/run_report.json (with per-phase MFU +
+# roofline) and artifacts/toy_trace.json (Perfetto timeline, checked
+# well-formed with spans from every rank), then run the gate advisory
+# against the recorded baseline (bench.py's artifacts/GATE_BASELINE.json
+# or the newest BENCH_r*.json) — all inside run_probe. Advisory because
+# shared CI boxes have noisy step times; run gate.py without --advisory
+# on dedicated perf hardware to make it blocking.
 python scripts/run_probe.py || true
-python scripts/gate.py --advisory --report artifacts/run_report.json || true
 
 exit $rc

@@ -22,11 +22,20 @@ Metrics compared (only those present in BOTH report and baseline):
 - ``achieved_bytes_per_s``  higher is better (from ``bandwidth.total``)
 - ``flagship_imgs_per_sec`` higher is better (bench baselines)
 - ``value``                 higher is better (bench value-tier score)
+- ``mfu``                   higher is better (report ``mfu_headline`` /
+  bench flagship ``mfu`` — ROADMAP item 2's "gate on MFU, not just
+  imgs/sec")
+
+Span time shares (report ``spans.by_name[*].share``) are compared
+separately when both sides carry them: a span name whose share of run
+wall-clock grew by more than ``--span-tolerance`` (absolute, default
+0.10) is a regression — e.g. checkpointing creeping from 5% to 20% of the
+run fails the gate even when throughput metrics still pass.
 
 Usage::
 
     python scripts/gate.py --report artifacts/run_report.json \
-        [--baseline F] [--tolerance 0.2] [--advisory]
+        [--baseline F] [--tolerance 0.2] [--span-tolerance 0.1] [--advisory]
 """
 
 import argparse
@@ -42,6 +51,7 @@ METRICS: Dict[str, str] = {
     "achieved_bytes_per_s": "higher",
     "flagship_imgs_per_sec": "higher",
     "value": "higher",
+    "mfu": "higher",
 }
 
 BASELINE_NAME = "GATE_BASELINE.json"
@@ -68,6 +78,25 @@ def extract_metrics(doc: Dict) -> Dict[str, float]:
     v = doc.get("achieved_bytes_per_s")
     if isinstance(v, (int, float)) and v == v and v > 0:
         out.setdefault("achieved_bytes_per_s", float(v))
+    # MFU: the run report's headline scalar, or bench's flagship "mfu"
+    for key in ("mfu_headline", "mfu"):
+        v = doc.get(key)
+        if isinstance(v, (int, float)) and v == v and v > 0:
+            out.setdefault("mfu", float(v))
+    return out
+
+
+def extract_span_shares(doc: Dict) -> Dict[str, float]:
+    """Per-span-name wall-clock shares from a report's ``spans`` section
+    (absent from bench baselines — span shares only gate report-vs-report)."""
+    spans = doc.get("spans")
+    if not isinstance(spans, dict):
+        return {}
+    out: Dict[str, float] = {}
+    for name, slot in (spans.get("by_name") or {}).items():
+        share = slot.get("share") if isinstance(slot, dict) else None
+        if isinstance(share, (int, float)) and share == share and share >= 0:
+            out[str(name)] = float(share)
     return out
 
 
@@ -179,6 +208,32 @@ def compare(
     return verdicts
 
 
+def compare_span_shares(
+    current: Dict[str, float], baseline: Dict[str, float], tolerance: float
+) -> List[Dict]:
+    """Span time-share verdicts: ABSOLUTE share growth beyond ``tolerance``
+    regresses (shares are fractions of run wall-clock, so a ratio test
+    would over-fire on tiny spans — 0.1% -> 0.4% is noise, 5% -> 20% is
+    the regression this exists to catch). Only names present on both sides
+    compare; a span that newly appeared has no baseline to regress from."""
+    verdicts: List[Dict] = []
+    for name in sorted(set(current) & set(baseline)):
+        cur, base = current[name], baseline[name]
+        limit = base + tolerance
+        verdicts.append(
+            {
+                "metric": f"span:{name}",
+                "direction": "lower",
+                "current": cur,
+                "baseline": base,
+                "limit": limit,
+                "ratio": cur / base if base else float("inf"),
+                "regressed": cur > limit,
+            }
+        )
+    return verdicts
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
@@ -192,6 +247,11 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--tolerance", type=float, default=0.2,
         help="allowed fractional regression before failing (default 0.2)",
+    )
+    parser.add_argument(
+        "--span-tolerance", type=float, default=0.1,
+        help="allowed ABSOLUTE growth in a span's share of run wall-clock"
+             " before failing (default 0.1 = ten percentage points)",
     )
     parser.add_argument(
         "--advisory", action="store_true",
@@ -221,6 +281,13 @@ def main(argv=None) -> int:
     baseline = extract_metrics(baseline_doc)
 
     verdicts = compare(current, baseline, args.tolerance)
+    verdicts.extend(
+        compare_span_shares(
+            extract_span_shares(report),
+            extract_span_shares(baseline_doc),
+            args.span_tolerance,
+        )
+    )
     if not verdicts:
         _say(
             f"baseline {baseline_path} shares no comparable metrics with "
@@ -234,10 +301,15 @@ def main(argv=None) -> int:
     regressions = [v for v in verdicts if v["regressed"]]
     for v in verdicts:
         status = "REGRESSED" if v["regressed"] else "ok"
+        is_span = v["metric"].startswith("span:")
+        tol = (
+            f"tol +{args.span_tolerance:.2f} abs" if is_span
+            else f"tol {args.tolerance:.0%}"
+        )
         _say(
             f"{v['metric']}: current {v['current']:.6g} vs baseline "
             f"{v['baseline']:.6g} ({v['ratio']:.2f}x, {v['direction']} is "
-            f"better, tol {args.tolerance:.0%}) -> {status}"
+            f"better, {tol}) -> {status}"
         )
     result = {
         "gate": "fail" if regressions else "pass",

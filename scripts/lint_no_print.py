@@ -11,6 +11,14 @@ human lines to stderr (``print(..., file=sys.stderr)`` is permitted) and
 machine output via ``sys.stdout.write`` so piped JSON stays clean. A few
 legacy stdout-printing scripts are grandfathered in ``SCRIPT_ALLOWED``.
 
+It also enforces the observability clock discipline: ``time.time()``
+inside ``observe/`` is flagged except at the two sanctioned wall-clock
+sites (``MONO_ALLOWED``). Span and step durations must come from
+``time.monotonic()`` — the wall clock steps under NTP slew, and a span
+whose duration went negative once poisons every share/idle figure
+downstream. Wall-clock belongs only where events are *stamped* for
+cross-rank joining.
+
 Usage::
 
     python scripts/lint_no_print.py            # lint package + scripts/
@@ -34,6 +42,13 @@ SCRIPT_ALLOWED = {
     "tpu_evidence.py",
 }
 
+# the sanctioned wall-clock call sites inside observe/ (everything else
+# there must use time.monotonic() for durations):
+# - telemetry.py: Telemetry.emit stamps ``ts`` — the cross-rank join key
+#   the runlog merger aligns shards by, which MUST be wall clock
+# - runlog.py: the manifest's ``created_unix`` provenance stamp
+MONO_ALLOWED = {"telemetry.py", "runlog.py"}
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGE = os.path.join(REPO, "network_distributed_pytorch_tpu")
 SCRIPTS = os.path.join(REPO, "scripts")
@@ -51,10 +66,13 @@ def _is_stderr_print(node: ast.Call) -> bool:
     return False
 
 
-def print_calls(path: str, permit_stderr: bool = False):
+def _parse(path: str) -> ast.AST:
     with open(path, "rb") as f:
-        tree = ast.parse(f.read(), filename=path)
-    for node in ast.walk(tree):
+        return ast.parse(f.read(), filename=path)
+
+
+def print_calls(path: str, permit_stderr: bool = False):
+    for node in ast.walk(_parse(path)):
         if (
             isinstance(node, ast.Call)
             and isinstance(node.func, ast.Name)
@@ -62,6 +80,21 @@ def print_calls(path: str, permit_stderr: bool = False):
         ):
             if permit_stderr and _is_stderr_print(node):
                 continue
+            yield node.lineno
+
+
+def wallclock_calls(path: str):
+    """Line numbers of ``time.time()`` calls (the attribute form only —
+    a ``from time import time`` alias would dodge this, and observe/
+    deliberately never imports it that way)."""
+    for node in ast.walk(_parse(path)):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "time"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time"
+        ):
             yield node.lineno
 
 
@@ -73,10 +106,17 @@ def lint_tree(root: str, allowed, permit_stderr: bool = False):
                 continue
             path = os.path.join(dirpath, fname)
             rel = os.path.relpath(path, root)
-            if rel in allowed:
-                continue
-            for lineno in print_calls(path, permit_stderr=permit_stderr):
-                violations.append(f"{path}:{lineno}")
+            if rel not in allowed:
+                for lineno in print_calls(path, permit_stderr=permit_stderr):
+                    violations.append(f"{path}:{lineno} bare print()")
+            # clock discipline applies to observe/ wherever the lint was
+            # rooted (package walk or an explicit path argument)
+            if "observe" in path.split(os.sep) and fname not in MONO_ALLOWED:
+                for lineno in wallclock_calls(path):
+                    violations.append(
+                        f"{path}:{lineno} time.time() in observe/ "
+                        "(use time.monotonic() for durations)"
+                    )
     return violations
 
 
@@ -92,8 +132,9 @@ def lint(roots) -> int:
         )
     if violations:
         sys.stderr.write(
-            "bare print() outside observe/sinks.py — route it through an "
-            "observe event/sink (or sys.stderr in scripts/) instead:\n"
+            "lint violations (bare print() must route through an observe "
+            "event/sink or sys.stderr in scripts/; observe/ durations must "
+            "use time.monotonic()):\n"
         )
         for v in violations:
             sys.stderr.write(f"  {v}\n")

@@ -10,9 +10,17 @@ overlap evidence from the scheduled HLO.
 With ``--run-dir`` the input is a whole run directory (manifest + per-rank
 shards, ``launch.py --supervise --run-dir``): the shards are merged into
 one supervisor-clock-ordered timeline (``observe.runlog``), and the report
-adds per-rank step-time skew, straggler verdicts, and the achieved-vs-
-modeled bandwidth table (``observe.analytics``) — emitted as text AND as a
+adds per-rank step-time skew, straggler verdicts, the achieved-vs-
+modeled bandwidth table (``observe.analytics``), the span time-attribution
+summary (top time sinks, per-rank idle gaps), and the per-phase MFU +
+roofline verdict (``observe.mfu`` joining recorded compile-time FLOPs with
+the measured steady-state step time) — emitted as text AND as a
 machine-readable ``artifacts/run_report.json`` for ``scripts/gate.py``.
+
+``--trace-out`` additionally exports the merged timeline as a Chrome-trace
+JSON (open in Perfetto / ``chrome://tracing``): one process row per rank,
+nested host spans as complete events, steps on their own track, collective
+and failure instants — plus a critical-path summary on stdout.
 
 stdlib-only and jax-free — runs anywhere the log files can be copied
 (``--run-dir`` imports ``observe``, which is itself jax-free).
@@ -22,6 +30,7 @@ Usage::
     python scripts/report.py runs/exact.jsonl
     python scripts/report.py runs/*.jsonl      # one report per file
     python scripts/report.py --run-dir runs/r7 --json-out artifacts/run_report.json
+    python scripts/report.py --run-dir runs/r7 --trace-out artifacts/trace.json
 """
 
 from __future__ import annotations
@@ -278,6 +287,16 @@ def render_report(events: List[Dict], name: str = "", skipped_lines: int = 0) ->
                 f"  compression {comp['compression_ratio']:.1f}x "
                 f"(dense gradient {_fmt_bytes(comp.get('dense_grad_bytes') or 0)})"
             )
+        if comp.get("flops_per_step"):
+            peak = comp.get("peak_flops_per_s")
+            peak_txt = f", peak {peak / 1e12:.1f} TF/s" if peak else ""
+            ba = comp.get("bytes_accessed_per_step")
+            ba_txt = f", {_fmt_bytes(ba)} accessed" if ba else ""
+            lines.append(
+                f"  device cost: {comp['flops_per_step'] / 1e9:.2f} GF/step "
+                f"({comp.get('flops_source', '?')}) on "
+                f"{comp.get('device_kind') or 'unknown device'}{peak_txt}{ba_txt}"
+            )
         ov = comp.get("overlap") or {}
         if ov:
             if ov.get("scheduled"):
@@ -309,6 +328,11 @@ def render_report(events: List[Dict], name: str = "", skipped_lines: int = 0) ->
                 f"{e.get('mean_loss', float('nan')):.4f}, "
                 f"{_fmt_bytes(e.get('bits_cumulative', 0) / 8)} cumulative"
             )
+
+    # single-process runs carry spans too (t_run falls back to the emit ts)
+    spans = span_summary(events)
+    if spans:
+        lines.extend(render_span_section(spans))
 
     failures = by_kind.get("failure", [])
     if failures:
@@ -406,11 +430,247 @@ def render_run_sections(
     return lines
 
 
+def _union_len(intervals: List[Tuple[float, float]]) -> float:
+    """Total length covered by a set of (start, end) intervals."""
+    covered = 0.0
+    end = None
+    for lo, hi in sorted(intervals):
+        if hi <= lo:
+            continue
+        if end is None or lo > end:
+            covered += hi - lo
+            end = hi
+        elif hi > end:
+            covered += hi - end
+            end = hi
+    return covered
+
+
+def span_summary(events: List[Dict]) -> Optional[Dict]:
+    """Aggregate the merged timeline's SpanEvents into per-name time
+    shares and per-rank idle gaps.
+
+    A span's ``ts``/``t_run`` marks its CLOSE; start is ``t_run − dur_s``.
+    ``share`` divides a span name's total time by the summed per-rank wall
+    (each rank's last-event-minus-first-event window), so it is the
+    fraction of run wall-clock that name occupied — comparable across runs
+    and what ``scripts/gate.py`` regresses on. ``idle`` is the part of a
+    rank's wall NOT covered by any depth-0 span: host time attributed to
+    nothing, the first place to look when MFU is low but no span is hot."""
+    spans = [
+        e for e in events
+        if e.get("event") == "span"
+        and isinstance(e.get("dur_s"), (int, float)) and e["dur_s"] >= 0
+    ]
+    if not spans:
+        return None
+    walls: Dict = {}
+    for e in events:
+        t = e.get("t_run", e.get("ts"))
+        if isinstance(t, (int, float)):
+            r = e.get("rank")
+            lo, hi = walls.get(r, (t, t))
+            walls[r] = (min(lo, t), max(hi, t))
+    total_wall = sum(hi - lo for lo, hi in walls.values())
+    by_name: Dict[str, Dict] = {}
+    for s in spans:
+        slot = by_name.setdefault(
+            s.get("name", "?"), {"count": 0, "total_s": 0.0, "max_s": 0.0}
+        )
+        slot["count"] += 1
+        slot["total_s"] += s["dur_s"]
+        slot["max_s"] = max(slot["max_s"], s["dur_s"])
+    for slot in by_name.values():
+        slot["mean_s"] = slot["total_s"] / slot["count"]
+        slot["share"] = (
+            slot["total_s"] / total_wall if total_wall > 0 else None
+        )
+    idle_by_rank: Dict[str, Dict] = {}
+    for r, (lo, hi) in sorted(
+        walls.items(), key=lambda kv: (kv[0] is None, kv[0])
+    ):
+        if r is None:  # the supervisor's shard has no training work to idle
+            continue
+        ivals = [
+            (max(lo, s["t_run"] - s["dur_s"]), min(hi, s["t_run"]))
+            for s in spans
+            if s.get("rank") == r and s.get("depth") == 0
+            and isinstance(s.get("t_run"), (int, float))
+        ]
+        if not ivals:
+            continue  # no spans on this rank's clock — idle is undefined
+        covered = _union_len(ivals)
+        wall = hi - lo
+        idle_by_rank[str(r)] = {
+            "wall_s": wall,
+            "covered_s": covered,
+            "idle_s": max(0.0, wall - covered),
+        }
+    return {
+        "by_name": by_name,
+        "total_wall_s": total_wall,
+        "idle_by_rank": idle_by_rank,
+    }
+
+
+def render_span_section(spans: Dict, top_n: int = 8) -> List[str]:
+    """The critical-path summary: top span time sinks by total time, then
+    the per-rank idle gaps."""
+    lines = ["", "span time attribution (top sinks)",
+             "---------------------------------"]
+    top = sorted(
+        spans["by_name"].items(), key=lambda kv: -kv[1]["total_s"]
+    )[:top_n]
+    for name, s in top:
+        share = (
+            f"{100 * s['share']:5.1f}%" if s.get("share") is not None
+            else "    -"
+        )
+        lines.append(
+            f"  {name:<22} total {s['total_s'] * 1e3:9.1f} ms  "
+            f"x{s['count']:<4} mean {s['mean_s'] * 1e3:7.1f} ms  "
+            f"share {share}"
+        )
+    dropped = len(spans["by_name"]) - len(top)
+    if dropped > 0:
+        lines.append(f"  (+{dropped} more span name(s) below the top {top_n})")
+    if spans["idle_by_rank"]:
+        lines.append("  idle (wall not covered by any top-level span):")
+        for r, g in spans["idle_by_rank"].items():
+            pct = 100 * g["idle_s"] / g["wall_s"] if g["wall_s"] > 0 else 0.0
+            lines.append(
+                f"    rank {r}: {g['idle_s'] * 1e3:9.1f} ms of "
+                f"{g['wall_s'] * 1e3:9.1f} ms wall ({pct:4.1f}%)"
+            )
+    return lines
+
+
+def render_mfu_section(mfu_records: List[Dict]) -> List[str]:
+    """Per-phase MFU + roofline verdicts (already record() dicts)."""
+    lines = ["", "mfu & roofline (steady-state)",
+             "-----------------------------"]
+    if not mfu_records:
+        lines.append(
+            "  no compile record carries a FLOPs count — run with audit"
+            " enabled (or a bench tier) to populate the join"
+        )
+        return lines
+    for m in mfu_records:
+        mfu = f"{m['mfu']:.4f}" if m.get("mfu") is not None else "n/a"
+        peak = m.get("peak_flops_per_s") or 0.0
+        peak_txt = f" of {peak / 1e12:.1f} TF/s peak" if peak > 0 else ""
+        exposed = m.get("exposed_comm_fraction")
+        exp_txt = f", exposed comm {exposed:.2f}" if exposed is not None else ""
+        lines.append(
+            f"  {m.get('label', '?'):<16} mfu {mfu}{peak_txt}  "
+            f"{m.get('flops_per_step', 0.0) / 1e9:8.2f} GF/step "
+            f"({m.get('flops_source', '?')}) at "
+            f"{m.get('step_time_s', 0.0) * 1e3:7.1f} ms/step"
+            f" -> {m.get('bound', '?')}{exp_txt}"
+        )
+    return lines
+
+
+# Chrome-trace lanes, one pid per rank (Perfetto renders pid -1, the
+# supervisor, as its own process track)
+_TID_SPANS, _TID_STEPS, _TID_COLLECTIVES, _TID_FAILURES = 0, 1, 2, 3
+
+
+def chrome_trace(events: List[Dict]) -> Dict:
+    """The merged timeline as Chrome-trace JSON (Perfetto /
+    ``chrome://tracing``): spans and steps as complete ("X") events with
+    microsecond timestamps relative to the earliest event, collectives and
+    failures as instants, one process per rank."""
+    timed = [e for e in events if isinstance(e.get("t_run"), (int, float))]
+    if not timed:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t0 = min(
+        e["t_run"] - (
+            e["dur_s"]
+            if e.get("event") == "span"
+            and isinstance(e.get("dur_s"), (int, float))
+            else 0.0
+        )
+        for e in timed
+    )
+
+    def us(t: float) -> float:
+        return round((t - t0) * 1e6, 3)
+
+    trace_events: List[Dict] = []
+    pids: Dict[int, str] = {}
+    for e in timed:
+        rank = e.get("rank")
+        pid = int(rank) if rank is not None else -1
+        kind = e.get("event")
+        if kind == "span" and isinstance(e.get("dur_s"), (int, float)):
+            pids[pid] = "supervisor" if pid < 0 else f"rank {pid}"
+            trace_events.append({
+                "ph": "X", "cat": "span", "name": e.get("name", "span"),
+                "pid": pid, "tid": _TID_SPANS,
+                "ts": us(e["t_run"] - e["dur_s"]),
+                "dur": round(e["dur_s"] * 1e6, 3),
+                "args": {
+                    k: e.get(k)
+                    for k in ("span_id", "parent_id", "depth", "step")
+                    if e.get(k) is not None
+                },
+            })
+        elif kind == "step" and isinstance(e.get("step_time_s"), (int, float)):
+            pids[pid] = "supervisor" if pid < 0 else f"rank {pid}"
+            trace_events.append({
+                "ph": "X", "cat": "step", "name": f"step {e.get('step')}",
+                "pid": pid, "tid": _TID_STEPS,
+                "ts": us(e["t_run"] - e["step_time_s"]),
+                "dur": round(e["step_time_s"] * 1e6, 3),
+                "args": {"loss": e.get("loss")},
+            })
+        elif kind == "collective":
+            pids[pid] = "supervisor" if pid < 0 else f"rank {pid}"
+            trace_events.append({
+                "ph": "i", "s": "t", "cat": "collective",
+                "name": f"{e.get('tag', '?')} ({e.get('op', '?')})",
+                "pid": pid, "tid": _TID_COLLECTIVES, "ts": us(e["t_run"]),
+                "args": {
+                    "payload_bytes": e.get("payload_bytes"),
+                    "layer": e.get("layer"),
+                },
+            })
+        elif kind == "failure":
+            pids[pid] = "supervisor" if pid < 0 else f"rank {pid}"
+            trace_events.append({
+                "ph": "i", "s": "t", "cat": "failure",
+                "name": e.get("kind", "failure"),
+                "pid": pid, "tid": _TID_FAILURES, "ts": us(e["t_run"]),
+                "args": {"message": e.get("message")},
+            })
+    meta: List[Dict] = []
+    for pid, name in sorted(pids.items()):
+        meta.append({
+            "ph": "M", "name": "process_name", "pid": pid,
+            "args": {"name": name},
+        })
+        for tid, tname in (
+            (_TID_SPANS, "spans"), (_TID_STEPS, "steps"),
+            (_TID_COLLECTIVES, "collectives"), (_TID_FAILURES, "failures"),
+        ):
+            meta.append({
+                "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                "args": {"name": tname},
+            })
+    return {"traceEvents": meta + trace_events, "displayTimeUnit": "ms"}
+
+
 def run_report(
-    run_dir: str, straggler_factor: float = 1.5
+    run_dir: str,
+    straggler_factor: float = 1.5,
+    trace_out: Optional[str] = None,
 ) -> Tuple[str, Dict]:
     """The multi-rank run report: merge the run directory's shards, run
-    the analytics, and return (text, machine-readable report dict)."""
+    the analytics (including the span time-attribution summary and the
+    MFU/roofline join), and return (text, machine-readable report dict).
+    ``trace_out`` additionally writes the merged timeline as Chrome-trace
+    JSON there."""
     runlog, analytics = _observe_modules()
     merged = runlog.merge_run(run_dir)
     stats = analytics.rank_step_stats(merged.events)
@@ -436,9 +696,41 @@ def run_report(
         else None
     )
 
+    # the MFU join: compile-time FLOPs records x measured steady-state p50
+    from network_distributed_pytorch_tpu.observe import mfu as mfu_mod
+
+    n_steps = sum(s["n"] for s in stats.values())
+    mfu_records = [
+        ev.record()
+        for ev in mfu_mod.mfu_from_compile_records(
+            [e for e in merged.events if e.get("event") == "compile"],
+            step_p50,
+            n_steps=n_steps,
+        )
+    ]
+    mfus = [m["mfu"] for m in mfu_records if m.get("mfu") is not None]
+    spans = span_summary(merged.events)
+
     sections = render_run_sections(
         merged, stats, stragglers, bandwidth, straggler_factor
     )
+    sections.extend(render_mfu_section(mfu_records))
+    # the span attribution section itself renders inside render_report
+    # (shared with the single-file mode); here we only keep the summary
+    # for the machine-readable report dict
+    if trace_out:
+        trace = chrome_trace(merged.events)
+        parent = os.path.dirname(trace_out)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(trace_out, "w") as f:
+            json.dump(trace, f)
+        sections.append("")
+        sections.append(
+            f"trace: {len(trace['traceEvents'])} events ->"
+            f" {trace_out} (open in Perfetto / chrome://tracing)"
+        )
+
     text = (
         render_report(merged.events, name=run_dir, skipped_lines=merged.torn_lines)
         .rstrip("\n") + "\n" + "\n".join(sections) + "\n"
@@ -467,6 +759,11 @@ def run_report(
         "straggler_factor": straggler_factor,
         "stragglers": [ev.record() for ev in stragglers],
         "bandwidth": bandwidth,
+        "mfu": mfu_records,
+        # the gate's scalar: the best steady-state MFU across phases
+        # (higher = better; a regression means the run got less efficient)
+        "mfu_headline": max(mfus) if mfus else None,
+        "spans": spans,
         "failures": {
             **deaths,
             "restarts": sum(
@@ -496,6 +793,11 @@ def main(argv=None) -> int:
              " by this factor",
     )
     parser.add_argument(
+        "--trace-out", default=None,
+        help="run-dir mode: export the merged timeline as Chrome-trace"
+             " JSON here (open in Perfetto / chrome://tracing)",
+    )
+    parser.add_argument(
         "--json",
         action="store_true",
         help="emit the aggregated per-kind event counts (or the run-dir"
@@ -507,7 +809,9 @@ def main(argv=None) -> int:
 
     if args.run_dir:
         text, report = run_report(
-            args.run_dir, straggler_factor=args.straggler_factor
+            args.run_dir,
+            straggler_factor=args.straggler_factor,
+            trace_out=args.trace_out,
         )
         if args.json:
             sys.stdout.write(json.dumps(report) + "\n")

@@ -4,7 +4,12 @@ Stdlib-only parent (workers are the jax-free toy worker), cheap enough to
 ride at the end of ``run_tests.sh``: spawns a 2-rank supervised run of
 ``tests/toy_supervised_worker.py`` into ``artifacts/toy_run/``, then runs
 ``scripts/report.py --run-dir`` over it so every CI pass leaves a fresh
-``artifacts/run_report.json`` for the perf gate to inspect.
+``artifacts/run_report.json`` for the perf gate to inspect, plus a
+Perfetto-loadable Chrome-trace timeline (``artifacts/toy_trace.json``).
+The trace is sanity-checked (well-formed JSON, span events from every
+rank) and ``scripts/gate.py`` then runs in advisory mode against the
+report, so the whole span -> merge -> trace -> MFU -> gate pipeline is
+exercised on every CI pass.
 
 Usage::
 
@@ -13,6 +18,7 @@ Usage::
 
 import argparse
 import importlib.util
+import json
 import os
 import shutil
 import sys
@@ -32,13 +38,35 @@ from network_distributed_pytorch_tpu.resilience.supervisor import (  # noqa: E40
 )
 
 
-def _load_report_module():
-    path = os.path.join(REPO, "scripts", "report.py")
-    spec = importlib.util.spec_from_file_location("_ci_report", path)
+def _load_script(name: str):
+    path = os.path.join(REPO, "scripts", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"_ci_{name}", path)
     mod = importlib.util.module_from_spec(spec)
-    sys.modules["_ci_report"] = mod
+    sys.modules[f"_ci_{name}"] = mod
     spec.loader.exec_module(mod)
     return mod
+
+
+def _check_trace(path: str, world: int) -> str:
+    """Assert the exported trace is a non-empty, well-formed Chrome-trace
+    document with span slices from every worker rank. Returns "" when
+    healthy, else a diagnostic."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as exc:
+        return f"trace unreadable: {exc}"
+    events = doc.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        return "trace has no traceEvents"
+    span_pids = {
+        ev.get("pid") for ev in events
+        if ev.get("ph") == "X" and ev.get("cat") == "span"
+    }
+    missing = [r for r in range(world) if r not in span_pids]
+    if missing:
+        return f"trace missing span slices for rank(s) {missing}"
+    return ""
 
 
 def main(argv=None) -> int:
@@ -48,6 +76,10 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--json-out", default=os.path.join(REPO, "artifacts", "run_report.json")
+    )
+    parser.add_argument(
+        "--trace-out", default=os.path.join(REPO, "artifacts", "toy_trace.json"),
+        help="Chrome-trace/Perfetto timeline artifact (empty string disables)",
     )
     parser.add_argument("--world", type=int, default=2)
     parser.add_argument("--steps", type=int, default=5)
@@ -89,13 +121,35 @@ def main(argv=None) -> int:
         sys.stderr.write(f"# run_probe: toy run failed: {result}\n")
         return 1
 
-    report = _load_report_module()
-    rc = report.main(["--run-dir", run_dir, "--json-out", args.json_out])
+    report = _load_script("report")
+    report_argv = ["--run-dir", run_dir, "--json-out", args.json_out]
+    if args.trace_out:
+        report_argv += ["--trace-out", args.trace_out]
+    rc = report.main(report_argv)
+    if rc != 0:
+        return rc
+
+    if args.trace_out:
+        problem = _check_trace(args.trace_out, args.world)
+        if problem:
+            sys.stderr.write(f"# run_probe: FAIL: {problem}\n")
+            return 1
+        sys.stderr.write(
+            f"# run_probe: trace ok at {args.trace_out} "
+            "(open in Perfetto / chrome://tracing)\n"
+        )
+
+    # MFU/span regression gate, advisory: the probe proves the gate can
+    # read the report it just wrote; a real regression verdict belongs to
+    # runs with a comparable recorded baseline, not the toy workload
+    gate = _load_script("gate")
+    gate.main(["--report", args.json_out, "--advisory", "--root", REPO])
+
     sys.stderr.write(
         f"# run_probe: {args.world}-rank x {args.steps}-step run recorded at "
         f"{run_dir}; report -> {args.json_out}\n"
     )
-    return rc
+    return 0
 
 
 if __name__ == "__main__":

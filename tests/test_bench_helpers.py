@@ -650,6 +650,85 @@ def test_compact_summary_parses_from_2000_char_tail(monkeypatch):
     assert rec["summary"] is True and rec["value"] == out["value"]
 
 
+def _load_gate():
+    spec = importlib.util.spec_from_file_location(
+        "gate_under_test", os.path.join(REPO, "scripts", "gate.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_summary_round_trips_through_gate_tail_parser(monkeypatch):
+    """The contract the summary line exists for, proved against the REAL
+    consumer: a >1,200-char full record plus the bounded summary, cut to a
+    2,000-char tail, must still yield the summary — with its headline
+    metrics intact — through gate.py's backwards tail scan (the same parser
+    the driver's ``parsed`` field and baseline fallback rely on)."""
+    bench = _load_bench(monkeypatch)
+    gate = _load_gate()
+    out, status = _worst_case_record(bench)
+    summary = bench._compact_summary(out, status)
+    full_line = json.dumps(out)
+    assert len(full_line) > bench._SUMMARY_LIMIT  # premise: record overflows
+    tail = (full_line + "\n" + json.dumps(summary) + "\n")[-2000:]
+    doc = gate._summary_from_lines(tail.split("\n"))
+    assert doc == summary  # byte-exact round trip through the tail
+    metrics = gate.extract_metrics(doc)
+    assert metrics["value"] == out["value"]
+    assert metrics["flagship_imgs_per_sec"] == out["flagship_imgs_per_sec"]
+    assert metrics["mfu"] == out["mfu"]  # the gate's MFU baseline rides it
+
+
+def test_orchestrator_emits_summary_on_crash(monkeypatch, tmp_path):
+    """An orchestrator-level exception (round 5's "parsed": null: the tail
+    ended in a front-truncated full record, no summary) must not skip the
+    final emissions: the full record lands with partial=True and the error
+    on it, the bounded summary is still the very last line, and the
+    exception re-raises so the exit code stays honest."""
+    bench = _load_bench(monkeypatch)
+    lines = []
+
+    class _Boom:
+        def __init__(self, phases):
+            raise RuntimeError("injected orchestrator crash")
+
+    bench._ChildProc = _Boom
+    bench._emit = lambda payload: lines.append(json.loads(json.dumps(payload)))
+    bench.HERE = str(tmp_path)
+    with pytest.raises(RuntimeError, match="injected"):
+        bench.orchestrate()
+    full, summary = lines[-2], lines[-1]
+    assert full["partial"] is True  # the crashed round never claims finality
+    assert full["orchestrator_error"].startswith("RuntimeError")
+    assert all(
+        str(v).startswith("skipped: orchestrator error")
+        for v in full["phases"].values()
+    )
+    assert summary["summary"] is True
+    assert summary["orchestrator_error"].startswith("RuntimeError")
+    assert len(json.dumps(summary)) <= bench._SUMMARY_LIMIT
+
+
+def test_gate_baseline_records_mfu(monkeypatch, tmp_path):
+    """A plain-ok flagship round with a derived MFU records it in
+    artifacts/GATE_BASELINE.json so gate.py can compare a run report's
+    mfu_headline like-for-like; a round without one omits the key."""
+    bench = _load_bench(monkeypatch)
+    bench.HERE = str(tmp_path)
+    out = {"platform": "cpu", "preset": "small", "value": 50.0,
+           "flagship_imgs_per_sec": 50.0, "vs_baseline": 2.0, "mfu": 0.41}
+    bench._record_gate_baseline(out, {"flagship": "ok"})
+    path = os.path.join(str(tmp_path), "artifacts", "GATE_BASELINE.json")
+    with open(path) as f:
+        rec = json.load(f)
+    assert rec["mfu"] == 0.41 and rec["flagship_imgs_per_sec"] == 50.0
+    out.pop("mfu")
+    bench._record_gate_baseline(out, {"flagship": "ok"})
+    with open(path) as f:
+        assert "mfu" not in json.load(f)
+
+
 @pytest.mark.slow
 def test_child_phases_real_jax_smoke(tmp_path):
     """The real measurement child (subprocess, real jax on CPU, tiny chunk):

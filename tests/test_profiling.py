@@ -1,0 +1,54 @@
+"""utils/profiling smoke: the thin jax.profiler wrappers.
+
+These were 0%-covered plumbing until the span work made them load-bearing
+(``experiments.common.train_loop`` wraps every step in
+``step_annotation``). The tests pin the contract the loop relies on:
+``annotate``/``step_annotation`` enter and exit cleanly even OUTSIDE an
+active trace (cheap no-ops — how they run on CPU CI every time), and
+``trace`` really round-trips start/stop, leaving a capture on disk and
+releasing the profiler even when the body raises.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from network_distributed_pytorch_tpu.utils import profiling
+
+
+def test_annotate_nests_outside_trace():
+    # no active trace: TraceAnnotation must still be a safe no-op region
+    with profiling.annotate("outer"):
+        with profiling.annotate("inner"):
+            x = jnp.ones(()) + 1
+    assert float(x) == 2.0
+
+
+def test_step_annotation_wraps_computation():
+    with profiling.step_annotation("toy_run", step=3):
+        y = jax.jit(lambda a: a * 2)(jnp.arange(4.0))
+    assert float(y.sum()) == 12.0
+
+
+def test_trace_writes_capture(tmp_path):
+    log_dir = str(tmp_path / "trace")
+    with profiling.trace(log_dir):
+        with profiling.step_annotation("toy_run", step=0):
+            jax.block_until_ready(jnp.ones((8,)) * 2)
+    captured = []
+    for _root, _dirs, files in os.walk(log_dir):
+        captured.extend(files)
+    assert captured, "start/stop produced no capture files"
+
+
+def test_trace_stops_on_exception(tmp_path):
+    """The finally-clause contract: a raising body must still stop the
+    profiler, or every later trace() in the process fails with 'profiler
+    already started'."""
+    with pytest.raises(ValueError, match="boom"):
+        with profiling.trace(str(tmp_path / "t1")):
+            raise ValueError("boom")
+    with profiling.trace(str(tmp_path / "t2")):  # proof the first stopped
+        pass

@@ -16,9 +16,12 @@ fault self-delivers exactly that SIGTERM).
 With ``--event-log`` (or a supervisor-exported run dir, resolved via
 ``observe.runlog.shard_event_log_from_env``) the worker also emits real
 telemetry into its per-rank shard: the auto run-start marker, one
-CollectiveEvent (the toy "wire ledger" — a fixed per-step payload), and a
-timed StepEvent per step — what the run-level merger, straggler detector,
-and bandwidth estimator consume in tests.
+CollectiveEvent (the toy "wire ledger" — a fixed per-step payload), one
+CompileEvent carrying the toy cost model (fixed FLOPs/step + a made-up
+peak, for the report's MFU join), a timed StepEvent per step, and nested
+SpanEvents (``step`` > ``step/compute`` / ``checkpoint/save``) — what the
+run-level merger, straggler detector, bandwidth estimator, MFU
+accounting, and trace export consume in tests.
 
 Usage::
 
@@ -43,7 +46,10 @@ from network_distributed_pytorch_tpu.resilience.chaos import (  # noqa: E402
 )
 from network_distributed_pytorch_tpu.observe import (  # noqa: E402
     CollectiveEvent,
+    CompileEvent,
     StepEvent,
+    recording,
+    span,
     telemetry_for_run,
 )
 from network_distributed_pytorch_tpu.observe.runlog import (  # noqa: E402
@@ -56,6 +62,13 @@ from network_distributed_pytorch_tpu.resilience.supervisor import (  # noqa: E40
 # the toy "wire ledger": a fixed per-step all-reduce payload, so the
 # bandwidth estimator has real bytes to join with measured step times
 TOY_PAYLOAD_BYTES = 1 << 20
+# the toy "cost model": a fixed analytic FLOPs count and a made-up peak for
+# the simulated device, so the report's MFU join and roofline verdict have
+# real numbers to work from (the one collective is fully exposed -> the
+# steady-state window classifies comm-exposed)
+TOY_FLOPS_PER_STEP = 2.0e9
+TOY_PEAK_FLOPS = 1e12
+TOY_DEVICE_KIND = "toy-sim"
 
 
 def _load_state(path):
@@ -124,6 +137,28 @@ def main() -> int:
                 payload_bytes=TOY_PAYLOAD_BYTES,
             )
         )
+        # the toy compile verdict: byte-exact by fiat, one fully-exposed
+        # collective, and the cost fields observe.mfu joins at report time
+        telemetry.emit(
+            CompileEvent(
+                label="toy",
+                analytic_bytes=TOY_PAYLOAD_BYTES,
+                hlo_bytes=TOY_PAYLOAD_BYTES,
+                delta_bytes=0,
+                exact=True,
+                hlo_collective_count=1,
+                hlo_by_kind={"all-reduce": 1},
+                overlap={
+                    "scheduled": True,
+                    "n_sync_collectives": 1,
+                    "n_sync_gaps_with_compute": 0,
+                },
+                flops_per_step=TOY_FLOPS_PER_STEP,
+                flops_source="analytic",
+                device_kind=TOY_DEVICE_KIND,
+                peak_flops_per_s=TOY_PEAK_FLOPS,
+            )
+        )
 
     if args.graceful_term:
         # the PreemptionGuard contract, toy-sized: SIGTERM -> persist the
@@ -135,32 +170,38 @@ def main() -> int:
 
         signal.signal(signal.SIGTERM, _on_term)
 
-    while state["step"] < args.steps:
-        i = state["step"]
-        if args.heartbeat_dir:
-            _beat(args.heartbeat_dir, args.rank, incarnation, i)
-        spec = plan.pop(PROCESS_FAULTS, i, args.rank, incarnation)
-        if spec is not None:
-            if spec.kind == "proc_exit":
-                os._exit(int(spec.payload.get("exit_code", 43)))
-            if spec.kind == "proc_kill":
-                os.kill(os.getpid(), signal.SIGKILL)
-            if spec.kind == "proc_hang":
-                time.sleep(float(spec.payload.get("hang_seconds", 3600.0)))
-            if spec.kind == "proc_preempt":
-                os.kill(os.getpid(), signal.SIGTERM)
-        t0 = time.monotonic()
-        time.sleep(args.step_seconds)
-        state = {"step": i + 1, "value": state["value"] + args.world}
-        _save_state(state_path, state)
-        if telemetry is not None:
-            telemetry.emit(
-                StepEvent(
-                    step=i, epoch=0, loss=1.0 / (i + 1),
-                    step_time_s=time.monotonic() - t0,
-                    bits_cumulative=8 * TOY_PAYLOAD_BYTES * (i + 1),
+    with recording(telemetry):
+        while state["step"] < args.steps:
+            i = state["step"]
+            if args.heartbeat_dir:
+                _beat(args.heartbeat_dir, args.rank, incarnation, i)
+            spec = plan.pop(PROCESS_FAULTS, i, args.rank, incarnation)
+            if spec is not None:
+                if spec.kind == "proc_exit":
+                    os._exit(int(spec.payload.get("exit_code", 43)))
+                if spec.kind == "proc_kill":
+                    os.kill(os.getpid(), signal.SIGKILL)
+                if spec.kind == "proc_hang":
+                    time.sleep(float(spec.payload.get("hang_seconds", 3600.0)))
+                if spec.kind == "proc_preempt":
+                    os.kill(os.getpid(), signal.SIGTERM)
+            t0 = time.monotonic()
+            # nested spans, toy-sized like the real loop's: the trace export
+            # e2e asserts this parent/child structure survives the merge
+            with span("step", step=i, rank=args.rank):
+                with span("step/compute", step=i, rank=args.rank):
+                    time.sleep(args.step_seconds)
+                state = {"step": i + 1, "value": state["value"] + args.world}
+                with span("checkpoint/save", step=i, rank=args.rank):
+                    _save_state(state_path, state)
+            if telemetry is not None:
+                telemetry.emit(
+                    StepEvent(
+                        step=i, epoch=0, loss=1.0 / (i + 1),
+                        step_time_s=time.monotonic() - t0,
+                        bits_cumulative=8 * TOY_PAYLOAD_BYTES * (i + 1),
+                    )
                 )
-            )
 
     if telemetry is not None:
         telemetry.close()
