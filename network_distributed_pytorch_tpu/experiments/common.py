@@ -447,6 +447,176 @@ def summarize(
     return out
 
 
+def adaptive_train_loop(
+    step_factory: Callable[[Dict[str, Any]], CompiledStep],
+    params: Any,
+    model_state: Any,
+    batches_for_epoch: Callable[[int], Iterator[Any]],
+    epochs: int,
+    controller: Any,
+    injector: Any = None,
+    telemetry: Any = None,
+    rank: int = 0,
+    log_every: int = 0,
+    run_name: str = "train",
+    fabric: str = "ICI(v5e)",
+    deadline_slack: float = 4.0,
+    deadline_floor_s: float = 0.05,
+    escalate_after: int = 3,
+    step_retries: int = 2,
+    stragglers_for_epoch: Optional[Callable[[int], int]] = None,
+) -> Tuple[TrainState, MetricsLogger, Any]:
+    """The degraded-fabric survival loop: :func:`train_loop`'s epoch/step
+    structure, driven by a rebuildable step and closed through the
+    :class:`resilience.controller.FallbackController`.
+
+    ``step_factory(overrides)`` builds a :class:`CompiledStep` for one
+    fallback-ladder rung (overrides: ``reducer``, ``reducer_rank``,
+    ``comm_chunks``, ``comm_strategy``, ``sync_every``); it MUST use
+    ``donate_state=False`` — both guards replay steps on their inputs.
+    Around every step: a :class:`resilience.guards.CollectiveWatchdog`
+    fence hook arms per-chunk deadlines (registered FIRST, so the timer is
+    running when an injected stall sleeps), the optional
+    :class:`resilience.chaos.CommFaultInjector` is advanced host-side and
+    registered as the second fence hook, and the step runs inside
+    ``CommDeadlineGuard(GuardedStep(step))`` — transient exceptions retry
+    innermost; deadline expiries retry once, then mark the step degraded;
+    K consecutive degraded steps raise
+    :class:`resilience.guards.CommEscalationError` to the caller (the
+    supervisor's restart path).
+
+    At each epoch boundary the loop summarizes fabric health (host-side
+    step-time p50; achieved wire bytes/s = ledger bytes-per-step over
+    measured p50; the watchdog's expiry/degraded counters; optional
+    ``stragglers_for_epoch(epoch)`` verdict count — cross-rank straggler
+    detection lives in ``observe.analytics`` and needs the merged run log,
+    so in-process callers inject it) and feeds it to
+    ``controller.observe``. On a decision the step is rebuilt ONCE from
+    the new rung's overrides and the training state carried across:
+    ``params`` (and ``momenta`` — params-shaped and replicated under both
+    reducers) transfer exactly; per-worker ``model_state`` is collapsed
+    through ``eval_model_state`` and re-broadcast; error-feedback memories
+    restart at zero (the unsent residual is forfeited — one step of
+    compression error, the price of the switch; DESIGN.md). The decision
+    lands in telemetry via ``controller.record`` with predicted (new
+    rung's static ledger) vs realized (old rung, measured) bytes/step.
+
+    Returns ``(state, logger, controller)``.
+    """
+    import contextlib
+    import statistics
+    import time as _time
+
+    from ..observe.spans import recording, span
+    from ..parallel import comm
+    from ..resilience.controller import EpochHealth
+    from ..resilience.guards import (
+        CollectiveWatchdog,
+        CommDeadlineGuard,
+        GuardedStep,
+    )
+
+    base = step_factory(controller.overrides)
+    state = base.init_state(params, model_state)
+    n_workers = getattr(base, "num_devices", None) or 1
+
+    watchdog = CollectiveWatchdog(
+        n_workers=n_workers, fabric=fabric, slack=deadline_slack,
+        floor_s=deadline_floor_s, escalate_after=escalate_after,
+        telemetry=telemetry, rank=rank, label=run_name,
+    )
+
+    def _guard(inner: CompiledStep):
+        return CommDeadlineGuard(
+            GuardedStep(
+                inner, retries=step_retries, telemetry=telemetry,
+                label=run_name,
+            ),
+            watchdog, telemetry=telemetry, label=run_name, rank=rank,
+        )
+
+    guard = _guard(base)
+    logger = MetricsLogger(
+        bits_per_step=base.bits_per_step, log_every=log_every,
+        telemetry=telemetry,
+    )
+
+    # watchdog BEFORE injector: arm the deadline, then let the fault sleep
+    comm.add_fence_hook(watchdog)
+    if injector is not None:
+        comm.add_fence_hook(injector)
+    gstep = 0
+    # compile grace for the health signal: the first steps after every
+    # (re)build pay XLA compilation and cache warmup, which would poison
+    # the epoch p50 the controller compares against — excluded from
+    # step_times (still logged through the MetricsLogger)
+    compile_grace = 2
+    try:
+        with recording(telemetry) if telemetry is not None else contextlib.nullcontext():
+            for epoch in range(epochs):
+                step_times = []
+                for batch in batches_for_epoch(epoch):
+                    if injector is not None:
+                        injector.advance(gstep)
+                    logger.start_step()
+                    t0 = _time.monotonic()
+                    with span("step", step=gstep):
+                        with span("step/compute", step=gstep):
+                            state, loss = guard(state, batch)
+                        with span("step/loss_sync", step=gstep):
+                            loss = jax.device_get(loss)
+                    if compile_grace > 0:
+                        compile_grace -= 1
+                    else:
+                        step_times.append(_time.monotonic() - t0)
+                    logger.end_step(epoch, loss, bits=base.bits_per_step)
+                    gstep += 1
+                logger.end_epoch(epoch, rank=rank)
+                if not step_times:
+                    continue
+                p50 = statistics.median(step_times)
+                bytes_per_step = base.bits_per_step / 8
+                counters = watchdog.take_epoch()
+                health = EpochHealth(
+                    epoch=epoch,
+                    step_p50_s=p50,
+                    achieved_bytes_per_s=(
+                        bytes_per_step / p50 if p50 > 0 else 0.0
+                    ),
+                    deadline_expiries=counters["deadline_expiries"],
+                    degraded_steps=counters["degraded_steps"],
+                    stragglers=(
+                        stragglers_for_epoch(epoch)
+                        if stragglers_for_epoch is not None
+                        else 0
+                    ),
+                )
+                decision = controller.observe(health)
+                if decision is None:
+                    continue
+                # ONE recompile per decision: rebuild at the new rung and
+                # carry the state across the switch
+                realized = bytes_per_step
+                new_base = step_factory(controller.overrides)
+                carried_model = base.eval_model_state(state)
+                new_state = new_base.init_state(state.params, carried_model)
+                new_state = new_state._replace(momenta=state.momenta)
+                base, state = new_base, new_state
+                guard = _guard(base)
+                compile_grace = 2
+                controller.record(
+                    decision,
+                    predicted_bytes_per_step=base.bits_per_step / 8,
+                    realized_bytes_per_step=realized,
+                )
+    finally:
+        if injector is not None:
+            comm.remove_fence_hook(injector)
+        comm.remove_fence_hook(watchdog)
+        watchdog.stop()
+    return state, logger, controller
+
+
 def resilient_train_loop(
     step: CompiledStep,
     init_state: TrainState,

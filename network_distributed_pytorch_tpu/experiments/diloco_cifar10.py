@@ -109,7 +109,7 @@ def run(
     else:
         phase_bits = [diloco.bits_per_round]
         round_bits = diloco.bits_per_round
-    from ..observe import NoteEvent, telemetry_from_config
+    from ..observe import DataDropEvent, telemetry_from_config
 
     telemetry = telemetry_from_config(config)
     logger = MetricsLogger(log_every=config.log_every, telemetry=telemetry)
@@ -152,13 +152,18 @@ def run(
             )
             rounds_done += 1
             total_rounds += 1
-        if pending and config.log_every:
-            # same convention as the static-shape loader's ragged-batch drop,
-            # but said out loud: a partial round cannot sync
+        if pending:
+            # same convention as the static-shape loader's ragged-batch
+            # drop, but TYPED: a partial round cannot sync, and the report's
+            # data-drop tally should see exactly how many samples that cost
             telemetry.emit(
-                NoteEvent(
-                    f"note: dropping {len(pending)} trailing batches"
-                    f" (< sync_every={sync_every}) at epoch {epoch} end"
+                DataDropEvent(
+                    label="diloco_cifar10",
+                    epoch=epoch,
+                    dropped_batches=len(pending),
+                    dropped_samples=sum(len(b[1]) for b in pending),
+                    reason=f"partial round < sync_every={sync_every}",
+                    rank=config.process_id,
                 )
             )
         logger.end_epoch(epoch, rank=config.process_id)

@@ -61,14 +61,30 @@ def run(
     ``checkpoint_dir`` switches to :func:`common.resilient_train_loop`:
     per-epoch committed checkpoints, resume-on-entry, and (with
     ``config.chaos_plan``) deterministic fault injection healed by the
-    recovery guards."""
+    recovery guards.
+
+    ``config.adaptive_comm`` switches to :func:`common.adaptive_train_loop`
+    instead: collective deadline watchdogs around every fenced chunk and
+    the :class:`resilience.controller.FallbackController` walking the
+    reducer fallback ladder at epoch boundaries (``config.chaos_plan``
+    then drives the comm-layer faults in-process — no supervisor needed,
+    so checkpoint_dir is not required and not supported together)."""
     config = config or ExperimentConfig(
         training_epochs=1, global_batch_size=256, learning_rate=0.001
     )
     mesh = mesh or make_mesh()
     resilient = checkpoint_dir is not None
-    if config.chaos_plan and not resilient:
-        raise ValueError("config.chaos_plan requires checkpoint_dir")
+    adaptive = bool(config.adaptive_comm)
+    if adaptive and resilient:
+        raise ValueError(
+            "adaptive_comm rebuilds the step per fallback-ladder rung;"
+            " the checkpointed resilient loop carries one fixed step —"
+            " pick one (checkpoint_dir or adaptive_comm)"
+        )
+    if config.chaos_plan and not (resilient or adaptive):
+        raise ValueError(
+            "config.chaos_plan requires checkpoint_dir or adaptive_comm"
+        )
 
     images, labels, is_real = load_cifar10_or_synthetic(data_dir, train=True)
     model = build_model(preset, dtype=jnp.dtype(config.compute_dtype))
@@ -84,6 +100,11 @@ def run(
 
     loss_fn = image_classifier_loss(model, has_batch_stats=True)
     assert strategy in ("ddp", "fsdp"), strategy
+    if adaptive and strategy != "ddp":
+        raise ValueError(
+            "adaptive_comm requires strategy='ddp' (the fallback ladder"
+            " swaps reducers; the FSDP step has no reducer to swap)"
+        )
     if strategy == "fsdp":
         from ..parallel.fsdp import make_fsdp_train_step
 
@@ -110,6 +131,51 @@ def run(
             mesh=mesh,
             comm_chunks=config.comm_chunks,
         )
+    elif adaptive:
+        from ..parallel import PowerSGDReducer
+
+        def _build_step(overrides):
+            # One fallback-ladder rung -> one compiled step. ``sync_every``
+            # is accepted but ignored: this entry point is synchronous DDP
+            # (every step reduces); the localsgd rung only widens anything
+            # in entry point C. ``ef_momentum`` at EVERY rung (it equals
+            # sgd-momentum under ExactReducer — memories stay zero) so the
+            # momenta buffer carries exactly across a reducer switch.
+            if overrides.get("reducer") == "powersgd":
+                reducer = PowerSGDReducer(
+                    random_seed=config.seed,
+                    compression_rank=overrides.get(
+                        "reducer_rank", config.reducer_rank
+                    ),
+                    reuse_query=config.reuse_query,
+                    comm_chunks=overrides.get("comm_chunks", config.comm_chunks),
+                    comm_strategy=overrides.get(
+                        "comm_strategy", config.comm_strategy
+                    ),
+                )
+            else:
+                reducer = ExactReducer(
+                    comm_chunks=overrides.get("comm_chunks", config.comm_chunks),
+                    comm_strategy=overrides.get(
+                        "comm_strategy", config.comm_strategy
+                    ),
+                )
+            return make_train_step(
+                loss_fn,
+                reducer,
+                params,
+                learning_rate=config.learning_rate,
+                momentum=config.momentum,
+                algorithm="ef_momentum",
+                mesh=mesh,
+                accum_steps=config.accum_steps,
+                max_grad_norm=config.max_grad_norm,
+                # the deadline guard replays a step on its inputs, which a
+                # donated buffer cannot survive
+                donate_state=False,
+            )
+
+        step = None  # built per-rung by adaptive_train_loop
     else:
         step = make_train_step(
             loss_fn,
@@ -125,7 +191,8 @@ def run(
             # donated buffer cannot survive
             donate_state=not resilient,
         )
-    state = step.init_state(params, model_state=model_state)
+    if not adaptive:
+        state = step.init_state(params, model_state=model_state)
 
     batches = accumulated_batches(
         [images, labels], config, max_steps_per_epoch=max_steps_per_epoch
@@ -182,6 +249,34 @@ def run(
                 # graceful sentinel rather than report a half-run result
                 # (the finally below still closes telemetry)
                 raise SystemExit(PREEMPT_EXIT_CODE)
+        elif adaptive:
+            from ..resilience import (
+                ChaosPlan,
+                CommFaultInjector,
+                FallbackController,
+            )
+            from .common import adaptive_train_loop
+
+            plan = (
+                ChaosPlan.load(config.chaos_plan)
+                if config.chaos_plan else None
+            )
+            injector = (
+                CommFaultInjector(
+                    plan, rank=config.process_id, telemetry=telemetry,
+                )
+                if plan is not None else None
+            )
+            controller = FallbackController(
+                telemetry=telemetry, rank=config.process_id,
+            )
+            state, logger, controller = adaptive_train_loop(
+                _build_step, params, model_state, batches,
+                config.training_epochs, controller,
+                injector=injector, telemetry=telemetry,
+                rank=config.process_id, log_every=config.log_every,
+                run_name="exact_cifar10", fabric=config.comm_fabric,
+            )
         else:
             state, logger = train_loop(
                 step, state, batches, config.training_epochs,
@@ -198,13 +293,27 @@ def run(
         "preset": preset, "real_data": is_real, "num_devices": mesh.size,
         "strategy": strategy,
     }
+    if adaptive:
+        extra["final_rung"] = controller.rung.name
+        extra["policy_decisions"] = len(controller.decisions)
     if eval_after:
         from .common import evaluate_image_classifier
 
         eval_params = step.unshard(state) if strategy == "fsdp" else state.params
+        if adaptive:
+            # the final rung's step object stayed inside the adaptive loop;
+            # collapse the per-worker stats directly
+            from ..parallel.trainer import collapse_per_worker
+
+            eval_model_state = (
+                collapse_per_worker(state.model_state)
+                if mesh is not None else state.model_state
+            )
+        else:
+            eval_model_state = step.eval_model_state(state)
         test_x, test_y, _ = load_cifar10_or_synthetic(data_dir, train=False)
         extra["eval_accuracy"] = evaluate_image_classifier(
-            model, eval_params, step.eval_model_state(state)["batch_stats"],
+            model, eval_params, eval_model_state["batch_stats"],
             test_x, test_y,
         )
     return summarize("exact_cifar10", logger, extra)

@@ -186,6 +186,21 @@ def build_parser() -> argparse.ArgumentParser:
              " experiments that run through resilient_train_loop; forwarded"
              " to workers under --supervise",
     )
+    p.add_argument(
+        "--adaptive-comm", action="store_true",
+        help="exact_cifar10 (ddp) only: degraded-fabric survival — collective"
+             " deadline watchdogs around every fenced chunk plus the"
+             " closed-loop reducer fallback ladder (resilience.controller);"
+             " --chaos-plan then drives comm-layer faults in-process, no"
+             " checkpoint_dir needed",
+    )
+    p.add_argument(
+        "--comm-fabric", type=str, default=None,
+        choices=("1GbE", "10GbE", "100GbE", "ICI(v5e)"),
+        help="--adaptive-comm: fabric whose modeled line rate"
+             " (utils.bandwidth.FABRICS_BYTES_PER_S) budgets the collective"
+             " deadlines (default ICI(v5e))",
+    )
     # --- supervised elastic launch (resilience.supervisor) ---------------
     # these flags configure the PARENT only and are stripped from the
     # worker command lines (_SUPERVISOR_FLAGS below)
@@ -289,6 +304,9 @@ def config_from_args(args) -> ExperimentConfig:
     cfg.trace_dir = args.trace_dir
     cfg.audit_wire = args.audit_wire
     cfg.chaos_plan = args.chaos_plan
+    cfg.adaptive_comm = args.adaptive_comm
+    if args.comm_fabric is not None:
+        cfg.comm_fabric = args.comm_fabric
     return cfg
 
 
@@ -441,6 +459,13 @@ def main(argv=None) -> dict:
             f"--comm-strategy is not supported by {args.experiment!r}"
             f" (supported: {', '.join(_CHUNKS_OK)})"
         )
+    if cfg.adaptive_comm and args.experiment != "exact_cifar10":
+        raise ValueError(
+            f"--adaptive-comm is not supported by {args.experiment!r}"
+            " (supported: exact_cifar10)"
+        )
+    if args.comm_fabric is not None and not cfg.adaptive_comm:
+        raise ValueError("--comm-fabric requires --adaptive-comm")
     if args.remat and args.experiment not in _REMAT_OK:
         raise ValueError(
             f"--remat is not supported by {args.experiment!r}"

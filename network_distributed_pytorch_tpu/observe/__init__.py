@@ -52,12 +52,14 @@ from .events import (  # noqa: F401
     SCHEMA_VERSION,
     CollectiveEvent,
     CompileEvent,
+    DataDropEvent,
     EpochEvent,
     Event,
     FailureEvent,
     MarkerEvent,
     MfuEvent,
     NoteEvent,
+    PolicyEvent,
     RawEvent,
     SpanEvent,
     StepEvent,

@@ -305,6 +305,66 @@ class MfuEvent(Event):
 
 
 @dataclass
+class PolicyEvent(Event):
+    """One transition of the degraded-fabric fallback controller
+    (:mod:`resilience.controller`): the ladder was walked one rung down
+    (``action="descend"``, the fabric degraded) or one rung up
+    (``action="ascend"``, it recovered). ``trigger`` names the verdict
+    that forced the move (deadline expiries, degraded steps, straggler
+    flags, achieved-bandwidth collapse, or a sustained healthy streak);
+    ``overrides`` is the new rung's knob dict (``reducer``,
+    ``comm_chunks``, ``comm_strategy``, ...) so the record alone is
+    enough to reproduce the reconfiguration. ``predicted_bytes_per_step``
+    is the NEW rung's static wire-ledger cost, ``realized_bytes_per_step``
+    the measured cost at the OLD rung — the pair is the controller's
+    falsifiable claim that descending actually sheds bytes. The banner is
+    the record as JSON, like :class:`FailureEvent`."""
+
+    KIND: ClassVar[str] = "policy"
+
+    action: str  # "descend" | "ascend"
+    trigger: str
+    epoch: int
+    rung_before: str
+    rung_after: str
+    rung_index_before: int
+    rung_index_after: int
+    overrides: Dict = field(default_factory=dict)
+    predicted_bytes_per_step: Optional[float] = None
+    realized_bytes_per_step: Optional[float] = None
+    rank: Optional[int] = None
+
+    def banner(self) -> str:
+        rec = {k: v for k, v in self.record().items() if v is not None}
+        return json.dumps(rec, default=str)
+
+
+@dataclass
+class DataDropEvent(Event):
+    """Typed record of intentionally dropped training data (e.g. the
+    DiLoCo driver discarding a trailing partial sync round). The drop was
+    always legal — the reference does the same — but a silent note makes
+    skipped samples unauditable; this event carries the exact batch and
+    sample counts so ``scripts/report.py`` can tally them per label."""
+
+    KIND: ClassVar[str] = "data_drop"
+
+    label: str
+    epoch: int
+    dropped_batches: int
+    dropped_samples: int
+    reason: str = ""
+    rank: Optional[int] = None
+
+    def banner(self) -> str:
+        return (
+            f"[observe] data_drop {self.label} epoch {self.epoch}: "
+            f"{self.dropped_batches} batch(es) / {self.dropped_samples} "
+            f"sample(s) dropped ({self.reason})"
+        )
+
+
+@dataclass
 class NoteEvent(Event):
     """A free-form human banner (init lifecycle, dropped-batch notes,
     study tables) that should also land in the structured log."""

@@ -37,10 +37,100 @@ see DESIGN.md Round-6 for why both exist.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+import functools
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+# Host-side chunk fence hooks (degraded-fabric survival, DESIGN.md): a hook
+# is a plain Python callable invoked ON THE HOST at every chunk fence point
+# of :func:`chunked_all_reduce_mean` — once per device per execution, with
+# an info dict {tag, chunk, n_chunks, payload_bytes, phase, device_index}
+# where phase is "launch" (the chunk payload is about to ride its
+# collective) or "retire" (the reduced result is available). The insertion
+# is an ordered ``io_callback`` whose token is fenced into the dataflow, so
+# a sleeping hook genuinely delays the collective (comm fault injection)
+# and a timing hook genuinely brackets it (collective deadline watchdogs) —
+# while the callback itself issues NO collectives, leaving the wire ledger
+# byte-exact (schedule_smoke counts only collectives). Hooks are consulted
+# at TRACE time: with no hook registered the compiled graph is bit-for-bit
+# the pre-hook graph; registered hooks are late-bound (the host shim reads
+# the registry at call time), so the active hook set may change between
+# executions without recompiling.
+_FENCE_HOOKS: List[Callable[[Dict], None]] = []
+
+
+def add_fence_hook(fn: Callable[[Dict], None]) -> None:
+    """Register a host-side chunk fence hook (see module note). Hooks run
+    in registration order — register watchdogs BEFORE injectors so the
+    deadline timer is armed when an injected stall starts sleeping."""
+    _FENCE_HOOKS.append(fn)
+
+
+def remove_fence_hook(fn: Callable[[Dict], None]) -> None:
+    """Unregister a fence hook (no-op when absent)."""
+    try:
+        _FENCE_HOOKS.remove(fn)
+    except ValueError:
+        pass
+
+
+def fence_hooks_active() -> bool:
+    """True when at least one fence hook is registered — the trace-time
+    gate for inserting the host callbacks at all."""
+    return bool(_FENCE_HOOKS)
+
+
+def _run_fence_hooks(
+    device_index, *, tag: str, chunk: int, n_chunks: int,
+    payload_bytes: int, phase: str
+):
+    info = {
+        "tag": tag,
+        "chunk": chunk,
+        "n_chunks": n_chunks,
+        "payload_bytes": payload_bytes,
+        "phase": phase,
+        "device_index": int(device_index),
+    }
+    for hook in list(_FENCE_HOOKS):
+        hook(info)
+    return np.int32(0)
+
+
+def _chunk_callback(
+    carry: jax.Array, *, tag: str, chunk: int, n_chunks: int,
+    payload_bytes: int, phase: str, axis_name: Optional[str]
+) -> jax.Array:
+    """Fence a host callback into ``carry``'s dataflow at a chunk boundary:
+    the callback's token and the carried value pass through one
+    ``optimization_barrier``, so XLA can neither hoist the collective above
+    the callback nor sink the callback past the result.
+
+    ``ordered=False`` deliberately: ordering comes from DATAFLOW, not the
+    global token chain — each callback's token is fenced into its own
+    chunk's payload (launch) or the concatenated result (retire), and the
+    chunk pipeline itself is barrier-chained, so per-device callback order
+    follows the chunk schedule exactly. (``ordered=True`` also trips an
+    XLA sharding-propagation check on jaxlib 0.4.37 when the enclosing jit
+    carries explicit shardings: the ordering token becomes an extra entry
+    parameter the propagation vector doesn't cover.)"""
+    from jax.experimental import io_callback
+
+    shim = functools.partial(
+        _run_fence_hooks, tag=tag, chunk=chunk, n_chunks=n_chunks,
+        payload_bytes=payload_bytes, phase=phase,
+    )
+    token = io_callback(
+        shim,
+        jax.ShapeDtypeStruct((), jnp.int32),
+        jnp.asarray(axis_index(axis_name), jnp.int32),
+        ordered=False,
+    )
+    carry, _ = fence(carry, token)
+    return carry
 
 
 def n_bits(x: jax.Array | jax.ShapeDtypeStruct) -> int:
@@ -143,6 +233,7 @@ def chunked_all_reduce_mean(
     axis_name: Optional[str],
     n_chunks: Optional[int],
     strategy: str = "interleave",
+    tag: str = "payload",
 ) -> jax.Array:
     """Software-pipelined chunked allreduce-mean of a flat buffer.
 
@@ -159,21 +250,57 @@ def chunked_all_reduce_mean(
     ``n_chunks=None`` (or a single-chunk split) degrades to the plain
     monolithic path. Wire bytes are invariant in K: the chunk payloads are
     a partition of the flat buffer.
+
+    When fence hooks are registered at trace time (see
+    :func:`add_fence_hook`), every chunk launch and the final retire get an
+    ordered host callback fenced into the dataflow, tagged with ``tag`` —
+    on BOTH the chunked and the monolithic path, so comm faults and
+    deadline watchdogs bite even at the un-chunked baseline rung.
     """
     assert strategy in ("interleave", "ring"), strategy
     reduce_one = ring_all_reduce_mean if strategy == "ring" else all_reduce_mean
     bounds = chunk_bounds(flat.size, n_chunks if n_chunks is not None else 1)
+    hooked = fence_hooks_active()
+    itemsize = flat.dtype.itemsize
+    total_bytes = int(flat.size) * itemsize
     if len(bounds) <= 1:
-        return reduce_one(flat, axis_name)
+        if hooked:
+            flat = _chunk_callback(
+                flat, tag=tag, chunk=0, n_chunks=1,
+                payload_bytes=total_bytes, phase="launch",
+                axis_name=axis_name,
+            )
+        out = reduce_one(flat, axis_name)
+        if hooked:
+            out = _chunk_callback(
+                out, tag=tag, chunk=1, n_chunks=1,
+                payload_bytes=total_bytes, phase="retire",
+                axis_name=axis_name,
+            )
+        return out
     prev = None
     outs = []
-    for start, end in bounds:
+    k = len(bounds)
+    for idx, (start, end) in enumerate(bounds):
         chunk = jax.lax.slice(flat, (start,), (end,))
         if prev is not None:
             chunk, prev = fence(chunk, prev)
+        if hooked:
+            chunk = _chunk_callback(
+                chunk, tag=tag, chunk=idx, n_chunks=k,
+                payload_bytes=(end - start) * itemsize, phase="launch",
+                axis_name=axis_name,
+            )
         prev = reduce_one(chunk, axis_name)
         outs.append(prev)
-    return jnp.concatenate(outs)
+    out = jnp.concatenate(outs)
+    if hooked:
+        out = _chunk_callback(
+            out, tag=tag, chunk=k, n_chunks=k,
+            payload_bytes=total_bytes, phase="retire",
+            axis_name=axis_name,
+        )
+    return out
 
 
 def ring_all_reduce_mean(x: jax.Array, axis_name: Optional[str]) -> jax.Array:

@@ -130,12 +130,14 @@ class ExactReducer:
         if self.packed:
             packer = TensorPacker.for_arrays(leaves)
             flat = packer.pack(leaves)
-            if self.comm_chunks is not None:
-                reduced = chunked_all_reduce_mean(
-                    flat, axis_name, self.comm_chunks, self.comm_strategy
-                )
-            else:
-                reduced = all_reduce_mean(flat, axis_name)
+            # always through the chunked engine: with comm_chunks=None this
+            # degrades to the identical monolithic pmean, but the shared
+            # path carries the fence-hook callbacks (comm fault injection /
+            # deadline watchdogs) even at the un-chunked baseline rung
+            reduced = chunked_all_reduce_mean(
+                flat, axis_name, self.comm_chunks, self.comm_strategy,
+                tag="grads",
+            )
             bits = packer.bits()
             out_leaves = [
                 o.astype(l.dtype) for o, l in zip(packer.unpack(reduced), leaves)
@@ -339,12 +341,14 @@ class PowerSGDReducer:
         return p_packer, q_packer, rank1_packer
 
     @jax.named_scope("reduce.collective")
-    def _reduce_flat(self, flat: jax.Array, axis_name: Optional[str]) -> jax.Array:
-        """One packed payload through the configured reduction engine."""
-        if self.comm_chunks is None:
-            return all_reduce_mean(flat, axis_name)
+    def _reduce_flat(
+        self, flat: jax.Array, axis_name: Optional[str], tag: str = "payload"
+    ) -> jax.Array:
+        """One packed payload through the configured reduction engine —
+        unconditionally the chunked path (identical to the monolithic pmean
+        at ``comm_chunks=None``) so fence hooks cover every collective."""
         return chunked_all_reduce_mean(
-            flat, axis_name, self.comm_chunks, self.comm_strategy
+            flat, axis_name, self.comm_chunks, self.comm_strategy, tag=tag
         )
 
     # ---- state -----------------------------------------------------------
@@ -417,7 +421,9 @@ class PowerSGDReducer:
             # Step 4: ALL_REDUCE_MEAN(P) — ONE collective for all Ps
             # (reducer.py:125-128)
             if ps:
-                p_flat = self._reduce_flat(p_packer.pack(ps), axis_name)
+                p_flat = self._reduce_flat(
+                    p_packer.pack(ps), axis_name, tag="powersgd.P"
+                )
                 bits += n_bits(p_flat)
                 math_dtype = matrices[0].dtype
                 ps = [p.astype(math_dtype) for p in p_packer.unpack(p_flat)]
@@ -429,7 +435,9 @@ class PowerSGDReducer:
             # issue ORDER is mirrored.
             if it == 0 and rank1_idx:
                 rank1_flat = rank1_packer.pack([leaves[i] for i in rank1_idx])
-                rank1_reduced = self._reduce_flat(rank1_flat, axis_name)
+                rank1_reduced = self._reduce_flat(
+                    rank1_flat, axis_name, tag="powersgd.rank1"
+                )
                 bits += rank1_packer.bits()
                 rank1_out = [
                     o.astype(leaves[i].dtype)
@@ -455,7 +463,9 @@ class PowerSGDReducer:
             # Step 7: ALL_REDUCE_MEAN(Q) — ONE collective for all Qs
             # (reducer.py:144-147)
             if qs:
-                q_flat = self._reduce_flat(q_packer.pack(qs), axis_name)
+                q_flat = self._reduce_flat(
+                    q_packer.pack(qs), axis_name, tag="powersgd.Q"
+                )
                 bits += n_bits(q_flat)
                 qs = [q.astype(matrices[0].dtype) for q in q_packer.unpack(q_flat)]
                 new_q_memory = q_flat

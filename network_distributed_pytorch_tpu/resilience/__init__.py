@@ -23,6 +23,11 @@ operates them:
   with bounded backoff (SIGTERM-then-SIGKILL with a grace window, never a
   bare kill), resumes from the newest committed checkpoint, and degrades
   to a shrunk world when a rank is permanently gone.
+- :mod:`resilience.controller` — the degraded-fabric policy loop: an
+  ordered fallback ladder over the comm knobs (chunking → ring schedule →
+  PowerSGD compression → widened sync period) walked down on degraded
+  epoch verdicts and back up, with hysteresis, when the fabric recovers —
+  every move a typed ``PolicyEvent``.
 - :mod:`resilience.reshard`    — what makes the degraded restart lossless:
   deterministic state resharding from a topology-tagged checkpoint at
   world W to any W' ≤ W (EF memories fold by summation preserving the
@@ -37,7 +42,9 @@ jax lazily inside the functions that touch pytrees).
 
 from .chaos import (  # noqa: F401
     CHECKPOINT_FAULTS,
+    COMM_FAULTS,
     FAULT_KINDS,
+    INJECTION_SITES,
     LOADER_FAULTS,
     PREEMPT_EXIT_CODE,
     PROCESS_FAULTS,
@@ -45,14 +52,28 @@ from .chaos import (  # noqa: F401
     ChaosPlan,
     ChaosStep,
     ChaosTransientError,
+    CommFaultInjector,
     FaultSpec,
     apply_checkpoint_fault,
     chaos_batches,
+    check_fault_registry,
+)
+from .controller import (  # noqa: F401
+    DEFAULT_LADDER,
+    EpochHealth,
+    FallbackController,
+    PolicyDecision,
+    Rung,
 )
 from .guards import (  # noqa: F401
+    CollectiveWatchdog,
+    CommDeadlineError,
+    CommDeadlineGuard,
+    CommEscalationError,
     GuardedStep,
     NonFiniteLossError,
     PreemptionGuard,
+    derive_collective_deadline,
     guarded_batches,
 )
 from .reshard import (  # noqa: F401

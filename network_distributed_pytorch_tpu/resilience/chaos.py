@@ -26,6 +26,15 @@ Fault kinds and where they bite:
 ``proc_preempt``       a preemption notice: the worker SIGTERMs itself; an
                        installed ``guards.PreemptionGuard`` turns it into an
                        emergency committed checkpoint at the step boundary
+``comm_throttle``      the fabric degrades: every chunk collective pays a
+                       host-side sleep of ``payload_bytes / bytes_per_s``
+                       (a mock line rate), injected at the comm fence hooks
+``comm_stall``         ONE collective hangs past its deadline on the target
+                       rank (a dead link / stuck DMA): a single chunk
+                       launch sleeps ``stall_seconds``, then proceeds
+``comm_flap``          a transient throttle that clears by itself after
+                       ``clears_after`` steps — the flaky-link case the
+                       watchdog must survive WITHOUT a world restart
 ==================  =========================================================
 
 Process- and step-level faults carry an ``incarnation`` filter (default 0)
@@ -52,7 +61,52 @@ LOADER_FAULTS = ("loader_bad_batch", "loader_short_batch")
 STEP_FAULTS = ("step_transient", "step_nan")
 CHECKPOINT_FAULTS = ("ckpt_torn", "ckpt_bitflip")
 PROCESS_FAULTS = ("proc_exit", "proc_kill", "proc_hang", "proc_preempt")
-FAULT_KINDS = LOADER_FAULTS + STEP_FAULTS + CHECKPOINT_FAULTS + PROCESS_FAULTS
+COMM_FAULTS = ("comm_throttle", "comm_stall", "comm_flap")
+FAULT_KINDS = (
+    LOADER_FAULTS + STEP_FAULTS + CHECKPOINT_FAULTS + PROCESS_FAULTS
+    + COMM_FAULTS
+)
+
+# The registry the satellite asks for: every fault kind names the ONE
+# injection site that consumes it, and every registered kind must be in
+# FAULT_KINDS. ``check_fault_registry`` asserts the bijection at import
+# time, so adding a kind to a group without teaching an injector about it
+# (or vice versa) fails the first import instead of silently never firing.
+INJECTION_SITES: Dict[str, str] = {
+    "loader_bad_batch": "loader",       # chaos_batches
+    "loader_short_batch": "loader",     # chaos_batches
+    "step_transient": "step",           # ChaosStep
+    "step_nan": "step",                 # ChaosStep
+    "ckpt_torn": "checkpoint",          # apply_checkpoint_fault
+    "ckpt_bitflip": "checkpoint",       # apply_checkpoint_fault
+    "proc_exit": "process",             # ChaosStep (process-level branch)
+    "proc_kill": "process",             # ChaosStep (process-level branch)
+    "proc_hang": "process",             # ChaosStep (process-level branch)
+    "proc_preempt": "process",          # ChaosStep (process-level branch)
+    "comm_throttle": "comm-hook",       # CommFaultInjector fence hook
+    "comm_stall": "comm-hook",          # CommFaultInjector fence hook
+    "comm_flap": "comm-hook",           # CommFaultInjector fence hook
+}
+
+
+def check_fault_registry() -> None:
+    """Assert FAULT_KINDS and INJECTION_SITES agree exactly (both ways)."""
+    kinds = set(FAULT_KINDS)
+    sites = set(INJECTION_SITES)
+    missing = sorted(kinds - sites)
+    stray = sorted(sites - kinds)
+    if missing or stray:
+        raise AssertionError(
+            f"fault registry drift: kinds without an injection site "
+            f"{missing}; injection-site kinds not in FAULT_KINDS {stray}"
+        )
+    if len(FAULT_KINDS) != len(kinds):
+        raise AssertionError(
+            f"duplicate fault kind in FAULT_KINDS: {FAULT_KINDS}"
+        )
+
+
+check_fault_registry()
 
 # exit code a chaos-injected clean crash uses — distinguishable from both
 # success (0) and a signal death (negative returncode) in supervisor logs
@@ -269,6 +323,125 @@ def chaos_batches(
             yield batch
 
     return gen
+
+
+class CommFaultInjector:
+    """The comm-hook face of the plan's ``COMM_FAULTS`` group: a plain
+    callable registered as a :func:`parallel.comm.add_fence_hook`, plus a
+    host-side :meth:`advance` the training loop calls once per step.
+
+    The split matters: ``advance`` does the plan bookkeeping (pop specs,
+    start/clear throttles, emit ``chaos_injected`` / ``comm_fault_cleared``)
+    on the host thread where telemetry is safe, while ``__call__`` — which
+    runs inside the ordered io_callback, once per device per execution —
+    only sleeps. Injection therefore delays the real collective (the
+    callback token is fenced into the chunk's dataflow) without adding a
+    single byte to the wire ledger.
+
+    Fault payload knobs: ``bytes_per_s`` (mock line rate, default 10GbE),
+    ``max_sleep_s`` (per-chunk sleep clamp, keeps a throttle under the
+    watchdog deadline), ``duration_steps`` / ``clears_after`` (throttle /
+    flap lifetime in steps; a flap defaults to clearing after 3),
+    ``stall_seconds`` and ``chunk`` (which chunk launch hangs, once).
+
+    Runs are single-controller per process: the hook filters on
+    ``device_index == rank`` so a single-process multi-device test mesh
+    injects exactly one fault per logical collective, not one per device.
+    """
+
+    def __init__(
+        self,
+        plan: ChaosPlan,
+        rank: int = 0,
+        incarnation: int = 0,
+        telemetry: Any = None,
+    ):
+        self._plan = plan
+        self._rank = rank
+        self._incarnation = incarnation
+        self._telemetry = telemetry
+        self._step_index = -1
+        self._throttle: Optional[Dict[str, Any]] = None
+        self._stall: Optional[Dict[str, Any]] = None
+
+    # -- host-side plan bookkeeping (training loop, once per step) ----------
+    @property
+    def throttled(self) -> bool:
+        return self._throttle is not None
+
+    @property
+    def stall_pending(self) -> bool:
+        return self._stall is not None
+
+    def advance(self, step_index: int) -> None:
+        """Pop any comm fault scheduled for ``step_index`` and retire an
+        expiring flap/throttle. Call BEFORE running the step."""
+        self._step_index = step_index
+        t = self._throttle
+        if (
+            t is not None
+            and t["until_step"] is not None
+            and step_index >= t["until_step"]
+        ):
+            self._throttle = None
+            if self._telemetry is not None:
+                from ..observe import FailureEvent
+
+                self._telemetry.emit(
+                    FailureEvent(
+                        kind="comm_fault_cleared",
+                        label=t["kind"],
+                        rank=self._rank,
+                        step=step_index,
+                        incarnation=self._incarnation,
+                    )
+                )
+        spec = self._plan.pop(
+            COMM_FAULTS, step_index, self._rank, self._incarnation
+        )
+        if spec is None:
+            return
+        _emit_injected(
+            self._telemetry, spec, step_index, self._rank, self._incarnation
+        )
+        p = spec.payload
+        if spec.kind in ("comm_throttle", "comm_flap"):
+            clears = p.get("clears_after", 3 if spec.kind == "comm_flap" else None)
+            if clears is None:
+                clears = p.get("duration_steps")
+            self._throttle = {
+                "kind": spec.kind,
+                "bytes_per_s": float(p.get("bytes_per_s", 1.25e9)),
+                "max_sleep_s": float(p.get("max_sleep_s", 0.25)),
+                "until_step": (
+                    step_index + int(clears) if clears is not None else None
+                ),
+            }
+        elif spec.kind == "comm_stall":
+            self._stall = {
+                "stall_seconds": float(p.get("stall_seconds", 1.0)),
+                "chunk": int(p.get("chunk", 0)),
+            }
+
+    # -- the fence hook (io_callback thread, once per device) ---------------
+    def __call__(self, info: Dict[str, Any]) -> None:
+        if info.get("device_index") != self._rank:
+            return
+        if info.get("phase") != "launch":
+            return
+        st = self._stall
+        if st is not None and info.get("chunk") == st["chunk"]:
+            self._stall = None  # one collective hangs, once
+            time.sleep(st["stall_seconds"])
+            return
+        t = self._throttle
+        if t is not None:
+            sleep_s = min(
+                float(info.get("payload_bytes", 0)) / t["bytes_per_s"],
+                t["max_sleep_s"],
+            )
+            if sleep_s > 0:
+                time.sleep(sleep_s)
 
 
 def apply_checkpoint_fault(

@@ -22,6 +22,22 @@ Plus the preemption-grace side of elastic recovery:
   graceful SIGTERM-then-SIGKILL shutdown loses zero completed steps
   instead of everything since the last epoch boundary.
 
+And the degraded-fabric side (DESIGN.md):
+
+- :func:`derive_collective_deadline` — a per-collective time budget from
+  the wire ledger's bytes and the ``FABRICS_BYTES_PER_S`` model, floored
+  by the measured collective p50 × a slack factor.
+- :class:`CollectiveWatchdog` — a fence hook (``parallel.comm``) arming a
+  ``StepWatchdog``-style monitor-thread timer around every fenced chunk;
+  expiry emits ``FailureEvent(kind="comm_deadline")`` and marks the
+  attempt, never kills the process itself.
+- :class:`CommDeadlineGuard` — wraps the step OUTSIDE :class:`GuardedStep`
+  (a deadline expiry is not a transient exception — the step returns,
+  late); one in-place retry, then the step is marked degraded, and only K
+  CONSECUTIVE degraded steps escalate (``CommEscalationError``, which is
+  deliberately not a ``RuntimeError`` so the transient-retry machinery
+  cannot swallow it) — a transient flap recovers with zero restarts.
+
 Every recovery action is a ``FailureEvent`` through telemetry, so the run
 log shows fault → detection → recovery with timestamps.
 """
@@ -30,12 +46,304 @@ from __future__ import annotations
 
 import math
 import signal
-from typing import Any, Callable, Iterator, Optional
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, Optional
 
 
 class NonFiniteLossError(RuntimeError):
     """A step reported a NaN/inf loss — treated as transient: the state
     that produced it is discarded and the step re-run on its inputs."""
+
+
+class CommDeadlineError(RuntimeError):
+    """A collective blew its derived deadline (transient-shaped: retryable)."""
+
+
+class CommEscalationError(Exception):
+    """K consecutive steps degraded by collective-deadline expiries: the
+    fabric is persistently sick and the supervisor should take over.
+
+    Deliberately NOT a ``RuntimeError``: :class:`GuardedStep` /
+    ``retry_transient`` catch ``RuntimeError``, and an escalation must
+    propagate past them to the worker's top level."""
+
+
+def derive_collective_deadline(
+    payload_bytes: int,
+    n_workers: int,
+    fabric: str = "ICI(v5e)",
+    measured_p50_s: Optional[float] = None,
+    slack: float = 4.0,
+    floor_s: float = 0.05,
+) -> float:
+    """Per-collective deadline: ``max(modeled_time, measured_p50) × slack``,
+    floored at ``floor_s``.
+
+    The model is ``utils.bandwidth.allreduce_time_s`` (the ring lower
+    bound at the fabric's ``FABRICS_BYTES_PER_S`` line rate) — optimistic
+    by construction, hence the slack factor; the measured p50 of recent
+    fenced chunks keeps the deadline honest on hardware slower than the
+    model (CPU test meshes most of all); the floor keeps tiny payloads
+    from deriving microsecond hair-trigger deadlines."""
+    # path-load so the supervisor-parent import path stays jax-free (the
+    # utils package __init__ pulls jax; the bandwidth module itself is
+    # stdlib-only)
+    from ..observe.analytics import _load_utils_module
+
+    bw = _load_utils_module("bandwidth")
+    modeled = bw.allreduce_time_s(int(payload_bytes), int(n_workers), fabric)
+    budget = max(modeled, measured_p50_s or 0.0) * slack
+    return max(budget, floor_s)
+
+
+class CollectiveWatchdog:
+    """A deadline timer around every fenced chunk collective, driven as a
+    ``parallel.comm`` fence hook.
+
+    One monitor thread (the :class:`utils.failure.StepWatchdog` pattern:
+    a ``Condition`` guarding a single monotonic deadline) watches the
+    currently-armed chunk. The hook arms on every ``launch`` with a
+    deadline from :func:`derive_collective_deadline` (per-chunk payload
+    bytes; measured p50 over the last ``history`` chunks as the floor) and
+    disarms on the next fence point — so the armed window brackets exactly
+    one collective's wire time plus its retire compute. Expiry emits
+    ``FailureEvent(kind="comm_deadline")`` from the monitor thread and
+    flags the attempt; it never interrupts the step, which completes
+    (late) on its own.
+
+    Escalation policy lives here too: :meth:`note_step` tracks the
+    CONSECUTIVE-degraded-step streak, :meth:`should_escalate` compares it
+    against ``escalate_after`` (K), and :meth:`take_epoch` hands the
+    per-epoch expiry/degraded counters to the fallback controller.
+
+    Register this hook BEFORE any fault injector, so the timer is armed
+    when an injected stall starts sleeping."""
+
+    def __init__(
+        self,
+        n_workers: int = 1,
+        fabric: str = "ICI(v5e)",
+        slack: float = 4.0,
+        floor_s: float = 0.05,
+        escalate_after: int = 3,
+        history: int = 64,
+        telemetry: Any = None,
+        rank: int = 0,
+        label: str = "comm",
+    ):
+        self.n_workers = n_workers
+        self.fabric = fabric
+        self.slack = slack
+        self.floor_s = floor_s
+        self.escalate_after = escalate_after
+        self._telemetry = telemetry
+        self._rank = rank
+        self._label = label
+        self._cond = threading.Condition()
+        self._deadline: Optional[float] = None
+        self._armed: Optional[Dict[str, Any]] = None
+        self._arm_t: Optional[float] = None
+        self._durations: deque = deque(maxlen=history)
+        self._stop = False
+        self._expired_this_attempt = False
+        self._degraded_streak = 0
+        self._epoch_expiries = 0
+        self._epoch_degraded = 0
+        self.fired: list = []
+        self._thread = threading.Thread(
+            target=self._monitor, name=f"collective-watchdog-{label}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # -- the fence hook (io_callback thread) --------------------------------
+    def __call__(self, info: Dict[str, Any]) -> None:
+        if info.get("device_index") != self._rank:
+            return
+        now = time.monotonic()
+        with self._cond:
+            if self._arm_t is not None:
+                self._durations.append(now - self._arm_t)
+            if info.get("phase") == "launch":
+                durs = sorted(self._durations)
+                p50 = durs[len(durs) // 2] if durs else None
+                budget = derive_collective_deadline(
+                    info.get("payload_bytes", 0), self.n_workers,
+                    self.fabric, measured_p50_s=p50, slack=self.slack,
+                    floor_s=self.floor_s,
+                )
+                self._armed = {**info, "deadline_s": budget}
+                self._arm_t = now
+                self._deadline = now + budget
+            else:  # retire: the pipeline's last result landed
+                self._armed = None
+                self._arm_t = None
+                self._deadline = None
+            self._cond.notify_all()
+
+    # -- monitor thread -----------------------------------------------------
+    def _monitor(self) -> None:
+        while True:
+            with self._cond:
+                if self._stop:
+                    return
+                if self._deadline is None:
+                    self._cond.wait()
+                    continue
+                now = time.monotonic()
+                if now < self._deadline:
+                    self._cond.wait(self._deadline - now)
+                    continue
+                info = self._armed or {}
+                self._deadline = None
+                self._armed = None
+                self._arm_t = None
+                self._expired_this_attempt = True
+                self._epoch_expiries += 1
+                self.fired.append(info)
+            self._emit_deadline(info)
+
+    def _emit_deadline(self, info: Dict[str, Any]) -> None:
+        if self._telemetry is None:
+            return
+        from ..observe import FailureEvent
+
+        self._telemetry.emit(
+            FailureEvent(
+                kind="comm_deadline",
+                label=f"{info.get('tag', '?')}"
+                      f"[{info.get('chunk', '?')}/{info.get('n_chunks', '?')}]",
+                message=(
+                    f"collective exceeded deadline "
+                    f"{info.get('deadline_s', 0.0):.3f}s "
+                    f"({info.get('payload_bytes', 0)} B on {self.fabric})"
+                ),
+                rank=self._rank,
+            )
+        )
+
+    # -- attempt / step / epoch bookkeeping (loop thread) -------------------
+    def begin_attempt(self) -> None:
+        with self._cond:
+            self._expired_this_attempt = False
+
+    @property
+    def expired_this_attempt(self) -> bool:
+        with self._cond:
+            return self._expired_this_attempt
+
+    def note_step(self, degraded: bool) -> None:
+        with self._cond:
+            if degraded:
+                self._degraded_streak += 1
+                self._epoch_degraded += 1
+            else:
+                self._degraded_streak = 0
+
+    def should_escalate(self) -> bool:
+        with self._cond:
+            return self._degraded_streak >= self.escalate_after
+
+    def take_epoch(self) -> Dict[str, int]:
+        """Per-epoch counters for the fallback controller; resets them
+        (the consecutive-degraded streak is NOT reset — escalation is
+        about the fabric, not the calendar)."""
+        with self._cond:
+            out = {
+                "deadline_expiries": self._epoch_expiries,
+                "degraded_steps": self._epoch_degraded,
+            }
+            self._epoch_expiries = 0
+            self._epoch_degraded = 0
+            return out
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "CollectiveWatchdog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class CommDeadlineGuard:
+    """Deadline-expiry policy around a step: one in-place retry, then mark
+    degraded, escalate only on K consecutive degraded steps.
+
+    Sits OUTSIDE :class:`GuardedStep` — an expired collective is not an
+    exception (the step returns, late, with a VALID state), so the guard
+    inspects the watchdog's attempt flag after each call. Requires
+    ``donate_state=False`` on the underlying step, same as GuardedStep:
+    the retry re-runs on the original inputs. Attribute access delegates
+    to the wrapped step."""
+
+    def __init__(
+        self,
+        step: Callable,
+        watchdog: CollectiveWatchdog,
+        telemetry: Any = None,
+        label: str = "step",
+        rank: int = 0,
+    ):
+        self._inner = step
+        self._watchdog = watchdog
+        self._telemetry = telemetry
+        self._label = label
+        self._rank = rank
+        self._step_index = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _emit(self, kind: str, step: int, message: str) -> None:
+        if self._telemetry is None:
+            return
+        from ..observe import FailureEvent
+
+        self._telemetry.emit(
+            FailureEvent(
+                kind=kind, label=self._label, message=message,
+                rank=self._rank, step=step,
+            )
+        )
+
+    def __call__(self, state, batch):
+        wd = self._watchdog
+        i = self._step_index
+        self._step_index += 1
+        wd.begin_attempt()
+        out = self._inner(state, batch)
+        if not wd.expired_this_attempt:
+            wd.note_step(False)
+            return out
+        # a collective blew its deadline: the returned state is usable but
+        # the step is suspect — discard it and re-run once in place
+        self._emit(
+            "comm_step_retry", i,
+            "collective deadline expired; retrying step in place",
+        )
+        wd.begin_attempt()
+        out = self._inner(state, batch)
+        if not wd.expired_this_attempt:
+            wd.note_step(False)
+            return out
+        wd.note_step(True)
+        self._emit(
+            "comm_degraded", i,
+            "collective deadline expired on retry; step marked degraded",
+        )
+        if wd.should_escalate():
+            raise CommEscalationError(
+                f"{self._label}: {wd.escalate_after} consecutive degraded "
+                f"steps (collective deadlines); escalating to supervisor"
+            )
+        return out
 
 
 class PreemptionGuard:

@@ -64,3 +64,12 @@ class ExperimentConfig:
     # (resilience.chaos.ChaosPlan) for experiments running through
     # resilient_train_loop — deterministic fault injection for chaos drills
     chaos_plan: Optional[str] = None
+    # degraded-fabric survival (resilience.controller, DESIGN.md): run the
+    # closed-loop fallback controller — collective deadline watchdogs
+    # around every fenced chunk plus the epoch-boundary reducer fallback
+    # ladder. exact_cifar10 ddp only.
+    adaptive_comm: bool = False
+    # the fabric whose FABRICS_BYTES_PER_S line rate models the collective
+    # deadline budget (utils.bandwidth keys: "1GbE", "10GbE", "100GbE",
+    # "ICI(v5e)")
+    comm_fabric: str = "ICI(v5e)"

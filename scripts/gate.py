@@ -52,6 +52,10 @@ METRICS: Dict[str, str] = {
     "flagship_imgs_per_sec": "higher",
     "value": "higher",
     "mfu": "higher",
+    # wall seconds from the first injected comm fault to the first clean
+    # step (scripts/report.py recovery_latency_s) — slower healing is a
+    # resilience regression
+    "recovery_latency_s": "lower",
 }
 
 BASELINE_NAME = "GATE_BASELINE.json"
@@ -64,7 +68,9 @@ def _say(msg: str) -> None:
 def extract_metrics(doc: Dict) -> Dict[str, float]:
     """Pull the comparable scalar metrics out of a report/baseline dict."""
     out: Dict[str, float] = {}
-    for name in ("step_p50_s", "flagship_imgs_per_sec", "value"):
+    for name in (
+        "step_p50_s", "flagship_imgs_per_sec", "value", "recovery_latency_s",
+    ):
         v = doc.get(name)
         if isinstance(v, (int, float)) and v == v and v > 0:
             out[name] = float(v)

@@ -107,12 +107,17 @@ def _fmt_bytes(n: float) -> str:
 _DETECTION_KINDS = {
     "worker_exit", "worker_hang", "watchdog_timeout", "bad_batch_dropped",
     "audit_error", "stale_peer", "preempt_notice",
+    "comm_deadline", "comm_degraded",
 }
 _RECOVERY_KINDS = {
     "retry", "checkpoint_fallback", "worker_restart", "resumed",
     "resharded", "preempt_checkpoint", "degraded_restart",
     "worker_complete", "run_complete",
+    "comm_fault_cleared", "comm_step_retry",
 }
+# the comm-layer fault kinds (resilience.chaos.COMM_FAULTS) — the
+# recovery-latency clock starts at the first of these injected
+_COMM_FAULT_LABELS = {"comm_throttle", "comm_stall", "comm_flap"}
 # supervisor-observed worker deaths; their messages carry the supervisor's
 # graceful-vs-hard classification (SIGTERM honored within the grace window
 # vs SIGKILL/crash), which the timeline tallies
@@ -199,6 +204,112 @@ def render_failure_timeline(failures: List[Dict]) -> List[str]:
                 f"    -> {f.get('label', '?')}: {', '.join(span)}"
             )
     return lines
+
+
+def _event_time(e: Dict) -> Optional[float]:
+    t = e.get("t_run", e.get("ts"))
+    return t if isinstance(t, (int, float)) else None
+
+
+def render_policy_timeline(policies: List[Dict]) -> List[str]:
+    """The fallback-controller section: every ladder move ordered by time,
+    with the trigger verdict and the predicted-vs-realized bytes/step the
+    controller claimed for it."""
+    ordered = sorted(
+        policies, key=lambda p: (_event_time(p) is None, _event_time(p) or 0.0)
+    )
+    t0 = next((_event_time(p) for p in ordered if _event_time(p) is not None), None)
+    lines = ["", "policy — fallback ladder timeline",
+             "---------------------------------"]
+    for p in ordered:
+        t = _event_time(p)
+        when = f"t+{t - t0:8.3f}s" if t is not None and t0 is not None else " " * 10 + "-"
+        pred = p.get("predicted_bytes_per_step")
+        real = p.get("realized_bytes_per_step")
+        claim = ""
+        if pred is not None or real is not None:
+            claim = (
+                f"  realized {_fmt_bytes(real or 0)}/step ->"
+                f" predicted {_fmt_bytes(pred or 0)}/step"
+            )
+        lines.append(
+            f"  {when}  {p.get('action', '?'):<8} epoch {p.get('epoch', '?'):<3} "
+            f"{p.get('rung_before', '?')} -> {p.get('rung_after', '?')}{claim}"
+        )
+        if p.get("trigger"):
+            lines.append(f"      trigger: {p['trigger']}")
+    descends = sum(1 for p in ordered if p.get("action") == "descend")
+    ascends = sum(1 for p in ordered if p.get("action") == "ascend")
+    last = ordered[-1] if ordered else None
+    lines.append(
+        f"  {descends} descend(s), {ascends} ascend(s); final rung"
+        f" {last.get('rung_after', '?') if last else '?'}"
+    )
+    return lines
+
+
+def data_drop_summary(events: List[Dict]) -> Dict[str, Dict]:
+    """Per-label tally of typed data-drop events (samples an experiment
+    silently lost to shape constraints — now counted, not just noted)."""
+    out: Dict[str, Dict] = {}
+    for e in events:
+        if e.get("event") != "data_drop":
+            continue
+        slot = out.setdefault(
+            e.get("label", "?"),
+            {"events": 0, "dropped_batches": 0, "dropped_samples": 0},
+        )
+        slot["events"] += 1
+        slot["dropped_batches"] += int(e.get("dropped_batches", 0) or 0)
+        slot["dropped_samples"] += int(e.get("dropped_samples", 0) or 0)
+    return out
+
+
+def recovery_latency_s(events: List[Dict]) -> Optional[float]:
+    """Seconds from the FIRST injected comm fault to the first healthy
+    step after it — a step whose window (previous step's close, its close]
+    contains no comm_deadline/comm_degraded detection and no further comm
+    fault injection. None when no comm fault was injected or the run never
+    got healthy again (itself a finding: the gate treats missing as
+    worst-case)."""
+    injected = [
+        t for e in events
+        if e.get("event") == "failure" and e.get("kind") == "chaos_injected"
+        and e.get("label") in _COMM_FAULT_LABELS
+        and (t := _event_time(e)) is not None
+    ]
+    if not injected:
+        return None
+    t0 = min(injected)
+    bad = sorted(
+        t for e in events
+        if e.get("event") == "failure"
+        and (
+            e.get("kind") in ("comm_deadline", "comm_degraded")
+            or (
+                e.get("kind") == "chaos_injected"
+                and e.get("label") in _COMM_FAULT_LABELS
+            )
+        )
+        and (t := _event_time(e)) is not None
+    )
+    steps = sorted(
+        t for e in events
+        if e.get("event") == "step" and (t := _event_time(e)) is not None
+    )
+    import bisect
+
+    prev: Optional[float] = None
+    for st in steps:
+        if st <= t0:
+            prev = st
+            continue
+        lo = prev if prev is not None else float("-inf")
+        i = bisect.bisect_right(bad, lo)
+        if i >= len(bad) or bad[i] > st:
+            return st - t0
+        prev = st
+    return None
 
 
 def render_report(events: List[Dict], name: str = "", skipped_lines: int = 0) -> str:
@@ -337,6 +448,28 @@ def render_report(events: List[Dict], name: str = "", skipped_lines: int = 0) ->
     failures = by_kind.get("failure", [])
     if failures:
         lines.extend(render_failure_timeline(failures))
+
+    policies = by_kind.get("policy", [])
+    if policies:
+        lines.extend(render_policy_timeline(policies))
+    latency = recovery_latency_s(events)
+    if latency is not None:
+        lines.append("")
+        lines.append(
+            f"comm-fault recovery latency: {latency:.3f}s"
+            " (first injected comm fault -> first clean step)"
+        )
+
+    drops = data_drop_summary(events)
+    if drops:
+        lines.append("")
+        lines.append("data drops (typed)")
+        lines.append("------------------")
+        for label, d in sorted(drops.items()):
+            lines.append(
+                f"  {label:<18} {d['dropped_samples']} sample(s) in "
+                f"{d['dropped_batches']} batch(es) over {d['events']} event(s)"
+            )
 
     notes = by_kind.get("note", [])
     if notes:
@@ -738,6 +871,7 @@ def run_report(
 
     failures = [e for e in merged.events if e.get("event") == "failure"]
     deaths = _death_counts(failures)
+    policies = [e for e in merged.events if e.get("event") == "policy"]
     report = {
         "schema": 1,
         "run_dir": os.path.abspath(run_dir),
@@ -770,6 +904,26 @@ def run_report(
                 1 for f in failures if f.get("kind") == "worker_restart"
             ),
         },
+        "policy": {
+            "decisions": policies,
+            "descends": sum(
+                1 for p in policies if p.get("action") == "descend"
+            ),
+            "ascends": sum(1 for p in policies if p.get("action") == "ascend"),
+            "final_rung": (
+                sorted(
+                    policies,
+                    key=lambda p: (
+                        _event_time(p) is None, _event_time(p) or 0.0
+                    ),
+                )[-1].get("rung_after")
+                if policies else None
+            ),
+        },
+        "data_drops": data_drop_summary(merged.events),
+        # the gate's recovery scalar: wall seconds from the first injected
+        # comm fault to the first clean step (lower = faster heal)
+        "recovery_latency_s": recovery_latency_s(merged.events),
     }
     return text, report
 

@@ -11,6 +11,12 @@ rank) and ``scripts/gate.py`` then runs in advisory mode against the
 report, so the whole span -> merge -> trace -> MFU -> gate pipeline is
 exercised on every CI pass.
 
+A second phase reruns the toy workers with ``--comm-flap`` (a transient
+fabric flap driving a real ``resilience.controller.FallbackController``)
+into ``artifacts/toy_run_flap/`` and asserts the degraded-fabric
+round-trip in the merged report: a ``descend`` AND an ``ascend``
+PolicyEvent, and a finite comm-fault recovery latency.
+
 Usage::
 
     python scripts/run_probe.py [--out-dir artifacts/toy_run] [--steps 5]
@@ -148,6 +154,71 @@ def main(argv=None) -> int:
     sys.stderr.write(
         f"# run_probe: {args.world}-rank x {args.steps}-step run recorded at "
         f"{run_dir}; report -> {args.json_out}\n"
+    )
+
+    # --- phase 2: the degraded-fabric survival round-trip ----------------
+    # 16 steps = 4 toy pseudo-epochs: one clean (seeds the per-rung best),
+    # one flapped (descend), two clean at the compressed rung (ascend)
+    flap_dir = run_dir + "_flap"
+    flap_steps = 16
+    shutil.rmtree(flap_dir, ignore_errors=True)
+    os.makedirs(flap_dir, exist_ok=True)
+
+    def flap_argv_for_rank(rank, world_size, incarnation):
+        return [
+            sys.executable, worker,
+            "--rank", str(rank),
+            "--world", str(world_size),
+            "--steps", str(flap_steps),
+            "--state-dir", os.path.join(flap_dir, "state"),
+            "--result-dir", os.path.join(flap_dir, "results"),
+            "--step-seconds", str(args.step_seconds),
+            "--comm-flap", "4",
+        ]
+
+    flap_telemetry = telemetry_for_run(
+        event_log=os.path.join(flap_dir, SUPERVISOR_LOG), stdout=False
+    )
+    flap_result = Supervisor(
+        argv_for_rank=flap_argv_for_rank,
+        world_size=args.world,
+        config=SupervisorConfig(
+            max_restarts=1, backoff_base_s=0.05, poll_interval_s=0.05
+        ),
+        telemetry=flap_telemetry,
+        run_dir=flap_dir,
+    ).run()
+    flap_telemetry.close()
+    if not flap_result.success:
+        sys.stderr.write(f"# run_probe: FAIL: flap run failed: {flap_result}\n")
+        return 1
+
+    flap_json = os.path.join(os.path.dirname(args.json_out) or ".",
+                             "flap_report.json")
+    rc = report.main(["--run-dir", flap_dir, "--json-out", flap_json])
+    if rc != 0:
+        return rc
+    with open(flap_json) as f:
+        flap_report = json.load(f)
+    policy = flap_report.get("policy") or {}
+    latency = flap_report.get("recovery_latency_s")
+    problems = []
+    if not policy.get("descends"):
+        problems.append("no descend PolicyEvent in the flap report")
+    if not policy.get("ascends"):
+        problems.append("no ascend PolicyEvent in the flap report")
+    if not isinstance(latency, (int, float)) or not latency > 0:
+        problems.append(f"recovery_latency_s not finite-positive: {latency!r}")
+    if flap_report.get("failures", {}).get("restarts"):
+        problems.append("flap run should recover in-place, not restart")
+    if problems:
+        for prob in problems:
+            sys.stderr.write(f"# run_probe: FAIL: {prob}\n")
+        return 1
+    sys.stderr.write(
+        f"# run_probe: comm-flap round-trip ok ({policy['descends']}"
+        f" descend(s), {policy['ascends']} ascend(s), recovery"
+        f" {latency:.3f}s) at {flap_dir}; report -> {flap_json}\n"
     )
     return 0
 

@@ -29,15 +29,25 @@ from network_distributed_pytorch_tpu.parallel.trainer import (
     stateless_loss,
 )
 from network_distributed_pytorch_tpu.resilience import (
+    COMM_FAULTS,
+    FAULT_KINDS,
+    INJECTION_SITES,
     PROCESS_FAULTS,
     ChaosPlan,
     ChaosStep,
     ChaosTransientError,
+    CollectiveWatchdog,
+    CommDeadlineGuard,
+    CommEscalationError,
+    CommFaultInjector,
+    FallbackController,
     FaultSpec,
     GuardedStep,
     NonFiniteLossError,
     PreemptionGuard,
+    Rung,
     chaos_batches,
+    check_fault_registry,
     guarded_batches,
 )
 from network_distributed_pytorch_tpu.resilience.chaos import (
@@ -523,3 +533,439 @@ def test_gc_keep_last(devices, tmp_path):
 
 def test_restore_latest_empty_root(devices, tmp_path):
     assert restore_latest(str(tmp_path / "nope"), _tree(0.0)) is None
+
+
+# ---------------------------------------------------------------------------
+# degraded-fabric survival: comm-layer faults, watchdogs, fallback ladder
+# ---------------------------------------------------------------------------
+
+
+def _info(phase="launch", chunk=0, n_chunks=1, payload=4096, device=0,
+          tag="grads"):
+    return {
+        "tag": tag, "chunk": chunk, "n_chunks": n_chunks,
+        "payload_bytes": payload, "phase": phase, "device_index": device,
+    }
+
+
+def test_comm_fault_registry_bijection():
+    assert set(COMM_FAULTS) == {"comm_throttle", "comm_stall", "comm_flap"}
+    for kind in COMM_FAULTS:
+        assert kind in FAULT_KINDS
+        assert INJECTION_SITES[kind] == "comm-hook"
+        FaultSpec(kind=kind, step=0)  # accepted, not "unknown kind"
+    # every kind has a site and every site names a kind — both directions
+    check_fault_registry()
+    assert set(INJECTION_SITES) == set(FAULT_KINDS)
+
+
+def test_comm_fault_injector_throttle_lifecycle():
+    plan = ChaosPlan([
+        FaultSpec(kind="comm_throttle", step=1, payload={
+            "bytes_per_s": 1e6, "max_sleep_s": 0.04, "duration_steps": 2,
+        }),
+    ])
+    telemetry, sink = _telemetry()
+    inj = CommFaultInjector(plan, rank=0, telemetry=telemetry)
+    inj.advance(0)
+    assert not inj.throttled
+    inj.advance(1)
+    assert inj.throttled
+    assert "chaos_injected" in _kinds(sink)
+    # wrong device / retire phase: filtered, no sleep
+    import time as _t
+    t0 = _t.monotonic()
+    inj(_info(device=1))
+    inj(_info(phase="retire"))
+    assert _t.monotonic() - t0 < 0.02
+    # matching launch: sleeps min(payload/rate, max_sleep) = the clamp
+    t0 = _t.monotonic()
+    inj(_info(payload=10_000_000))
+    assert _t.monotonic() - t0 >= 0.03
+    # expires at step 1 + duration_steps
+    inj.advance(2)
+    assert inj.throttled
+    inj.advance(3)
+    assert not inj.throttled
+    assert "comm_fault_cleared" in _kinds(sink)
+
+
+def test_comm_fault_injector_stall_fires_once():
+    plan = ChaosPlan([
+        FaultSpec(kind="comm_stall", step=0, payload={
+            "stall_seconds": 0.05, "chunk": 1,
+        }),
+    ])
+    inj = CommFaultInjector(plan, rank=0)
+    inj.advance(0)
+    assert inj.stall_pending
+    import time as _t
+    t0 = _t.monotonic()
+    inj(_info(chunk=0))  # wrong chunk: no stall
+    assert _t.monotonic() - t0 < 0.02
+    t0 = _t.monotonic()
+    inj(_info(chunk=1))
+    assert _t.monotonic() - t0 >= 0.04
+    assert not inj.stall_pending  # one collective hangs, ONCE
+    t0 = _t.monotonic()
+    inj(_info(chunk=1))
+    assert _t.monotonic() - t0 < 0.02
+
+
+def test_comm_flap_defaults_to_clearing():
+    plan = ChaosPlan([FaultSpec(kind="comm_flap", step=2)])
+    inj = CommFaultInjector(plan, rank=0)
+    inj.advance(2)
+    assert inj.throttled
+    inj.advance(4)
+    assert inj.throttled
+    inj.advance(5)  # default clears_after=3
+    assert not inj.throttled
+
+
+def test_collective_watchdog_expiry_and_epoch_counters():
+    import time as _t
+
+    telemetry, sink = _telemetry()
+    with CollectiveWatchdog(
+        n_workers=8, slack=1.0, floor_s=0.05, escalate_after=2,
+        telemetry=telemetry, rank=0, label="t",
+    ) as wd:
+        # clean window: launch then retire inside the budget
+        wd.begin_attempt()
+        wd(_info(phase="launch"))
+        wd(_info(phase="retire"))
+        assert not wd.expired_this_attempt
+        wd.note_step(False)
+        # blown window: the retire never comes before the deadline
+        wd.begin_attempt()
+        wd(_info(phase="launch", chunk=2, n_chunks=4))
+        _t.sleep(0.15)
+        assert wd.expired_this_attempt
+        assert wd.fired and wd.fired[-1]["chunk"] == 2
+        # hooks from other devices never arm rank 0's timer
+        wd.begin_attempt()
+        wd(_info(phase="launch", device=3))
+        _t.sleep(0.08)
+        assert not wd.expired_this_attempt
+        # escalation streak: K consecutive degraded steps
+        wd.note_step(True)
+        assert not wd.should_escalate()
+        wd.note_step(True)
+        assert wd.should_escalate()
+        counters = wd.take_epoch()
+        assert counters == {"deadline_expiries": 1, "degraded_steps": 2}
+        # epoch counters reset; the consecutive streak survives the epoch
+        assert wd.take_epoch() == {"deadline_expiries": 0, "degraded_steps": 0}
+        assert wd.should_escalate()
+    deadline_events = [
+        r for r in sink.records if r.get("kind") == "comm_deadline"
+    ]
+    assert len(deadline_events) == 1
+    assert "grads[2/4]" in deadline_events[0]["label"]
+
+
+class _ScriptedWatchdog:
+    """CommDeadlineGuard contract double: expiry verdicts per attempt."""
+
+    escalate_after = 3
+
+    def __init__(self, verdicts):
+        self._verdicts = list(verdicts)
+        self._current = False
+        self.noted = []
+
+    def begin_attempt(self):
+        self._current = self._verdicts.pop(0) if self._verdicts else False
+
+    @property
+    def expired_this_attempt(self):
+        return self._current
+
+    def note_step(self, degraded):
+        self.noted.append(degraded)
+
+    def should_escalate(self):
+        return self.noted[-3:] == [True, True, True]
+
+
+def test_comm_deadline_guard_retry_then_degrade():
+    calls = []
+
+    class Step:
+        bits_per_step = 64
+
+        def __call__(self, state, batch):
+            calls.append(state)
+            return state + 1, 0.5
+
+    telemetry, sink = _telemetry()
+    wd = _ScriptedWatchdog([False, True, False, True, True])
+    guard = CommDeadlineGuard(Step(), wd, telemetry=telemetry, label="t")
+    assert guard.bits_per_step == 64  # delegation
+    # attempt 1 clean: one call, not degraded
+    state, _ = guard(0, None)
+    assert state == 1 and calls == [0]
+    # attempt expired -> retried IN PLACE on the same inputs -> clean
+    state, _ = guard(state, None)
+    assert state == 2 and calls == [0, 1, 1]
+    kinds = _kinds(sink)
+    assert kinds.count("comm_step_retry") == 1
+    assert "comm_degraded" not in kinds
+    # expired twice: the (late but valid) state is kept, step marked degraded
+    state, _ = guard(state, None)
+    assert state == 3
+    assert "comm_degraded" in _kinds(sink)
+    assert wd.noted == [False, False, True]
+
+
+def test_comm_deadline_guard_escalates_past_runtime_error_handlers():
+    class Step:
+        def __call__(self, state, batch):
+            return state + 1, 0.5
+
+    wd = _ScriptedWatchdog([True, True] * 6)  # every attempt expires
+    guard = CommDeadlineGuard(Step(), wd)
+    guard(0, None)
+    guard(0, None)
+    with pytest.raises(CommEscalationError):
+        guard(0, None)
+    # an escalation must pass through GuardedStep/retry_transient, which
+    # catch RuntimeError — so it must not BE one
+    assert not issubclass(CommEscalationError, RuntimeError)
+
+
+def test_fence_hooks_preserve_bits_and_see_every_chunk(devices):
+    from jax.sharding import PartitionSpec as P
+
+    from network_distributed_pytorch_tpu.parallel import DATA_AXIS
+    from network_distributed_pytorch_tpu.parallel import comm
+    from network_distributed_pytorch_tpu.parallel.comm import (
+        chunked_all_reduce_mean,
+    )
+
+    mesh = make_mesh()
+    flat = jax.random.normal(jax.random.PRNGKey(0), (8, 531))
+
+    def run(k):
+        def body(xs):
+            return chunked_all_reduce_mean(xs[0], DATA_AXIS, k, tag="t")[None]
+
+        return jax.jit(
+            jax.shard_map(
+                body, mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS)
+            )
+        )(flat)
+
+    baseline = np.asarray(run(3))
+    seen = []
+    comm.add_fence_hook(seen.append)
+    try:
+        assert comm.fence_hooks_active()
+        hooked = np.asarray(run(3))
+    finally:
+        comm.remove_fence_hook(seen.append)
+    assert not comm.fence_hooks_active()
+    # the callback is outside the math: bitwise identical results
+    np.testing.assert_array_equal(
+        baseline.view(np.uint32), hooked.view(np.uint32)
+    )
+    mine = [i for i in seen if i["device_index"] == 0]
+    launches = [i for i in mine if i["phase"] == "launch"]
+    retires = [i for i in mine if i["phase"] == "retire"]
+    # 3 chunk launches + the final retire, once per logical collective
+    assert [i["chunk"] for i in launches] == [0, 1, 2]
+    assert len(retires) == 1
+    itemsize = np.dtype(np.float32).itemsize
+    assert sum(i["payload_bytes"] for i in launches) == 531 * itemsize
+    assert retires[0]["payload_bytes"] == 531 * itemsize
+    assert all(i["tag"] == "t" and i["n_chunks"] == 3 for i in launches)
+
+
+# -- the e2e matrix: fault -> watchdog/controller -> documented recovery ----
+
+
+def _adaptive_setup():
+    model = SmallCNN(width=4)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, *IMG)))["params"]
+
+    def lf(p, b):
+        x, y = b
+        return cross_entropy_loss(model.apply({"params": p}, x), y)
+
+    mesh = make_mesh()
+
+    def step_factory(overrides):
+        if overrides.get("reducer") == "powersgd":
+            reducer = PowerSGDReducer(
+                random_seed=7,
+                compression_rank=overrides.get("reducer_rank", 2),
+                matricize="last",
+                comm_chunks=overrides.get("comm_chunks"),
+                comm_strategy=overrides.get("comm_strategy", "interleave"),
+            )
+        else:
+            from network_distributed_pytorch_tpu.parallel import ExactReducer
+
+            reducer = ExactReducer(
+                comm_chunks=overrides.get("comm_chunks"),
+                comm_strategy=overrides.get("comm_strategy", "interleave"),
+            )
+        return make_train_step(
+            stateless_loss(lf), reducer, params, learning_rate=0.05,
+            momentum=0.9, algorithm="ef_momentum", mesh=mesh,
+            donate_state=False,
+        )
+
+    return step_factory, params
+
+
+def _policy_records(sink):
+    return [r for r in sink.records if r.get("event") == "policy"]
+
+
+def _step_losses(sink):
+    return [r["loss"] for r in sink.records if r.get("event") == "step"]
+
+
+def _bits_deltas(sink):
+    bits = [
+        r["bits_cumulative"] for r in sink.records
+        if r.get("event") == "step" and "bits_cumulative" in r
+    ]
+    return [b - a for a, b in zip(bits, bits[1:])]
+
+
+@pytest.mark.slow
+def test_comm_throttle_walks_ladder_down_and_back(devices):
+    """The tentpole e2e: a mid-run throttle degrades achieved bandwidth ->
+    the controller descends to the compressed rung (reducer actually
+    switched, wire bytes/step measurably reduced per the ledger) with a
+    typed PolicyEvent; the fault clears -> the ladder walks back up; the
+    loss stays finite and nothing restarts."""
+    from network_distributed_pytorch_tpu.experiments.common import (
+        adaptive_train_loop,
+    )
+
+    step_factory, params = _adaptive_setup()
+    telemetry, sink = _telemetry()
+    plan = ChaosPlan([
+        FaultSpec(kind="comm_throttle", step=6, payload={
+            "bytes_per_s": 2e4, "max_sleep_s": 0.15, "duration_steps": 6,
+        }),
+    ])
+    injector = CommFaultInjector(plan, rank=0, telemetry=telemetry)
+    controller = FallbackController(
+        ladder=[
+            Rung("exact", {}),
+            Rung("powersgd", {"reducer": "powersgd", "reducer_rank": 2}),
+        ],
+        descend_after=1, recover_after=2, telemetry=telemetry,
+    )
+    state, logger, controller = adaptive_train_loop(
+        step_factory, params, None, _batches, 10, controller,
+        injector=injector, telemetry=telemetry,
+        # the throttle's per-chunk sleep (0.15s) must degrade bandwidth
+        # WITHOUT tripping the deadline watchdog — that's the stall test
+        deadline_floor_s=0.5,
+    )
+    policies = _policy_records(sink)
+    descents = [p for p in policies if p["action"] == "descend"]
+    ascents = [p for p in policies if p["action"] == "ascend"]
+    assert descents and ascents
+    assert descents[0]["rung_after"] == "powersgd"
+    assert "achieved_bytes_per_s" in descents[0]["trigger"]
+    # the descent's byte claim: the compressed rung sheds real ledger bytes
+    assert (
+        descents[0]["predicted_bytes_per_step"]
+        < descents[0]["realized_bytes_per_step"]
+    )
+    # ...and the ledger the logger charged agrees: compressed steps cost
+    # measurably fewer wire bits than exact steps
+    deltas = set(_bits_deltas(sink))
+    assert len(deltas) == 2 and min(deltas) < max(deltas)
+    kinds = _kinds(sink)
+    assert "chaos_injected" in kinds
+    assert "comm_fault_cleared" in kinds
+    assert "worker_restart" not in kinds  # recovery happened in-place
+    assert controller.index == 0  # recovered all the way back to exact
+    losses = _step_losses(sink)
+    assert losses and np.isfinite(losses).all()
+    assert all(
+        np.isfinite(np.asarray(l)).all()
+        for l in jax.tree_util.tree_leaves(state.params)
+    )
+
+
+@pytest.mark.slow
+def test_comm_flap_recovers_in_place_without_escalation(devices):
+    """A transient flap throttles a few steps then self-clears; the run
+    absorbs it with no deadline expiry, no escalation, no restart — the
+    flap lifecycle is visible as injected -> cleared telemetry."""
+    from network_distributed_pytorch_tpu.experiments.common import (
+        adaptive_train_loop,
+    )
+
+    step_factory, params = _adaptive_setup()
+    telemetry, sink = _telemetry()
+    plan = ChaosPlan([
+        FaultSpec(kind="comm_flap", step=4, payload={
+            "bytes_per_s": 2e4, "max_sleep_s": 0.1, "clears_after": 3,
+        }),
+    ])
+    injector = CommFaultInjector(plan, rank=0, telemetry=telemetry)
+    controller = FallbackController(
+        ladder=[Rung("exact", {})], telemetry=telemetry,
+    )
+    state, logger, _ = adaptive_train_loop(
+        step_factory, params, None, _batches, 4, controller,
+        injector=injector, telemetry=telemetry, deadline_floor_s=0.5,
+    )
+    kinds = _kinds(sink)
+    assert "chaos_injected" in kinds
+    assert "comm_fault_cleared" in kinds
+    assert "comm_deadline" not in kinds  # under the deadline floor
+    assert "worker_restart" not in kinds
+    losses = _step_losses(sink)
+    assert len(losses) == 12  # every step of every epoch completed
+    assert np.isfinite(losses).all()
+
+
+@pytest.mark.slow
+def test_comm_stall_trips_deadline_step_retried_ledger_unchanged(devices):
+    """One collective hangs past its deadline: the watchdog fires
+    ``comm_deadline``, the guard retries the step in place (the stall is
+    once-only, so the retry is clean), no escalation — and the wire ledger
+    is bit-identical to a clean run's, because injection lives in a host
+    callback, not in the graph."""
+    from network_distributed_pytorch_tpu.experiments.common import (
+        adaptive_train_loop,
+    )
+
+    step_factory, params = _adaptive_setup()
+    telemetry, sink = _telemetry()
+    plan = ChaosPlan([
+        FaultSpec(kind="comm_stall", step=4, payload={
+            "stall_seconds": 1.0, "chunk": 0,
+        }),
+    ])
+    injector = CommFaultInjector(plan, rank=0, telemetry=telemetry)
+    # single-rung ladder: the stalled epoch may NOT descend anywhere, so
+    # every step must charge the exact reducer's ledger
+    controller = FallbackController(
+        ladder=[Rung("exact", {})], telemetry=telemetry,
+    )
+    state, logger, _ = adaptive_train_loop(
+        step_factory, params, None, _batches, 3, controller,
+        injector=injector, telemetry=telemetry,
+        deadline_floor_s=0.2, deadline_slack=1.0, escalate_after=3,
+    )
+    kinds = _kinds(sink)
+    assert "comm_deadline" in kinds
+    assert "comm_step_retry" in kinds
+    # the once-only stall clears on the retry: degraded never accumulates
+    assert not any(k == "comm_degraded" for k in kinds)
+    losses = _step_losses(sink)
+    assert len(losses) == 9 and np.isfinite(losses).all()
+    # ledger invariance: every step charged the same exact-reducer bits
+    assert len(set(_bits_deltas(sink))) == 1

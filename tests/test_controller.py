@@ -1,0 +1,195 @@
+"""Fallback-controller and deadline-derivation units (jax-free, fast).
+
+The degraded-fabric policy layer (``resilience.controller``) is pure
+host-side bookkeeping, so every behavior the e2e chaos tests rely on is
+pinned here without a backend: the ladder's documented order, the
+descend/ascend hysteresis (consecutive evidence; the indeterminate middle
+band resets both streaks), the bandwidth-collapse trigger relative to the
+per-rung learned best, PolicyEvent emission, and the collective-deadline
+budget (modeled time vs measured p50 vs the floor).
+"""
+
+import pytest
+
+from network_distributed_pytorch_tpu.observe import MemorySink, Telemetry
+from network_distributed_pytorch_tpu.resilience import (
+    DEFAULT_LADDER,
+    EpochHealth,
+    FallbackController,
+    Rung,
+    derive_collective_deadline,
+)
+
+
+def _health(epoch=0, achieved=0.0, expiries=0, degraded=0, stragglers=0):
+    return EpochHealth(
+        epoch=epoch, step_p50_s=0.01, achieved_bytes_per_s=achieved,
+        deadline_expiries=expiries, degraded_steps=degraded,
+        stragglers=stragglers,
+    )
+
+
+# ---- ladder shape ----------------------------------------------------------
+
+
+def test_default_ladder_documented_order():
+    names = [r.name for r in DEFAULT_LADDER]
+    assert names == [
+        "baseline", "chunked", "ring", "compress", "compress-low-rank",
+        "localsgd",
+    ]
+    # baseline overrides nothing; each compression rung names the reducer;
+    # only the last rung widens the sync period
+    assert DEFAULT_LADDER[0].overrides == {}
+    assert DEFAULT_LADDER[2].overrides["comm_strategy"] == "ring"
+    for rung in DEFAULT_LADDER[3:]:
+        assert rung.overrides["reducer"] == "powersgd"
+    assert DEFAULT_LADDER[4].overrides["reducer_rank"] < (
+        DEFAULT_LADDER[3].overrides["reducer_rank"]
+    )
+    assert "sync_every" not in DEFAULT_LADDER[4].overrides
+    assert DEFAULT_LADDER[5].overrides["sync_every"] > 1
+
+
+def test_ladder_validation():
+    with pytest.raises(ValueError, match="at least one rung"):
+        FallbackController(ladder=[])
+    with pytest.raises(ValueError, match="outside ladder"):
+        FallbackController(start_index=len(DEFAULT_LADDER))
+
+
+# ---- descend / ascend walking ----------------------------------------------
+
+
+def test_descends_in_order_and_stops_at_bottom():
+    c = FallbackController(descend_after=1)
+    seen = []
+    for epoch in range(len(DEFAULT_LADDER) + 2):
+        d = c.observe(_health(epoch=epoch, expiries=1))
+        if d is not None:
+            assert d.action == "descend"
+            assert d.rung_index_after == d.rung_index_before + 1
+            assert d.overrides == DEFAULT_LADDER[d.rung_index_after].overrides
+            seen.append((d.rung_before, d.rung_after))
+    # walked every edge exactly once, then held at the bottom rung
+    assert seen == [
+        (a.name, b.name) for a, b in zip(DEFAULT_LADDER, DEFAULT_LADDER[1:])
+    ]
+    assert c.rung.name == "localsgd"
+
+
+def test_descend_requires_consecutive_degraded_epochs():
+    c = FallbackController(descend_after=2)
+    assert c.observe(_health(epoch=0, degraded=1)) is None
+    # an indeterminate epoch (no faults, no bandwidth evidence) resets the
+    # streak — a move needs CONSECUTIVE evidence
+    assert c.observe(_health(epoch=1)) is None
+    assert c.observe(_health(epoch=2, degraded=1)) is None
+    d = c.observe(_health(epoch=3, degraded=1))
+    assert d is not None and d.action == "descend"
+    assert "degraded_steps" in d.trigger
+
+
+def test_ascend_requires_consecutive_healthy_epochs():
+    c = FallbackController(start_index=1, recover_after=2)
+    # first healthy epoch seeds the rung's best and starts the streak
+    assert c.observe(_health(epoch=0, achieved=100.0)) is None
+    # indeterminate (achieved in the middle band) resets the streak
+    assert c.observe(_health(epoch=1, achieved=60.0)) is None
+    assert c.observe(_health(epoch=2, achieved=100.0)) is None
+    d = c.observe(_health(epoch=3, achieved=95.0))
+    assert d is not None and d.action == "ascend"
+    assert d.rung_index_after == 0
+    assert "recovered" in d.trigger
+    # at the top rung, healthy epochs never ascend past the ladder
+    c2 = FallbackController(recover_after=1)
+    assert c2.observe(_health(epoch=0, achieved=10.0)) is None
+    assert c2.observe(_health(epoch=1, achieved=10.0)) is None
+    assert c2.index == 0
+
+
+def test_bandwidth_collapse_is_a_degraded_trigger():
+    c = FallbackController(descend_after=1, degrade_factor=0.5)
+    assert c.observe(_health(epoch=0, achieved=100.0)) is None  # seeds best
+    d = c.observe(_health(epoch=1, achieved=40.0))  # < 0.5 x best
+    assert d is not None and d.action == "descend"
+    assert "achieved_bytes_per_s" in d.trigger
+    # per-rung best: the NEW rung has no history, so the same 40 B/s is
+    # indeterminate there (seeds that rung's best instead of triggering)
+    assert c.observe(_health(epoch=2, achieved=40.0)) is None
+    assert c.index == 1
+
+
+def test_every_fault_counter_triggers_degraded():
+    for kw in ({"expiries": 1}, {"degraded": 2}, {"stragglers": 3}):
+        c = FallbackController(descend_after=1)
+        d = c.observe(_health(**kw))
+        assert d is not None and d.action == "descend", kw
+
+
+# ---- PolicyEvent emission --------------------------------------------------
+
+
+def test_record_emits_policy_event_with_byte_claims():
+    sink = MemorySink()
+    c = FallbackController(
+        descend_after=1, telemetry=Telemetry([sink]), rank=3
+    )
+    d = c.observe(_health(epoch=5, expiries=2))
+    c.record(d, predicted_bytes_per_step=1348.0, realized_bytes_per_step=4428.0)
+    events = [r for r in sink.records if r.get("event") == "policy"]
+    assert len(events) == 1
+    (e,) = events
+    assert e["action"] == "descend"
+    assert e["epoch"] == 5
+    assert e["rung_before"] == "baseline" and e["rung_after"] == "chunked"
+    assert e["overrides"] == {"comm_chunks": 4}
+    assert e["predicted_bytes_per_step"] == 1348.0
+    assert e["realized_bytes_per_step"] == 4428.0
+    assert e["rank"] == 3
+    assert "deadline_expiries" in e["trigger"]
+    assert c.decisions == [d]
+
+
+def test_custom_ladder_and_overrides_copying():
+    ladder = [Rung("a", {}), Rung("b", {"comm_chunks": 2})]
+    c = FallbackController(ladder=ladder, descend_after=1)
+    d = c.observe(_health(expiries=1))
+    d.overrides["comm_chunks"] = 999  # mutating the decision's copy...
+    assert c.overrides == {"comm_chunks": 2}  # ...never reaches the rung
+
+
+# ---- collective-deadline derivation ----------------------------------------
+
+
+def test_deadline_floor_dominates_tiny_payloads():
+    # a few bytes on ICI models out at microseconds; the floor holds
+    assert derive_collective_deadline(16, 8, "ICI(v5e)", floor_s=0.25) == 0.25
+
+
+def test_deadline_measured_p50_dominates_optimistic_model():
+    # the model says microseconds; the fabric measurably delivers 100ms —
+    # the deadline follows the measurement times the slack
+    budget = derive_collective_deadline(
+        16, 8, "ICI(v5e)", measured_p50_s=0.1, slack=4.0, floor_s=0.05
+    )
+    assert budget == pytest.approx(0.4)
+
+
+def test_deadline_model_scales_with_payload_and_fabric():
+    from network_distributed_pytorch_tpu.observe.analytics import (
+        _load_utils_module,
+    )
+
+    bw = _load_utils_module("bandwidth")
+    payload = 100 * (1 << 20)  # 100 MB on 1GbE: seconds, far above floor
+    budget = derive_collective_deadline(
+        payload, 8, "1GbE", slack=2.0, floor_s=0.05
+    )
+    assert budget == pytest.approx(
+        bw.allreduce_time_s(payload, 8, "1GbE") * 2.0
+    )
+    # a faster fabric derives a tighter deadline for the same payload
+    assert budget > derive_collective_deadline(
+        payload, 8, "100GbE", slack=2.0, floor_s=0.05
+    )

@@ -47,6 +47,7 @@ from network_distributed_pytorch_tpu.resilience.chaos import (  # noqa: E402
 from network_distributed_pytorch_tpu.observe import (  # noqa: E402
     CollectiveEvent,
     CompileEvent,
+    FailureEvent,
     StepEvent,
     recording,
     span,
@@ -69,6 +70,15 @@ TOY_PAYLOAD_BYTES = 1 << 20
 TOY_FLOPS_PER_STEP = 2.0e9
 TOY_PEAK_FLOPS = 1e12
 TOY_DEVICE_KIND = "toy-sim"
+# --comm-flap: the simulated fabric flap lasts this many steps (each
+# sleeping FLAP_SLOWDOWN x the nominal step), and the real
+# FallbackController is fed one EpochHealth per EPOCH_LEN steps — small
+# enough that a 16-step probe sees the full descend -> ascend cycle
+FLAP_LEN = 4
+FLAP_SLOWDOWN = 5.0
+EPOCH_LEN = 4
+# the toy compressed rung's ledger: rank-1 toy compression of the payload
+TOY_COMPRESSED_BYTES = TOY_PAYLOAD_BYTES // 8
 
 
 def _load_state(path):
@@ -110,6 +120,13 @@ def main() -> int:
     p.add_argument("--step-seconds", type=float, default=0.01)
     p.add_argument("--graceful-term", action="store_true")
     p.add_argument("--event-log", default=None)
+    p.add_argument(
+        "--comm-flap", type=int, default=None, metavar="STEP",
+        help="simulate a transient fabric flap starting at this step"
+             " (FLAP_LEN steps at FLAP_SLOWDOWN x step time) and drive a"
+             " real FallbackController from measured pseudo-epoch health —"
+             " the comm-layer PolicyEvent round-trip, jax-free",
+    )
     args = p.parse_args()
 
     incarnation = incarnation_from_env()
@@ -160,6 +177,33 @@ def main() -> int:
             )
         )
 
+    flap = args.comm_flap
+    controller = None
+    if flap is not None:
+        from network_distributed_pytorch_tpu.resilience.controller import (
+            EpochHealth,
+            FallbackController,
+            Rung,
+        )
+
+        # two toy rungs are enough for the round-trip; recover_factor is
+        # loose (0.6) so checkpoint-save jitter on a loaded CI box cannot
+        # turn a genuinely healthy pseudo-epoch indeterminate
+        controller = FallbackController(
+            ladder=[
+                Rung("baseline", {}),
+                Rung("compress", {"reducer": "powersgd", "reducer_rank": 1}),
+            ],
+            descend_after=1, recover_after=2, recover_factor=0.6,
+            telemetry=telemetry, rank=args.rank,
+        )
+        epoch_times = []
+        epoch_degraded = 0
+        pseudo_epoch = 0
+
+    def _rung_bytes(index):
+        return TOY_PAYLOAD_BYTES if index == 0 else TOY_COMPRESSED_BYTES
+
     if args.graceful_term:
         # the PreemptionGuard contract, toy-sized: SIGTERM -> persist the
         # current state, exit with the sentinel the supervisor classifies
@@ -185,23 +229,83 @@ def main() -> int:
                     time.sleep(float(spec.payload.get("hang_seconds", 3600.0)))
                 if spec.kind == "proc_preempt":
                     os.kill(os.getpid(), signal.SIGTERM)
+            in_flap = flap is not None and flap <= i < flap + FLAP_LEN
+            if flap is not None and telemetry is not None:
+                if i == flap:
+                    telemetry.emit(
+                        FailureEvent(
+                            kind="chaos_injected", label="comm_flap",
+                            message=f"toy fabric flap: {FLAP_LEN} steps at"
+                                    f" {FLAP_SLOWDOWN:g}x step time",
+                            rank=args.rank, step=i, incarnation=incarnation,
+                        )
+                    )
+                elif i == flap + FLAP_LEN:
+                    telemetry.emit(
+                        FailureEvent(
+                            kind="comm_fault_cleared", label="comm_flap",
+                            rank=args.rank, step=i, incarnation=incarnation,
+                        )
+                    )
             t0 = time.monotonic()
             # nested spans, toy-sized like the real loop's: the trace export
             # e2e asserts this parent/child structure survives the merge
             with span("step", step=i, rank=args.rank):
                 with span("step/compute", step=i, rank=args.rank):
-                    time.sleep(args.step_seconds)
+                    time.sleep(
+                        args.step_seconds * (FLAP_SLOWDOWN if in_flap else 1.0)
+                    )
                 state = {"step": i + 1, "value": state["value"] + args.world}
                 with span("checkpoint/save", step=i, rank=args.rank):
                     _save_state(state_path, state)
+            step_time = time.monotonic() - t0
+            if in_flap and telemetry is not None:
+                # the detection the real loop's watchdog would emit —
+                # BEFORE the StepEvent, so the step's window contains it
+                # and the report's recovery-latency clock keeps running
+                telemetry.emit(
+                    FailureEvent(
+                        kind="comm_degraded", label="comm_flap",
+                        rank=args.rank, step=i, incarnation=incarnation,
+                    )
+                )
             if telemetry is not None:
                 telemetry.emit(
                     StepEvent(
                         step=i, epoch=0, loss=1.0 / (i + 1),
-                        step_time_s=time.monotonic() - t0,
+                        step_time_s=step_time,
                         bits_cumulative=8 * TOY_PAYLOAD_BYTES * (i + 1),
                     )
                 )
+            if controller is not None:
+                epoch_times.append(step_time)
+                if in_flap:
+                    epoch_degraded += 1
+                if len(epoch_times) == EPOCH_LEN:
+                    p50 = sorted(epoch_times)[len(epoch_times) // 2]
+                    bytes_per_step = _rung_bytes(controller.index)
+                    decision = controller.observe(
+                        EpochHealth(
+                            epoch=pseudo_epoch, step_p50_s=p50,
+                            achieved_bytes_per_s=(
+                                bytes_per_step / p50 if p50 > 0 else 0.0
+                            ),
+                            degraded_steps=epoch_degraded,
+                        )
+                    )
+                    if decision is not None:
+                        controller.record(
+                            decision,
+                            predicted_bytes_per_step=_rung_bytes(
+                                decision.rung_index_after
+                            ),
+                            realized_bytes_per_step=_rung_bytes(
+                                decision.rung_index_before
+                            ),
+                        )
+                    epoch_times = []
+                    epoch_degraded = 0
+                    pseudo_epoch += 1
 
     if telemetry is not None:
         telemetry.close()
