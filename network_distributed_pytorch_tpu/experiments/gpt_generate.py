@@ -16,7 +16,7 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from ..models.gpt import generate, gpt_prefill, gpt_small, gpt_tiny
+from ..models.gpt import decode_tokens, generate, gpt_prefill, gpt_small, gpt_tiny
 from ..utils.config import ExperimentConfig
 
 
@@ -62,20 +62,38 @@ def run(
     # generation (utils.timing)
     dt = time_amortized(lambda: gen(params, prompt, key))
 
-    # separate the prefill cost so the per-token decode latency is honest
-    # (generate() = one prefill forward + the decode scan; for short decode
-    # lengths the prefill dominates end-to-end time)
+    # time prefill and the decode scan as SEPARATE jitted calls, not by
+    # subtracting prefill from the end-to-end time (the old estimate went
+    # negative — "decode_unreliable" — whenever dispatch jitter exceeded a
+    # short decode's real cost). models.gpt.decode_tokens is generate()'s
+    # own scan, exposed for exactly this measurement.
     prefill = jax.jit(
         lambda p, ids: gpt_prefill(
             model.config, p, ids, prompt_len + max_new_tokens
-        )[0]
+        )
     )
-    wait_result(prefill(params, prompt))  # compile + warmup
-    prefill_s = time_amortized(lambda: prefill(params, prompt))
-    # prefill is timed separately, so dispatch jitter can push it past the
-    # end-to-end time; report null rather than an absurd ~0 decode latency
-    decode_s = dt - prefill_s
-    decode_unreliable = decode_s <= 0.0
+    last_logits, cache = prefill(params, prompt)
+    wait_result((last_logits, cache))  # compile + warmup
+    prefill_s = time_amortized(lambda: prefill(params, prompt)[0])
+
+    n_decode = max_new_tokens - 1  # generate(): prefill emits token 1
+    first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+    if n_decode > 0:
+        decode = jax.jit(
+            lambda p, c, f, k: decode_tokens(
+                model.config, p, c, f, prompt_len, n_decode,
+                temperature=temperature, key=k,
+            )
+        )
+        dkey = jax.random.PRNGKey(config.seed + 3)
+        wait_result(decode(params, cache, first, dkey))  # compile + warmup
+        decode_s = time_amortized(lambda: decode(params, cache, first, dkey))
+        decode_ms_per_token = 1000.0 * decode_s / n_decode
+        decode_unreliable = False
+    else:
+        # a 1-token generation has no decode scan to time
+        decode_ms_per_token = None
+        decode_unreliable = True
     return {
         "experiment": "gpt_generate",
         "preset": preset,
@@ -85,9 +103,7 @@ def run(
         "temperature": temperature,
         "generate_tokens_per_sec": batch * max_new_tokens / dt,  # end-to-end
         "prefill_ms": 1000.0 * prefill_s,
-        "decode_ms_per_token": (
-            None if decode_unreliable else 1000.0 * decode_s / max_new_tokens
-        ),
+        "decode_ms_per_token": decode_ms_per_token,
         "decode_time_unreliable": decode_unreliable,
         "sample_head": [int(t) for t in out[0, :8]],
         "device": getattr(

@@ -32,6 +32,7 @@ from .experiments import (
     imdb_baseline,
     powersgd_cifar10,
     powersgd_imdb,
+    serve_gpt,
 )
 from .observe import RawEvent, StreamJsonSink, Telemetry
 from .parallel.mesh import DistributedConfig, initialize_distributed
@@ -51,6 +52,7 @@ EXPERIMENTS = {
     "gpt_tp": gpt_tp.run,
     "gpt_moe": gpt_moe.run,
     "gpt_generate": gpt_generate.run,
+    "serve_gpt": serve_gpt.run,
 }
 
 
@@ -169,15 +171,40 @@ def build_parser() -> argparse.ArgumentParser:
         help="gpt_pp/gpt_sp: save the carry per epoch and resume the newest;"
              " exact_cifar10 (ddp): run through resilient_train_loop —"
              " committed per-epoch checkpoints, verified resume, and the"
-             " --chaos-plan injection point",
+             " --chaos-plan injection point; serve_gpt: hot-load model"
+             " params from the newest committed training checkpoint",
     )
     p.add_argument(
         "--max-new-tokens", type=int, default=64,
-        help="gpt_generate only: decode length",
+        help="gpt_generate: decode length; serve_gpt: per-request decode"
+             " budget cap (uniform in [2, this])",
     )
     p.add_argument(
         "--temperature", type=float, default=0.0,
         help="gpt_generate only: 0 = greedy",
+    )
+    # --- serve_gpt (serving/ continuous-batching engine) ------------------
+    p.add_argument(
+        "--slots", type=int, default=None,
+        help="serve_gpt only: static batch slots of the continuous-batching"
+             " engine (default 4)",
+    )
+    p.add_argument(
+        "--requests", type=int, default=None,
+        help="serve_gpt only: simulated requests in the Poisson workload"
+             " (default 16)",
+    )
+    p.add_argument(
+        "--request-rate", type=float, default=None,
+        help="serve_gpt only: Poisson arrival rate in requests/s"
+             " (default 64)",
+    )
+    p.add_argument(
+        "--spool-dir", type=str, default=None,
+        help="serve_gpt only: shared file-spool request queue — the elastic"
+             " fleet mode; combine with --supervise --num-processes N for"
+             " mid-decode fail-over (dead ranks' in-flight requests are"
+             " re-queued on the survivors)",
     )
     p.add_argument("--json", action="store_true", help="print the summary as JSON")
     p.add_argument(
@@ -476,10 +503,25 @@ def main(argv=None) -> dict:
             f"--scan-layers is not supported by {args.experiment!r}"
             " (supported: gpt_lm)"
         )
+    for flag, val in (
+        ("--slots", args.slots), ("--requests", args.requests),
+        ("--request-rate", args.request_rate),
+        ("--spool-dir", args.spool_dir),
+    ):
+        if val is not None and args.experiment != "serve_gpt":
+            raise ValueError(
+                f"{flag} is not supported by {args.experiment!r}"
+                " (supported: serve_gpt)"
+            )
 
     # multi-host rendezvous before any experiment touches devices
-    # (the reference's setup() does the same before run_task())
-    if args.num_processes > 1 and args.experiment != "bare_init":
+    # (the reference's setup() does the same before run_task()).
+    # serve_gpt ranks share only the file spool — no collectives, and a
+    # rendezvous would couple the fleet's fate to its slowest/dead rank,
+    # exactly what the elastic spool exists to avoid
+    if args.num_processes > 1 and args.experiment not in (
+        "bare_init", "serve_gpt"
+    ):
         initialize_distributed(
             DistributedConfig(
                 process_id=cfg.process_id,
@@ -514,6 +556,16 @@ def main(argv=None) -> dict:
     elif args.experiment == "gpt_generate":
         kwargs.update(preset=args.preset, max_new_tokens=args.max_new_tokens,
                       temperature=args.temperature)
+    elif args.experiment == "serve_gpt":
+        kwargs.update(preset=args.preset,
+                      slots=args.slots if args.slots is not None else 4,
+                      requests=args.requests
+                      if args.requests is not None else 16,
+                      request_rate=args.request_rate
+                      if args.request_rate is not None else 64.0,
+                      max_new_tokens=args.max_new_tokens,
+                      checkpoint_dir=args.checkpoint_dir,
+                      spool_dir=args.spool_dir)
     elif args.experiment == "bandwidth_study":
         kwargs.update(preset=args.preset)
     elif args.experiment in ("gpt_lm", "gpt_pp", "gpt_sp", "gpt_tp", "gpt_moe"):

@@ -750,6 +750,67 @@ def gpt_decode_step(config: GPTConfig, params, cache, tokens, pos):
     return logits.astype(jnp.float32), cache
 
 
+def gpt_decode_step_slots(config: GPTConfig, params, cache, tokens, pos):
+    """One decode step with a PER-ROW position vector: row ``b`` feeds
+    ``tokens[b]`` at ``pos[b]`` (both (B,)) and attends to its own cache
+    prefix ``<= pos[b]``. This is the continuous-batching primitive behind
+    ``serving.engine``: slot-batched requests at DIFFERENT decode depths
+    share one compiled step — static shapes, with each row's validity mask
+    doing its own truncation (Orca-style iteration-level batching). Row
+    math is identical to :func:`gpt_decode_step` at the same position
+    (pinned by ``tests/test_serving.py``); the scalar-``pos`` function is
+    kept separate so its compiled program (and the goldens riding on
+    ``generate``) stay byte-stable."""
+    cfg = config
+    head_dim = cfg.dim // cfg.n_heads
+    max_len = cache[0]["k"].shape[1]
+
+    apply_dense = lambda p, h: _apply_dense(cfg, p, h)
+    apply_ln = lambda p, h: _apply_ln(cfg, p, h)
+    # per-row single-position write at that row's own depth
+    row_update = jax.vmap(
+        lambda buf, row, p: jax.lax.dynamic_update_slice_in_dim(
+            buf, row[None], p, axis=0
+        )
+    )
+
+    x = params["wte"]["embedding"][tokens].astype(cfg.dtype)  # (B, dim)
+    x = x + params["wpe"]["embedding"][pos].astype(cfg.dtype)
+
+    cache = list(cache)
+    for i in range(cfg.n_layers):
+        bp = params[f"h_{i}"]
+        h = apply_ln(bp["ln_1"], x)
+        q = apply_dense(bp["attn"]["q_proj"], h).reshape(-1, cfg.n_heads, head_dim)
+        k = apply_dense(bp["attn"]["k_proj"], h).reshape(-1, cfg.n_heads, head_dim)
+        v = apply_dense(bp["attn"]["v_proj"], h).reshape(-1, cfg.n_heads, head_dim)
+        cache[i] = {
+            "k": row_update(cache[i]["k"], k, pos),
+            "v": row_update(cache[i]["v"], v, pos),
+        }
+        scores = jnp.einsum(
+            "bhd,bthd->bht", q.astype(jnp.float32),
+            cache[i]["k"].astype(jnp.float32),
+        ) / jnp.sqrt(head_dim)
+        valid = jnp.arange(max_len)[None, :] <= pos[:, None]
+        scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
+        weights = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum(
+            "bht,bthd->bhd", weights, cache[i]["v"].astype(jnp.float32)
+        ).astype(cfg.dtype)
+        x = x + apply_dense(
+            bp["attn"]["out_proj"], ctx.reshape(-1, cfg.dim)
+        )
+        h = apply_ln(bp["ln_2"], x)
+        h = apply_dense(bp["mlp_fc"], h)
+        h = nn.gelu(h, approximate=True)
+        x = x + apply_dense(bp["mlp_proj"], h)
+
+    x = apply_ln(params["ln_f"], x)
+    logits = x @ params["wte"]["embedding"].T.astype(cfg.dtype)
+    return logits.astype(jnp.float32), cache
+
+
 def gpt_prefill(config: GPTConfig, params, prompt_ids: jax.Array, max_len: int):
     """Fill the K/V cache for the whole prompt in ONE batched forward
     (position-parallel — the MXU sees (B, T_prompt) matmuls, not T_prompt
@@ -796,6 +857,77 @@ def gpt_prefill(config: GPTConfig, params, prompt_ids: jax.Array, max_len: int):
     return logits.astype(jnp.float32), cache
 
 
+def _sample_token(logits, sub, temperature: float):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(sub, logits / temperature, axis=-1).astype(
+        jnp.int32
+    )
+
+
+def decode_tokens(
+    config: GPTConfig,
+    params,
+    cache,
+    first: jax.Array,
+    t_prompt: int,
+    n_steps: int,
+    temperature: float = 0.0,
+    key: jax.Array = None,
+    eos_token_id: int = None,
+):
+    """The decode half of :func:`generate`, exposed on its own: feed
+    ``first`` (B,) at position ``t_prompt`` and run ``n_steps`` one-token
+    decode steps as one ``lax.scan``, returning the (B, n_steps) sampled
+    ids. Separated so harnesses can jit (and time) the decode scan apart
+    from the prefill forward (``experiments.gpt_generate``).
+
+    With ``eos_token_id``, rows that have already emitted EOS keep the
+    static scan shape but stop contributing: their subsequent outputs are
+    padded with the EOS id. Pre-EOS tokens are bitwise-identical to the
+    no-EOS run — the done-mask only rewrites a row's output AFTER its stop,
+    never the float math before it (pinned by test)."""
+    b = first.shape[0]
+    if n_steps <= 0:
+        return jnp.zeros((b, 0), jnp.int32)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    if eos_token_id is None:
+        # no-EOS path kept structurally identical to the historical scan so
+        # its compiled program (and anything golden-pinned on it) is stable
+        def step(carry, i):
+            cache, tok, key = carry
+            logits, cache = gpt_decode_step(
+                config, params, cache, tok, t_prompt + i
+            )
+            key, sub = jax.random.split(key)
+            nxt = _sample_token(logits, sub, temperature)
+            return (cache, nxt, key), nxt
+
+        (_, _, _), rest = jax.lax.scan(
+            step, (cache, first, key), jnp.arange(n_steps)
+        )
+        return jnp.moveaxis(rest, 0, 1)
+
+    eos = jnp.int32(eos_token_id)
+
+    def step_eos(carry, i):
+        cache, tok, key, done = carry
+        logits, cache = gpt_decode_step(config, params, cache, tok, t_prompt + i)
+        key, sub = jax.random.split(key)
+        nxt = _sample_token(logits, sub, temperature)
+        nxt = jnp.where(done, eos, nxt)  # pad rows that stopped earlier
+        done = done | (nxt == eos)
+        return (cache, nxt, key, done), nxt
+
+    done0 = first == eos
+    (_, _, _, _), rest = jax.lax.scan(
+        step_eos, (cache, first, key, done0), jnp.arange(n_steps)
+    )
+    return jnp.moveaxis(rest, 0, 1)
+
+
 def generate(
     config: GPTConfig,
     params,
@@ -803,44 +935,42 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     key: jax.Array = None,
+    eos_token_id: int = None,
+    cache_len: int = None,
 ):
     """Autoregressive sampling: batched prefill of the prompt (one forward),
     then ``max_new_tokens`` one-token decode steps as one ``lax.scan`` —
     greedy (``temperature=0``) or temperature sampling. Returns
-    (B, max_new_tokens) sampled ids."""
+    (B, max_new_tokens) sampled ids.
+
+    ``eos_token_id`` adds a per-row stop condition: a row that samples EOS
+    keeps the static output shape but pads the rest of its row with the EOS
+    id (the tokens before the stop are bitwise-identical to the full-length
+    run). ``cache_len`` overrides the KV-cache capacity (default: exactly
+    ``t_prompt + max_new_tokens``) — a sequential reference call can pin the
+    SAME capacity the serving engine decodes against, so reduction shapes
+    (and therefore bits) match exactly."""
     b, t_prompt = prompt_ids.shape
     total = t_prompt + max_new_tokens
     assert total <= config.max_position_embeddings
     if max_new_tokens <= 0:
         return jnp.zeros((b, 0), jnp.int32)
+    if cache_len is None:
+        cache_len = total
+    assert cache_len >= total, (cache_len, total)
     if key is None:
         key = jax.random.PRNGKey(0)
 
     # freshly-imported checkpoints arrive as numpy (import_weights is
     # torch-free); device arrays are required for traced indexing below
     params = jax.tree_util.tree_map(jnp.asarray, params)
-    last_logits, cache = gpt_prefill(config, params, prompt_ids, total)
-
-    def sample(logits, sub):
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(sub, logits / temperature, axis=-1).astype(
-            jnp.int32
-        )
+    last_logits, cache = gpt_prefill(config, params, prompt_ids, cache_len)
 
     key, sub = jax.random.split(key)
-    first = sample(last_logits, sub)
+    first = _sample_token(last_logits, sub, temperature)
 
-    def step(carry, i):
-        cache, tok, key = carry
-        logits, cache = gpt_decode_step(config, params, cache, tok, t_prompt + i)
-        key, sub = jax.random.split(key)
-        nxt = sample(logits, sub)
-        return (cache, nxt, key), nxt
-
-    (_, _, _), rest = jax.lax.scan(
-        step, (cache, first, key), jnp.arange(max_new_tokens - 1)
+    rest = decode_tokens(
+        config, params, cache, first, t_prompt, max_new_tokens - 1,
+        temperature=temperature, key=key, eos_token_id=eos_token_id,
     )
-    return jnp.concatenate(
-        [first[:, None], jnp.moveaxis(rest, 0, 1)], axis=1
-    )
+    return jnp.concatenate([first[:, None], rest], axis=1)

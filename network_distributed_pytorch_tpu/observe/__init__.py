@@ -61,6 +61,7 @@ from .events import (  # noqa: F401
     NoteEvent,
     PolicyEvent,
     RawEvent,
+    RequestEvent,
     SpanEvent,
     StepEvent,
     StragglerEvent,

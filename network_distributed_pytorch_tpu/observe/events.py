@@ -365,6 +365,37 @@ class DataDropEvent(Event):
 
 
 @dataclass
+class RequestEvent(Event):
+    """Terminal record of one serving request through
+    :mod:`serving.engine` — emitted once, when the request leaves the
+    engine (``state`` ∈ ``finished`` / ``evicted`` / ``failed``), carrying
+    the whole lifecycle's latency split: ``queue_s`` (submit → slot
+    admission), ``prefill_s`` (prompt forward + first token), ``decode_s``
+    (first token → last token) and ``total_s`` (submit → terminal), plus
+    the token counts the SLO report divides by. ``requeues`` counts how
+    many times the request was orphaned by a dead rank and reclaimed by a
+    survivor (the elastic fail-over path). Durations come from the
+    engine's monotonic clock; silent on stdout (one line per request would
+    drown a load test) — ``scripts/report.py`` aggregates the p50/p99 SLO
+    table from the JSONL records."""
+
+    KIND: ClassVar[str] = "request"
+
+    request_id: str
+    state: str  # finished | evicted | failed
+    label: str = "serving"
+    rank: Optional[int] = None
+    prompt_tokens: int = 0
+    tokens_generated: int = 0
+    queue_s: Optional[float] = None
+    prefill_s: Optional[float] = None
+    decode_s: Optional[float] = None
+    total_s: Optional[float] = None
+    requeues: int = 0
+    reason: str = ""
+
+
+@dataclass
 class NoteEvent(Event):
     """A free-form human banner (init lifecycle, dropped-batch notes,
     study tables) that should also land in the structured log."""

@@ -1,0 +1,51 @@
+"""serving — continuous-batching inference over the GPT decoder.
+
+The north star talks about "heavy traffic from millions of users"; this
+package is the piece that actually serves it. The decode primitives come
+from ``models.gpt`` (batched prefill, fixed-capacity KV cache, one-token
+decode steps); serving adds the SCHEDULING layer where real throughput
+lives (Orca iteration-level batching, OSDI '22; vLLM's KV management,
+SOSP '23 — slot-granular here, not paged):
+
+- :mod:`serving.engine`   — the slot engine: a fixed set of batch slots
+  over one slot-batched KV cache, ONE compiled per-slot-position decode
+  step shared by requests at different depths, freed slots backfilled
+  from the queue after every single-token step.
+- :mod:`serving.request`  — the typed request lifecycle (queued →
+  prefilling → decoding → finished/evicted/failed), timestamped per
+  transition and emitted as one terminal ``observe.RequestEvent`` per
+  request (the SLO pipeline's unit record).
+- :mod:`serving.cache`    — the slot-sharded KV cache plus checkpoint
+  hot-load: a serving fleet boots from the newest committed TRAINING
+  checkpoint via ``utils.checkpoint.restore_latest`` with a
+  ``widen_template`` resharder, whatever world size wrote it.
+- :mod:`serving.frontend` — jax-free simulated clients (Poisson
+  arrivals) and the elastic file-spool queue whose claim/requeue protocol
+  lets a supervised fleet re-queue a dead rank's in-flight requests on
+  the survivors (``launch.py serve_gpt --supervise``).
+
+This ``__init__`` imports only the jax-free half (request + frontend), so
+the supervisor-side tooling (``scripts/run_probe.py``, the toy serving
+worker) can drive the spool protocol without a backend init; import
+``serving.engine`` / ``serving.cache`` directly for the jax-backed engine.
+"""
+
+from .frontend import (  # noqa: F401
+    FileSpool,
+    WorkloadConfig,
+    poisson_workload,
+    replay,
+    serve_from_spool,
+    slo_summary,
+)
+from .request import (  # noqa: F401
+    DECODING,
+    EVICTED,
+    FAILED,
+    FINISHED,
+    PREFILLING,
+    QUEUED,
+    TERMINAL_STATES,
+    LifecycleError,
+    Request,
+)

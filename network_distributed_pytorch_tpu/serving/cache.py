@@ -1,0 +1,114 @@
+"""Slot-sharded KV cache + checkpoint hot-load for the serving engine.
+
+The cache reuses ``models.gpt``'s layout exactly — per layer
+``{"k": (S, max_len, H, D), "v": ...}`` — with the batch axis reinterpreted
+as SLOTS: row ``s`` belongs to whichever request currently occupies slot
+``s``. Admission writes a freshly-prefilled single-request cache into its
+slot row (:func:`write_slot`, a traced-index scatter so one compiled
+program serves every slot); freeing a slot needs no work at all, because
+every decode step masks reads beyond each row's own position
+(``gpt_decode_step_slots``) and the next prefill overwrites the row.
+
+:func:`restore_serving_params` is the fleet's boot path: hot-load model
+params from the newest TRAINING checkpoint via
+``utils.checkpoint.restore_latest``, with a ``resilience.reshard
+.widen_template`` resharder so a checkpoint written by a W-rank training
+run restores into a serving process regardless of W — params are
+replicated (no per-rank axis), so widening the template's per-worker
+leaves (EF memories / model_state) is all the elasticity serving needs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.gpt import GPTConfig, init_gpt_cache
+
+
+def init_slot_cache(config: GPTConfig, n_slots: int, max_len: int):
+    """Per-layer K/V zeros with a leading SLOT axis: (S, max_len, H, D)."""
+    return init_gpt_cache(config, n_slots, max_len)
+
+
+def write_slot(cache: List, row_cache: List, slot) -> List:
+    """Scatter a single-request cache (batch axis 1, from a ``gpt_prefill``
+    of that request's prompt) into row ``slot`` of the slot-batched cache.
+    ``slot`` may be traced — one compiled admission program covers every
+    slot index."""
+    out = []
+    for layer, row in zip(cache, row_cache):
+        out.append(
+            {
+                "k": jax.lax.dynamic_update_slice_in_dim(
+                    layer["k"], row["k"].astype(layer["k"].dtype), slot, axis=0
+                ),
+                "v": jax.lax.dynamic_update_slice_in_dim(
+                    layer["v"], row["v"].astype(layer["v"].dtype), slot, axis=0
+                ),
+            }
+        )
+    return out
+
+
+def read_slot(cache: List, slot: int) -> List:
+    """Row ``slot`` of the slot cache as a batch-1 cache (debug/tests)."""
+    return [
+        {"k": layer["k"][slot : slot + 1], "v": layer["v"][slot : slot + 1]}
+        for layer in cache
+    ]
+
+
+def serving_state_template(params) -> Any:
+    """A single-process ``TrainState`` template shaped like what the
+    training loops checkpoint, built from freshly-initialized serving
+    params — the restore target for :func:`restore_serving_params`. The
+    reducer slot uses ``ExactReducer`` (its state is an empty carry, which
+    every reducer's checkpoint satisfies structurally for the params we
+    read)."""
+    from ..parallel.reducers import ExactReducer
+    from ..parallel.trainer import init_train_state
+
+    return init_train_state(params, ExactReducer(), num_devices=1)
+
+
+def restore_serving_params(
+    root: str,
+    params,
+    telemetry: Any = None,
+    label: str = "serving",
+) -> Optional[Tuple[Any, int]]:
+    """Boot a serving process from the newest committed TRAINING
+    checkpoint under ``root``: returns ``(params, step)`` or None when
+    nothing restorable exists. ``params`` is this process's
+    freshly-initialized param tree (the shape/dtype template).
+
+    World-size elastic: a topology-tagged checkpoint written by a W-rank
+    training fleet hits ``TopologyMismatchError`` against the 1-process
+    serving template, and the resharder re-widens the template's per-rank
+    leaves to W (``widen_template``) so orbax can read it — the params are
+    replicated across ranks, so serving takes them as-is and discards the
+    per-worker training state."""
+    from ..resilience.reshard import widen_template
+    from ..utils.checkpoint import restore_checkpoint, restore_latest
+
+    template = serving_state_template(params)
+
+    def _resharder(path, topo):
+        if topo is None or topo.get("world_size") is None:
+            raise ValueError(
+                f"checkpoint {path} carries no topology record — cannot"
+                " hot-load across world sizes"
+            )
+        wide = widen_template(template, int(topo["world_size"]))
+        return restore_checkpoint(path, wide)
+
+    restored = restore_latest(
+        root, template, telemetry=telemetry, label=label, resharder=_resharder
+    )
+    if restored is None:
+        return None
+    state, step = restored
+    return jax.tree_util.tree_map(jnp.asarray, state.params), step

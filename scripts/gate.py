@@ -25,6 +25,8 @@ Metrics compared (only those present in BOTH report and baseline):
 - ``mfu``                   higher is better (report ``mfu_headline`` /
   bench flagship ``mfu`` — ROADMAP item 2's "gate on MFU, not just
   imgs/sec")
+- ``p99_decode_ms_per_token`` lower is better (report ``slo`` section —
+  the serving engine's tail decode latency per generated token)
 
 Span time shares (report ``spans.by_name[*].share``) are compared
 separately when both sides carry them: a span name whose share of run
@@ -56,6 +58,10 @@ METRICS: Dict[str, str] = {
     # step (scripts/report.py recovery_latency_s) — slower healing is a
     # resilience regression
     "recovery_latency_s": "lower",
+    # serving tail latency (report ``slo.p99_decode_ms_per_token``, from
+    # the serving/ engine's per-request events) — a slower p99 decode
+    # tick is an SLO regression even when training metrics hold
+    "p99_decode_ms_per_token": "lower",
 }
 
 BASELINE_NAME = "GATE_BASELINE.json"
@@ -89,6 +95,16 @@ def extract_metrics(doc: Dict) -> Dict[str, float]:
         v = doc.get(key)
         if isinstance(v, (int, float)) and v == v and v > 0:
             out.setdefault("mfu", float(v))
+    # serving SLO scalar: nested under the report's "slo" section, flat in
+    # hand-recorded baselines
+    slo = doc.get("slo")
+    if isinstance(slo, dict):
+        v = slo.get("p99_decode_ms_per_token")
+        if isinstance(v, (int, float)) and v == v and v > 0:
+            out["p99_decode_ms_per_token"] = float(v)
+    v = doc.get("p99_decode_ms_per_token")
+    if isinstance(v, (int, float)) and v == v and v > 0:
+        out.setdefault("p99_decode_ms_per_token", float(v))
     return out
 
 

@@ -265,6 +265,82 @@ def data_drop_summary(events: List[Dict]) -> Dict[str, Dict]:
     return out
 
 
+def slo_summary_from_events(events: List[Dict]) -> Optional[Dict]:
+    """Per-run serving SLO aggregate over terminal ``request`` events
+    (``observe.RequestEvent``, one per request from ``serving/``): state
+    counts, p50/p99 of each latency phase, decode ms/token, and aggregate
+    token throughput over the event window. None when the run served
+    nothing (the section and the gate metric simply don't apply)."""
+    reqs = [e for e in events if e.get("event") == "request"]
+    if not reqs:
+        return None
+    finished = [e for e in reqs if e.get("state") == "finished"]
+    out: Dict = {
+        "n_requests": len(reqs),
+        "n_finished": len(finished),
+        "n_evicted": sum(1 for e in reqs if e.get("state") == "evicted"),
+        "n_failed": sum(1 for e in reqs if e.get("state") == "failed"),
+        "requeues": sum(int(e.get("requeues", 0) or 0) for e in finished),
+    }
+    for phase in ("queue_s", "prefill_s", "decode_s", "total_s"):
+        vals = [e[phase] for e in finished if e.get(phase) is not None]
+        out[f"p50_{phase}"] = percentile(vals, 50) if vals else None
+        out[f"p99_{phase}"] = percentile(vals, 99) if vals else None
+    per_tok = [
+        1e3 * e["decode_s"] / (int(e["tokens_generated"]) - 1)
+        for e in finished
+        if e.get("decode_s") is not None
+        and int(e.get("tokens_generated", 0) or 0) > 1
+    ]
+    out["p50_decode_ms_per_token"] = percentile(per_tok, 50) if per_tok else None
+    out["p99_decode_ms_per_token"] = percentile(per_tok, 99) if per_tok else None
+    total_tokens = sum(int(e.get("tokens_generated", 0) or 0) for e in finished)
+    out["total_tokens"] = total_tokens
+    # throughput over the window the terminal events span: an aggregate
+    # fleet number (per-request rates double-count concurrency)
+    ts = [t for e in finished if (t := _event_time(e)) is not None]
+    out["tokens_per_s"] = (
+        total_tokens / (max(ts) - min(ts))
+        if total_tokens and len(ts) > 1 and max(ts) > min(ts)
+        else None
+    )
+    return out
+
+
+def render_request_section(slo: Dict) -> List[str]:
+    def _ms(v: Optional[float]) -> str:
+        return f"{v * 1e3:8.1f} ms" if v is not None else "     n/a   "
+
+    lines = ["", "serving SLO (per-request latencies)",
+             "-----------------------------------"]
+    lines.append(
+        f"  {slo['n_requests']} request(s): {slo['n_finished']} finished, "
+        f"{slo['n_evicted']} evicted, {slo['n_failed']} failed, "
+        f"{slo['requeues']} requeue(s) survived"
+    )
+    for phase, label in (
+        ("queue_s", "queue"), ("prefill_s", "prefill"),
+        ("decode_s", "decode"), ("total_s", "total"),
+    ):
+        lines.append(
+            f"  {label:<8} p50 {_ms(slo.get(f'p50_{phase}'))}   "
+            f"p99 {_ms(slo.get(f'p99_{phase}'))}"
+        )
+    p50 = slo.get("p50_decode_ms_per_token")
+    p99 = slo.get("p99_decode_ms_per_token")
+    if p50 is not None and p99 is not None:
+        lines.append(
+            f"  decode/token p50 {p50:8.2f} ms   p99 {p99:8.2f} ms"
+            " (the gate's serving scalar)"
+        )
+    tps = slo.get("tokens_per_s")
+    tps_txt = f"{tps:,.1f} tokens/s" if tps else "n/a"
+    lines.append(
+        f"  throughput  {tps_txt} ({slo['total_tokens']} tokens)"
+    )
+    return lines
+
+
 def recovery_latency_s(events: List[Dict]) -> Optional[float]:
     """Seconds from the FIRST injected comm fault to the first healthy
     step after it — a step whose window (previous step's close, its close]
@@ -470,6 +546,10 @@ def render_report(events: List[Dict], name: str = "", skipped_lines: int = 0) ->
                 f"  {label:<18} {d['dropped_samples']} sample(s) in "
                 f"{d['dropped_batches']} batch(es) over {d['events']} event(s)"
             )
+
+    slo = slo_summary_from_events(events)
+    if slo:
+        lines.extend(render_request_section(slo))
 
     notes = by_kind.get("note", [])
     if notes:
@@ -924,6 +1004,9 @@ def run_report(
         # the gate's recovery scalar: wall seconds from the first injected
         # comm fault to the first clean step (lower = faster heal)
         "recovery_latency_s": recovery_latency_s(merged.events),
+        # per-request serving SLOs (None when the run served nothing);
+        # the gate's serving scalar is slo.p99_decode_ms_per_token
+        "slo": slo_summary_from_events(merged.events),
     }
     return text, report
 

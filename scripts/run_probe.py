@@ -17,6 +17,14 @@ into ``artifacts/toy_run_flap/`` and asserts the degraded-fabric
 round-trip in the merged report: a ``descend`` AND an ``ascend``
 PolicyEvent, and a finite comm-fault recovery latency.
 
+A third phase supervises a 2-rank spool-SERVING fleet
+(``tests/toy_serving_worker.py`` over the real ``serving/`` request
+lifecycle + FileSpool) into ``artifacts/toy_run_serve/``: rank 1 kills
+itself mid-decode holding unreleased claims, the world degrades to the
+survivor, and the probe asserts every manifested request still completed
+(some via orphan re-queue) and that the merged report carries the serving
+SLO section with a finite-positive p99 decode ms/token.
+
 Usage::
 
     python scripts/run_probe.py [--out-dir artifacts/toy_run] [--steps 5]
@@ -219,6 +227,113 @@ def main(argv=None) -> int:
         f"# run_probe: comm-flap round-trip ok ({policy['descends']}"
         f" descend(s), {policy['ascends']} ascend(s), recovery"
         f" {latency:.3f}s) at {flap_dir}; report -> {flap_json}\n"
+    )
+
+    # --- phase 3: elastic serving fail-over ------------------------------
+    # a 2-rank spool-serving fleet (jax-free toy engine over the REAL
+    # serving/ spool + lifecycle); rank 1 SIGKILLs itself mid-decode with
+    # unreleased claims, the supervisor degrades the world to 1, and the
+    # surviving rank's restart re-queues the orphans — every manifested
+    # request must still complete, and the merged report must carry the
+    # serving SLO section with finite tail latencies
+    from network_distributed_pytorch_tpu.serving import (
+        FileSpool,
+        WorkloadConfig,
+        poisson_workload,
+    )
+
+    serve_dir = run_dir + "_serve"
+    shutil.rmtree(serve_dir, ignore_errors=True)
+    os.makedirs(serve_dir, exist_ok=True)
+    spool_dir = os.path.join(serve_dir, "spool")
+    workload = poisson_workload(
+        WorkloadConfig(n_requests=16, rate_rps=0.0, max_new_tokens=(6, 12))
+    )
+    FileSpool(spool_dir).ensure(workload)
+    serve_worker = os.path.join(REPO, "tests", "toy_serving_worker.py")
+    serve_step_s = max(args.step_seconds, 0.02)  # keep rank 1 alive long
+    # enough to claim before rank 0 drains the spool solo
+
+    def serve_argv_for_rank(rank, world_size, incarnation):
+        argv = [
+            sys.executable, serve_worker,
+            "--rank", str(rank),
+            "--world", str(world_size),
+            "--spool-dir", spool_dir,
+            "--result-dir", os.path.join(serve_dir, "results"),
+            "--step-seconds", str(serve_step_s),
+        ]
+        if rank == 1:
+            argv += ["--die-after-claims", "2"]
+        return argv
+
+    serve_telemetry = telemetry_for_run(
+        event_log=os.path.join(serve_dir, SUPERVISOR_LOG), stdout=False
+    )
+    serve_result = Supervisor(
+        argv_for_rank=serve_argv_for_rank,
+        world_size=args.world,
+        config=SupervisorConfig(
+            # no restarts for the killed rank: its death must degrade the
+            # world, and fail-over (not a resurrection) must finish the work
+            max_restarts=0, backoff_base_s=0.05, poll_interval_s=0.05,
+            term_grace_s=0.5, allow_degraded=True, min_world_size=1,
+        ),
+        telemetry=serve_telemetry,
+        run_dir=serve_dir,
+    ).run()
+    serve_telemetry.close()
+    problems = []
+    if not serve_result.success:
+        problems.append(f"serving run failed: {serve_result}")
+    elif args.world > 1 and not serve_result.degraded:
+        problems.append(
+            "serving run never degraded — the mid-decode death did not happen"
+        )
+    spool_after = FileSpool(spool_dir)
+    missing = set(spool_after.manifest_ids()) - set(spool_after.done_ids())
+    if missing:
+        problems.append(
+            f"{len(missing)} request(s) never completed after fail-over:"
+            f" {sorted(missing)[:4]}..."
+        )
+    records = spool_after.done_records()
+    requeues = sum(int(r.get("requeues", 0) or 0) for r in records.values())
+    if not problems and requeues < 1:
+        problems.append(
+            "no completion carries a requeue — the orphan re-queue path"
+            " was never exercised"
+        )
+    serve_json = os.path.join(
+        os.path.dirname(args.json_out) or ".", "serve_report.json"
+    )
+    if not problems:
+        rc = report.main(["--run-dir", serve_dir, "--json-out", serve_json])
+        if rc != 0:
+            return rc
+        with open(serve_json) as f:
+            slo = (json.load(f)).get("slo")
+        if not isinstance(slo, dict):
+            problems.append("merged serving report has no slo section")
+        else:
+            if slo.get("n_finished", 0) < len(spool_after.manifest_ids()):
+                problems.append(
+                    f"slo.n_finished {slo.get('n_finished')} < manifest"
+                    f" {len(spool_after.manifest_ids())}"
+                )
+            p99 = slo.get("p99_decode_ms_per_token")
+            if not isinstance(p99, (int, float)) or not p99 > 0:
+                problems.append(
+                    f"slo.p99_decode_ms_per_token not finite-positive: {p99!r}"
+                )
+    if problems:
+        for prob in problems:
+            sys.stderr.write(f"# run_probe: FAIL: {prob}\n")
+        return 1
+    sys.stderr.write(
+        f"# run_probe: serving fail-over ok ({len(records)} request(s)"
+        f" completed, {requeues} requeue(s) survived a mid-decode rank"
+        f" death) at {serve_dir}; report -> {serve_json}\n"
     )
     return 0
 

@@ -74,6 +74,54 @@ def test_generate_zero_tokens_is_empty(devices):
     assert out.shape == (B, 0)
 
 
+def test_eos_early_stop_prefix_matches_full_run(devices):
+    """EOS stop under static shapes: a row that samples EOS pads the rest
+    of its row with the EOS id, and every token BEFORE the stop is
+    bitwise-identical to the run without a stop condition."""
+    model, params, ids = _setup()
+    new = 8
+    full = np.asarray(
+        generate(model.config, params, ids, max_new_tokens=new)
+    )
+    # pick an id the run actually emits mid-sequence, so at least one row
+    # genuinely stops early
+    eos = int(full[0, new // 2])
+    out = np.asarray(
+        generate(
+            model.config, params, ids, max_new_tokens=new, eos_token_id=eos
+        )
+    )
+    assert out.shape == full.shape
+    stopped_early = False
+    for r in range(B):
+        hits = np.where(full[r] == eos)[0]
+        if hits.size == 0:
+            np.testing.assert_array_equal(out[r], full[r])
+            continue
+        j = int(hits[0])
+        stopped_early = stopped_early or j + 1 < new
+        np.testing.assert_array_equal(out[r, : j + 1], full[r, : j + 1])
+        assert (out[r, j + 1:] == eos).all()
+    assert stopped_early
+
+
+def test_eos_prompt_never_suppresses_first_token(devices):
+    """A prompt that happens to END with the EOS id still generates: the
+    stop condition watches SAMPLED tokens, and the first sampled token is
+    only padded when it itself is EOS."""
+    model, params, ids = _setup()
+    full = np.asarray(generate(model.config, params, ids, max_new_tokens=4))
+    eos = int(ids[0, -1])
+    if int(full[0, 0]) == eos:  # degenerate draw; nothing to distinguish
+        return
+    out = np.asarray(
+        generate(
+            model.config, params, ids, max_new_tokens=4, eos_token_id=eos
+        )
+    )
+    assert int(out[0, 0]) == int(full[0, 0])
+
+
 def test_decode_step_does_not_mutate_input_cache(devices):
     model, params, ids = _setup()
     cache = init_gpt_cache(model.config, B, T)

@@ -1,0 +1,469 @@
+"""serving/: the continuous-batching engine and its elastic plumbing.
+
+The load-bearing claims, in test form:
+
+- **Bit-identity**: N requests decoded concurrently through the slot
+  engine (including ones admitted mid-flight into freed slots) produce
+  EXACTLY the tokens of N sequential ``models.gpt.generate`` calls with
+  the cache capacity pinned to the engine's — continuous batching changes
+  the schedule, never the math.
+- **Fewer steps**: the engine's decode-tick count beats padded static
+  batching on unequal-length workloads (``padded_static_decode_steps``
+  is the foil).
+- **Lifecycle + SLO**: the typed request state machine rejects illegal
+  transitions, terminal requests emit one RequestEvent with the full
+  latency split, and ``scripts/report.py``/``scripts/gate.py`` consume
+  those events (SLO section; p99 decode-per-token regression fails the
+  gate).
+- **Elasticity**: the file spool's claim/complete/requeue protocol is
+  idempotent and never steals a live claim; an abandoned (dead-rank)
+  claim is re-queued and completed by a survivor; serving boots from a
+  training checkpoint written at a different world size.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from network_distributed_pytorch_tpu.models.gpt import generate, gpt_tiny
+from network_distributed_pytorch_tpu.serving import (
+    FINISHED,
+    FileSpool,
+    LifecycleError,
+    Request,
+    WorkloadConfig,
+    poisson_workload,
+    serve_from_spool,
+    slo_summary,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTS_DIR = os.path.join(REPO, "tests")
+
+
+def _load_module(name: str, path: str):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_script(name: str):
+    return _load_module(
+        f"_serving_test_{name}", os.path.join(REPO, "scripts", f"{name}.py")
+    )
+
+
+class _CaptureTelemetry:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event):
+        self.events.append(event)
+
+
+# --- request lifecycle (jax-free) ----------------------------------------
+
+
+def test_request_lifecycle_latency_split_and_event():
+    r = Request(request_id="a", prompt=[1, 2, 3], max_new_tokens=2)
+    with pytest.raises(LifecycleError):
+        r.mark_decoding(0.0)  # queued -> decoding skips prefill
+    with pytest.raises(LifecycleError):
+        r.event()  # non-terminal
+    r.mark_enqueued(1.0)
+    r.mark_prefilling(2.5)
+    r.mark_decoding(3.0)
+    r.add_token(5)
+    assert not r.done
+    r.add_token(6)
+    assert r.done  # budget exhausted
+    r.finish(4.0)
+    assert r.state == FINISHED
+    assert r.queue_s == 1.5 and r.prefill_s == 0.5
+    assert r.decode_s == 1.0 and r.total_s == 3.0
+    ev = r.event(label="t", rank=3)
+    rec = ev.record()
+    assert rec["event"] == "request" and rec["state"] == "finished"
+    assert rec["tokens_generated"] == 2 and rec["rank"] == 3
+    with pytest.raises(LifecycleError):
+        r.add_token(7)  # terminal
+
+
+def test_request_eos_stop_and_requeue_reset():
+    r = Request(request_id="b", prompt=[1], max_new_tokens=8, eos_token_id=9)
+    r.mark_enqueued(0.0)
+    r.mark_prefilling(0.0)
+    r.mark_decoding(0.0)
+    r.add_token(4)
+    r.add_token(9)
+    assert r.done  # EOS, budget unspent
+    fresh = r.reset_for_requeue()
+    assert fresh.state == "queued" and fresh.tokens == []
+    assert fresh.requeues == 1 and fresh.prompt == [1]
+    # wire round-trip carries the description + requeues, not progress
+    back = Request.loads(fresh.dumps())
+    assert back.requeues == 1 and back.eos_token_id == 9
+    assert back.tokens == [] and back.max_new_tokens == 8
+
+
+# --- file spool (jax-free) ------------------------------------------------
+
+
+def test_spool_ensure_claim_complete_idempotent(tmp_path):
+    root = str(tmp_path / "spool")
+    reqs = poisson_workload(WorkloadConfig(n_requests=3, rate_rps=0.0))
+    producer = FileSpool(root)
+    assert producer.ensure(reqs) == 3
+    assert producer.ensure(reqs) == 0  # idempotent
+    worker = FileSpool(root, rank=0, incarnation=0)
+    got = worker.claim()
+    assert got.request_id == reqs[0].request_id  # FIFO by id
+    got.mark_enqueued(0.0)
+    got.mark_prefilling(0.0)
+    got.mark_decoding(0.0)
+    got.add_token(1)
+    got.finish(1.0)
+    worker.complete(got)
+    assert producer.ensure(reqs) == 0  # done requests never re-enqueue
+    assert got.request_id in worker.done_ids()
+    assert not worker.drained()  # two still queued
+    # a duplicate queue file for a done id is dropped, not served twice
+    with open(
+        os.path.join(root, "queue", f"{got.request_id}.json"), "w"
+    ) as f:
+        json.dump(got.to_wire(), f)
+    ids = {worker.claim().request_id, worker.claim().request_id}
+    assert got.request_id not in ids and worker.claim() is None
+
+
+def test_spool_requeue_orphans_never_steals_live_claims(tmp_path):
+    root = str(tmp_path / "spool")
+    reqs = poisson_workload(WorkloadConfig(n_requests=4, rate_rps=0.0))
+    FileSpool(root).ensure(reqs)
+    live = FileSpool(root, rank=0, incarnation=0)
+    dead_peer = FileSpool(root, rank=1, incarnation=0)
+    a = live.claim()
+    b = dead_peer.claim()
+    assert a is not None and b is not None
+    # same world, everyone at their current incarnation: nothing is dead
+    assert live.requeue_orphans(world=2) == 0
+    # the world shrank past rank 1 AND rank 0 was restarted (incarnation
+    # 1): both old claims are provably orphaned
+    survivor = FileSpool(root, rank=0, incarnation=1)
+    moved = survivor.requeue_orphans(world=1)
+    assert moved == 2
+    ids = {survivor.claim().request_id for _ in range(4)}
+    assert {a.request_id, b.request_id} <= ids  # orphans are claimable again
+    assert survivor.claim() is None  # queue fully drained into claims
+
+
+def test_spool_requeue_skips_completed_orphans(tmp_path):
+    root = str(tmp_path / "spool")
+    reqs = poisson_workload(WorkloadConfig(n_requests=1, rate_rps=0.0))
+    FileSpool(root).ensure(reqs)
+    dying = FileSpool(root, rank=1, incarnation=0)
+    r = dying.claim()
+    r.mark_enqueued(0.0)
+    r.mark_prefilling(0.0)
+    r.mark_decoding(0.0)
+    r.add_token(1)
+    r.finish(1.0)
+    # completion record landed but the claim-release unlink did not (crash
+    # in between): the requeue must drop the claim, not duplicate the work
+    doc = {
+        "request_id": r.request_id, "state": r.state,
+        "tokens": list(r.tokens), "tokens_generated": len(r.tokens),
+        "requeues": 0, "rank": 1, "incarnation": 0,
+    }
+    with open(
+        os.path.join(root, "done", f"{r.request_id}.json"), "w"
+    ) as f:
+        json.dump(doc, f)
+    survivor = FileSpool(root, rank=0, incarnation=0)
+    assert survivor.requeue_orphans(world=1) == 0
+    assert survivor.claim() is None and survivor.drained()
+
+
+# --- toy-engine fail-over (jax-free, the probe's fast twin) ---------------
+
+
+def test_toy_serving_failover_requeues_and_completes(tmp_path):
+    toy = _load_module(
+        "_toy_serving_under_test",
+        os.path.join(TESTS_DIR, "toy_serving_worker.py"),
+    )
+    root = str(tmp_path / "spool")
+    reqs = poisson_workload(
+        WorkloadConfig(n_requests=6, rate_rps=0.0, max_new_tokens=(3, 6))
+    )
+    FileSpool(root).ensure(reqs)
+    # rank 1 claims two requests and "dies" mid-decode: ticks once, never
+    # completes, abandons its claims on the floor
+    dying_spool = FileSpool(root, rank=1, incarnation=0)
+    dying = toy.ToyEngine(2, rank=1)
+    for _ in range(2):
+        dying.submit(dying_spool.claim())
+    dying.step()
+    assert dying.n_active >= 1  # genuinely mid-decode
+    # the supervisor degrades the world to 1; the survivor restarts at a
+    # new incarnation and the serve loop re-queues the orphans
+    cap = _CaptureTelemetry()
+    spool = FileSpool(root, rank=0, incarnation=1)
+    engine = toy.ToyEngine(2, telemetry=cap, rank=0)
+    served = serve_from_spool(engine, spool, world=1, max_wall_s=30.0)
+    assert served["completed"] == 6 and served["requeued_orphans"] == 2
+    check = FileSpool(root)
+    assert set(check.done_ids()) == set(check.manifest_ids())
+    records = check.done_records()
+    assert sum(r["requeues"] for r in records.values()) == 2
+    # fail-over preserved determinism: every completion carries exactly
+    # the token sequence the toy decoder defines for that request alone
+    for req in reqs:
+        want, probe = [], Request.from_wire(req.to_wire())
+        probe.mark_enqueued(0.0)
+        probe.mark_prefilling(0.0)
+        probe.mark_decoding(0.0)
+        while not probe.done:
+            probe.add_token(toy.toy_token(probe))
+        assert records[req.request_id]["tokens"] == probe.tokens
+    # one terminal RequestEvent per completion went through telemetry
+    recs = [e.record() for e in cap.events]
+    assert len(recs) == 6
+    assert all(r["event"] == "request" and r["state"] == "finished"
+               for r in recs)
+    slo = slo_summary(served["requests"])
+    assert slo["n_finished"] == 6 and slo["total_tokens"] > 0
+
+
+# --- the jax engine: bit-identity and step accounting ---------------------
+
+
+def _serving_model(max_len=16):
+    model = gpt_tiny(vocab_size=64, max_position_embeddings=max_len)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, max_len), jnp.int32)
+    )["params"]
+    return model, params
+
+
+def test_engine_bit_identical_to_sequential_generate(devices):
+    from network_distributed_pytorch_tpu.serving.engine import SlotEngine
+
+    max_len = 16
+    model, params = _serving_model(max_len)
+    rng = np.random.RandomState(1)
+    reqs = []
+    for i, budget in enumerate((4, 6, 3, 5, 4)):
+        prompt = [int(t) for t in rng.randint(0, 64, rng.randint(2, 7))]
+        reqs.append(
+            Request(
+                request_id=f"req-{i:04d}", prompt=prompt,
+                max_new_tokens=budget,
+            )
+        )
+    engine = SlotEngine(model.config, params, n_slots=2, max_len=max_len)
+    # three submitted up front; two more admitted MID-FLIGHT into slots
+    # freed by earlier completions — the continuous-batching schedule
+    for r in reqs[:3]:
+        engine.submit(r)
+    engine.step()
+    engine.step()
+    for r in reqs[3:]:
+        engine.submit(r)
+    finished = engine.run(max_steps=200)
+    assert len(finished) == len(reqs)
+    assert all(r.state == FINISHED for r in finished)
+    for r in reqs:
+        ref = generate(
+            model.config, params, jnp.asarray([r.prompt], jnp.int32),
+            r.max_new_tokens, cache_len=max_len,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(r.tokens, np.int32), np.asarray(ref[0])
+        )
+
+
+def test_continuous_batching_beats_padded_static(devices):
+    from network_distributed_pytorch_tpu.serving.engine import (
+        SlotEngine,
+        padded_static_decode_steps,
+    )
+
+    model, params = _serving_model(16)
+    budgets = [8, 2, 2, 2]
+    cap = _CaptureTelemetry()
+    engine = SlotEngine(
+        model.config, params, n_slots=2, max_len=16, telemetry=cap, rank=0
+    )
+    for i, n in enumerate(budgets):
+        engine.submit(
+            Request(request_id=f"r{i}", prompt=[1 + i, 2, 3],
+                    max_new_tokens=n)
+        )
+    finished = engine.run(max_steps=100)
+    assert len(finished) == 4
+    # padded static batching decodes each arrival-order pair in lockstep
+    # to its longest member: (8,2) -> 7 ticks, (2,2) -> 1 tick
+    static = padded_static_decode_steps(budgets, batch=2)
+    assert static == 8
+    # the engine backfills freed slots every tick, so the short requests
+    # ride along with the long one instead of forcing extra groups
+    assert engine.decode_steps == 7 < static
+    assert engine.prefills == 4
+    assert len(cap.events) == 4  # one terminal RequestEvent each
+
+
+def test_padded_static_decode_steps_edge_cases():
+    from network_distributed_pytorch_tpu.serving.engine import (
+        padded_static_decode_steps,
+    )
+
+    assert padded_static_decode_steps([], 4) == 0
+    assert padded_static_decode_steps([1, 1, 1], 2) == 0  # prefill-only
+    assert padded_static_decode_steps([5], 1) == 4
+    with pytest.raises(ValueError):
+        padded_static_decode_steps([3], 0)
+
+
+def test_engine_evict_all_emits_and_requeues(devices):
+    from network_distributed_pytorch_tpu.serving.engine import SlotEngine
+
+    model, params = _serving_model(16)
+    cap = _CaptureTelemetry()
+    engine = SlotEngine(
+        model.config, params, n_slots=1, max_len=16, telemetry=cap
+    )
+    for i in range(2):
+        engine.submit(
+            Request(request_id=f"e{i}", prompt=[1, 2], max_new_tokens=6)
+        )
+    engine.step()  # one admitted + ticked, one still queued
+    evicted = engine.evict_all(reason="shutdown")
+    assert len(evicted) == 2 and engine.idle
+    assert {e.record()["state"] for e in cap.events} == {"evicted"}
+    fresh = [r.reset_for_requeue() for r in evicted]
+    assert all(f.requeues == 1 and f.tokens == [] for f in fresh)
+
+
+# --- checkpoint hot-load --------------------------------------------------
+
+
+def test_restore_serving_params_across_world_sizes(devices, tmp_path):
+    from network_distributed_pytorch_tpu.parallel.reducers import ExactReducer
+    from network_distributed_pytorch_tpu.parallel.trainer import (
+        init_train_state,
+    )
+    from network_distributed_pytorch_tpu.resilience.reshard import (
+        make_topology,
+    )
+    from network_distributed_pytorch_tpu.serving.cache import (
+        restore_serving_params,
+    )
+    from network_distributed_pytorch_tpu.utils.checkpoint import (
+        save_checkpoint,
+    )
+
+    model, trained = _serving_model(16)
+    root = str(tmp_path / "ckpt")
+    assert restore_serving_params(root, trained) is None  # nothing yet
+    # a 4-rank training fleet checkpoints its state (per-worker memories
+    # carry the leading world axis) with the topology tag
+    state = init_train_state(trained, ExactReducer(), num_devices=4)
+    save_checkpoint(root, state, step=7, topology=make_topology(4))
+    # serving boots single-process from different (fresh) params: the
+    # widened template reads the 4-rank checkpoint, params come back
+    # bit-identical to what training wrote
+    fresh = jax.tree_util.tree_map(jnp.zeros_like, trained)
+    restored = restore_serving_params(root, fresh)
+    assert restored is not None
+    params, step = restored
+    assert step == 7
+    for got, want in zip(
+        jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(trained)
+    ):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# --- the launcher entry + report/gate plumbing ----------------------------
+
+
+def test_serve_gpt_entry_in_process(devices, tmp_path):
+    from network_distributed_pytorch_tpu.experiments import serve_gpt
+
+    out = serve_gpt.run(
+        preset="small", slots=2, requests=4, request_rate=0.0,
+        max_new_tokens=6,
+    )
+    assert out["experiment"] == "serve_gpt" and out["mode"] == "in_process"
+    slo = out["slo"]
+    assert slo["n_finished"] == 4 and slo["n_evicted"] == 0
+    assert out["prefills"] == 4
+    assert out["decode_steps"] <= out["padded_static_decode_steps"]
+    assert slo["p99_decode_ms_per_token"] is None or (
+        slo["p99_decode_ms_per_token"] > 0
+    )
+
+
+def test_serve_gpt_launch_flags_rejected_elsewhere():
+    from network_distributed_pytorch_tpu.launch import main
+
+    with pytest.raises(ValueError, match="--slots is not supported"):
+        main(["gpt_generate", "--slots", "2"])
+    with pytest.raises(ValueError, match="--spool-dir is not supported"):
+        main(["gpt_lm", "--spool-dir", "/tmp/x"])
+
+
+def test_report_renders_slo_section_and_gate_fails_on_regression(tmp_path):
+    report = _load_script("report")
+    events = []
+    for i, decode_s in enumerate((0.010, 0.012, 0.200)):
+        events.append({
+            "event": "request", "request_id": f"req-{i:04d}",
+            "state": "finished", "label": "t", "rank": 0,
+            "prompt_tokens": 4, "tokens_generated": 11,
+            "queue_s": 0.001, "prefill_s": 0.002, "decode_s": decode_s,
+            "total_s": 0.003 + decode_s, "requeues": 1 if i == 2 else 0,
+            "t_wall": 100.0 + i,
+        })
+    events.append({
+        "event": "request", "request_id": "req-0099", "state": "evicted",
+        "label": "t", "rank": 0, "prompt_tokens": 4, "tokens_generated": 2,
+        "requeues": 0, "t_wall": 104.0,
+    })
+    slo = report.slo_summary_from_events(events)
+    assert slo["n_requests"] == 4 and slo["n_finished"] == 3
+    assert slo["n_evicted"] == 1 and slo["requeues"] == 1
+    # nearest-rank p99 of 3 samples = the max; 10 decode ticks per request
+    assert slo["p99_decode_ms_per_token"] == pytest.approx(20.0)
+    text = report.render_report(events, name="slo-test")
+    assert "serving SLO" in text and "requeue(s) survived" in text
+
+    gate = _load_script("gate")
+    report_path = str(tmp_path / "report.json")
+    base_path = str(tmp_path / "baseline.json")
+    with open(report_path, "w") as f:
+        json.dump({"slo": slo}, f)
+    with open(base_path, "w") as f:
+        json.dump({"p99_decode_ms_per_token": 2.0}, f)  # flat baseline form
+    # 20 ms/token vs baseline 2: way past tolerance -> exit 1
+    rc = gate.main(
+        ["--report", report_path, "--baseline", base_path,
+         "--root", str(tmp_path)]
+    )
+    assert rc == 1
+    # matching baseline passes
+    with open(base_path, "w") as f:
+        json.dump({"slo": {"p99_decode_ms_per_token": 19.0}}, f)
+    rc = gate.main(
+        ["--report", report_path, "--baseline", base_path,
+         "--root", str(tmp_path)]
+    )
+    assert rc == 0

@@ -1,0 +1,209 @@
+"""Jax-free toy SERVING worker for fail-over mechanics (run as subprocess).
+
+Simulates one rank of a spool-serving fleet without importing jax (so a
+supervised restart costs milliseconds): a :class:`ToyEngine` implements
+the exact duck-typed engine protocol ``serving.frontend.serve_from_spool``
+drives (``submit / step / take_finished / idle / n_slots / queue_len``)
+with a deterministic token function in place of the GPT decoder — each
+generated token depends only on the request itself, so a request that
+dies mid-decode on one rank and is re-queued decodes the SAME tokens on
+the survivor (what the probe's completion-record check relies on).
+
+The spool protocol, the request lifecycle, the terminal
+``observe.RequestEvent`` telemetry, and the orphan re-queue rules are all
+the REAL ``serving/`` code — only the model is toy.
+
+``--die-after-claims N`` makes the worker SIGKILL itself (incarnation 0
+only) right after a decode tick once it has admitted >= N requests and
+still holds some in flight — a mid-decode rank death with unreleased
+spool claims, the scenario ``scripts/run_probe.py`` phase 3 supervises.
+
+Usage::
+
+    python toy_serving_worker.py --rank R --world W --spool-dir D \
+        --result-dir D [--slots 2] [--step-seconds S] \
+        [--die-after-claims N] [--max-wall-s S]
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from network_distributed_pytorch_tpu.observe import (  # noqa: E402
+    telemetry_for_run,
+)
+from network_distributed_pytorch_tpu.observe.runlog import (  # noqa: E402
+    shard_event_log_from_env,
+)
+from network_distributed_pytorch_tpu.resilience.supervisor import (  # noqa: E402
+    incarnation_from_env,
+)
+from network_distributed_pytorch_tpu.serving import (  # noqa: E402
+    FileSpool,
+    Request,
+    serve_from_spool,
+)
+
+TOY_VOCAB = 64
+
+
+def toy_token(request: Request) -> int:
+    """Deterministic next token: a pure function of the request's own
+    prompt and progress, never of batch-mates or the serving rank — so
+    fail-over to another rank reproduces identical completions."""
+    return (sum(request.prompt) + 7 * len(request.tokens)) % TOY_VOCAB
+
+
+class ToyEngine:
+    """The SlotEngine's host-side scheduling, with :func:`toy_token` in
+    place of the compiled decode step (same backfill-then-tick order, same
+    lifecycle transitions, same terminal RequestEvents)."""
+
+    def __init__(self, n_slots, telemetry=None, rank=None,
+                 step_seconds=0.0, label="toy_serving"):
+        self.n_slots = n_slots
+        self.telemetry = telemetry
+        self.rank = rank
+        self.step_seconds = step_seconds
+        self.label = label
+        self.slots = [None] * n_slots
+        self.queue = []
+        self._finished = []
+        self.submits = 0
+        self.decode_steps = 0
+        self.prefills = 0
+
+    def submit(self, request):
+        request.mark_enqueued(time.monotonic())
+        self.queue.append(request)
+        self.submits += 1
+
+    @property
+    def n_active(self):
+        return sum(1 for s in self.slots if s is not None)
+
+    @property
+    def queue_len(self):
+        return len(self.queue)
+
+    @property
+    def idle(self):
+        return not self.queue and self.n_active == 0
+
+    def take_finished(self):
+        out, self._finished = self._finished, []
+        return out
+
+    def _terminal(self, request):
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                request.event(label=self.label, rank=self.rank)
+            )
+        self._finished.append(request)
+
+    def step(self):
+        before = self.prefills
+        now = time.monotonic()
+        for s in range(self.n_slots):
+            if not self.queue:
+                break
+            if self.slots[s] is None:
+                r = self.queue.pop(0)
+                r.mark_prefilling(now)
+                self.prefills += 1
+                r.mark_decoding(time.monotonic())
+                r.add_token(toy_token(r))
+                if r.done:
+                    r.finish(time.monotonic())
+                    self._terminal(r)
+                else:
+                    self.slots[s] = r
+        occupied = [s for s in range(self.n_slots) if self.slots[s] is not None]
+        if not occupied:
+            return self.prefills != before
+        if self.step_seconds:
+            time.sleep(self.step_seconds)
+        self.decode_steps += 1
+        now = time.monotonic()
+        for s in occupied:
+            r = self.slots[s]
+            r.add_token(toy_token(r))
+            if r.done:
+                r.finish(now)
+                self._terminal(r)
+                self.slots[s] = None
+        return True
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rank", type=int, required=True)
+    p.add_argument("--world", type=int, required=True)
+    p.add_argument("--spool-dir", required=True)
+    p.add_argument("--result-dir", required=True)
+    p.add_argument("--slots", type=int, default=2)
+    p.add_argument("--step-seconds", type=float, default=0.005)
+    p.add_argument("--max-wall-s", type=float, default=60.0)
+    p.add_argument(
+        "--die-after-claims", type=int, default=None, metavar="N",
+        help="incarnation 0 only: SIGKILL self mid-decode once N requests"
+             " have been admitted and some are still in flight",
+    )
+    args = p.parse_args()
+
+    incarnation = incarnation_from_env()
+    os.makedirs(args.result_dir, exist_ok=True)
+
+    event_log = shard_event_log_from_env()
+    telemetry = (
+        telemetry_for_run(event_log=event_log, stdout=False)
+        if event_log else None
+    )
+
+    spool = FileSpool(args.spool_dir, rank=args.rank, incarnation=incarnation)
+    engine = ToyEngine(
+        args.slots, telemetry=telemetry, rank=args.rank,
+        step_seconds=args.step_seconds,
+    )
+
+    if args.die_after_claims is not None and incarnation == 0:
+        # mid-decode death: strike AFTER a tick, with claims unreleased —
+        # this step's finished-but-uncompleted requests are orphaned too
+        # (re-queue must recover them, idempotently)
+        plain_step = engine.step
+
+        def step_then_maybe_die():
+            worked = plain_step()
+            if engine.submits >= args.die_after_claims and engine.n_active:
+                os.kill(os.getpid(), signal.SIGKILL)
+            return worked
+
+        engine.step = step_then_maybe_die
+
+    served = serve_from_spool(
+        engine, spool, world=args.world, max_wall_s=args.max_wall_s
+    )
+    served.pop("requests", None)  # Request objects aren't JSON
+
+    if telemetry is not None:
+        telemetry.close()
+    with open(
+        os.path.join(args.result_dir, f"rank{args.rank}.json"), "w"
+    ) as f:
+        json.dump(
+            {"rank": args.rank, "world": args.world,
+             "incarnation": incarnation,
+             "decode_steps": engine.decode_steps,
+             "prefills": engine.prefills, **served},
+            f,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
