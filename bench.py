@@ -79,7 +79,26 @@ CHUNK = int(os.environ.get("BENCH_CHUNK", "50"))
 # must stay decoupled from CHUNK: at the measured 3.4 s/step, CHUNK=50
 # iterations would alone blow the 240 s phase budget
 BASELINE_REPS = int(os.environ.get("BENCH_BASELINE_REPS", "8"))
+# per-tier MFU floors for the flagship (published as ``mfu_target`` in the
+# phase record, the summary, and GATE_BASELINE.json so scripts/gate.py can
+# gate the mfu metric against an EXPLICIT target instead of only
+# run-over-run drift). Anchored on recorded chip runs of the "full" preset
+# (artifacts/BENCH_R4_RUN2.json mfu=0.0072, BENCH_MIDROUND.json 0.0047 —
+# the spread is tunnel variance): 0.005 sits at the observed midpoint, and
+# the "small" tier's shallow ResNet-18 carries proportionally less MXU
+# work per byte. Override per-run with BENCH_MFU_TARGET.
+MFU_TARGETS = {"small": 0.002, "full": 0.005}
 MARKER = "@BENCH@ "
+
+
+def _mfu_target(preset: str) -> float:
+    env = os.environ.get("BENCH_MFU_TARGET")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    return MFU_TARGETS.get(preset, 0.0)
 # global wall budget for the whole orchestration — must undercut the
 # driver's own patience (round 3 was killed at rc=124 with nothing printed;
 # VERDICT r3 set the bar at <=900 s)
@@ -463,6 +482,10 @@ def _phase_flagship() -> dict:
         # visible in the published sequence
         "dispatch_times_ms": [round(1000.0 * t, 2) for t in times],
     }
+    # published floor for this tier, emitted even when mfu itself is
+    # withheld (CPU smoke / failed cross-check) — the target is policy,
+    # not measurement, and gate.py needs it either way
+    out["mfu_target"] = _mfu_target(out["preset"])
     # flops_chunk ÷ CHUNK is only valid where the compiler's cost analysis
     # multiplies the scan body by its trip count. The TPU toolchain does
     # (measured: chip runs report flops_per_step = 10.39 GF for this
@@ -1213,8 +1236,8 @@ _SUMMARY_PRIORITY = (
     "flagship_imgs_per_sec", "flagship_imgs_per_sec_min",
     "flagship_imgs_per_sec_max", "baseline_imgs_per_sec",
     "baseline_imgs_per_sec_min", "baseline_imgs_per_sec_max", "mfu",
-    "fp32_scanned_imgs_per_sec", "tpu_error", "orchestrator_error",
-    "flops_chunk_ratio",
+    "mfu_target", "fp32_scanned_imgs_per_sec", "tpu_error", "init_retries",
+    "orchestrator_error", "flops_chunk_ratio",
 )
 
 
@@ -1327,6 +1350,10 @@ def orchestrate() -> int:
                             # init failure so the CPU fallback policy engages
                             # instead of burning one phase per crash.
                             init_failures += 1
+                            if init_failures < 2:
+                                out["init_retries"] = (
+                                    out.get("init_retries", 0) + 1
+                                )
                             out.setdefault(
                                 "tpu_error", "child process died during backend init"
                             )
@@ -1338,12 +1365,20 @@ def orchestrate() -> int:
                     if ev["phase"] == "__init__":
                         err = str(ev["data"].get("error", "?"))[:300]
                         # an init HANG (_InitTimeout after the 240 s watchdog)
-                        # is the wedged-tunnel signature and is decisive: a
-                        # second probe would hang the same way and burn another
-                        # 240 s of the driver's window for the same verdict.
-                        # Transient errors (UNAVAILABLE etc.) return fast and
-                        # keep the two-strike budget.
-                        init_failures += 2 if "_InitTimeout" in err else 1
+                        # used to be decisive; pool-side evidence since shows
+                        # roughly half the hangs were transient tunnel
+                        # contention that a fresh probe clears. One retry is
+                        # cheap against the window when it works and costs one
+                        # 240 s probe when it doesn't, so hangs now share the
+                        # two-strike budget with transient errors
+                        # (UNAVAILABLE etc.) — every init failure gets exactly
+                        # one more attempt before the CPU fallback verdict.
+                        init_failures += 1
+                        if init_failures < 2:
+                            # another probe will follow (the while loop
+                            # respawns for the still-pending phases) — make
+                            # the retry visible in the published record
+                            out["init_retries"] = out.get("init_retries", 0) + 1
                         out["tpu_error"] = err
                         break
                     if ev["phase"] == "__drain__":
@@ -1379,9 +1414,9 @@ def orchestrate() -> int:
                 # failures: degrade to the CPU smoke tier, clearly labeled;
                 # the TPU error stays on the line
                 print(
-                    "# bench: TPU init failure budget exhausted (a hang is "
-                    "decisive; transient errors take two); falling back to CPU "
-                    "smoke tier",
+                    "# bench: TPU init failure budget exhausted (two strikes; "
+                    "every failure, hangs included, got one retry); falling "
+                    "back to CPU smoke tier",
                     file=sys.stderr, flush=True,
                 )
                 os.environ["BENCH_PLATFORM"] = "cpu"
@@ -1497,6 +1532,12 @@ def _record_gate_baseline(out: dict, status: dict) -> None:
     mfu = out.get("mfu")
     if isinstance(mfu, (int, float)) and mfu > 0:
         rec["mfu"] = float(mfu)
+    # the tier's published MFU floor rides along unconditionally: gate.py
+    # uses it as an ABSOLUTE target for the mfu metric (drift alone can
+    # ratchet a slow regression past a relative-only gate)
+    mfu_target = out.get("mfu_target")
+    if isinstance(mfu_target, (int, float)) and mfu_target > 0:
+        rec["mfu_target"] = float(mfu_target)
     path = os.path.join(HERE, "artifacts", "GATE_BASELINE.json")
     try:
         os.makedirs(os.path.join(HERE, "artifacts"), exist_ok=True)

@@ -89,6 +89,28 @@ def reducer_comm_kwargs(config) -> Dict[str, Any]:
     }
 
 
+def exact_reducer_kwargs(config) -> Dict[str, Any]:
+    """``ExactReducer`` constructor kwargs from config: the shared chunking
+    knobs plus the DDP-style backward-order bucket target
+    (``config.bucket_bytes`` → ``bucket_bytes``)."""
+    kw = reducer_comm_kwargs(config)
+    if getattr(config, "bucket_bytes", None) is not None:
+        kw["bucket_bytes"] = config.bucket_bytes
+    return kw
+
+
+def powersgd_reducer_kwargs(config) -> Dict[str, Any]:
+    """``PowerSGDReducer`` constructor kwargs from config: the shared
+    chunking knobs plus the kernel-implementation overrides
+    (``compress_impl`` for the fused Pallas compress pipeline,
+    ``orthogonalize_impl`` for the Gram-Schmidt — "auto" resolves to the
+    Pallas kernel on TPU)."""
+    kw = reducer_comm_kwargs(config)
+    kw["compress_impl"] = getattr(config, "compress_impl", "xla")
+    kw["orthogonalize_impl"] = getattr(config, "orthogonalize_impl", "auto")
+    return kw
+
+
 def accum_batch_sharding(mesh, accum_steps: int):
     """Prefetch sharding for accumulated batches: the sharded batch dim sits
     BEHIND the accum axis. None for the unaccumulated default (train_loop
@@ -383,6 +405,8 @@ def evaluate_image_classifier(
 
     from ..data import iterate_batches
 
+    # lint: no-donate — eval predict has no carry; params are closed
+    # over and re-used every batch
     @jax.jit
     def predict(x):
         logits = model.apply(
@@ -409,6 +433,8 @@ def evaluate_text_classifier(model, params, split, batch_size: int = 64) -> floa
 
     from ..data import iterate_batches
 
+    # lint: no-donate — eval predict has no carry; params are closed
+    # over and re-used every batch
     @jax.jit
     def predict(ids, mask):
         logits = model.apply({"params": params}, ids, mask, deterministic=True)

@@ -84,7 +84,10 @@ def run(
         sync_every=sync_every,
         reducer=red,
         mesh=mesh,
-        donate_state=False,
+        # the round loop threads the carry strictly and eval reads only the
+        # final state, so the donated round avoids a full params+momenta+
+        # memories copy per sync
+        donate_state=True,
     )
     if fragments > 1:
         diloco = make_streaming_diloco_train_fn(

@@ -28,7 +28,7 @@ from .common import (
     accum_batch_sharding,
     accumulated_batches,
     image_classifier_loss,
-    reducer_comm_kwargs,
+    exact_reducer_kwargs,
     summarize,
     train_loop,
 )
@@ -179,7 +179,7 @@ def run(
     else:
         step = make_train_step(
             loss_fn,
-            ExactReducer(**reducer_comm_kwargs(config)),
+            ExactReducer(**exact_reducer_kwargs(config)),
             params,
             learning_rate=config.learning_rate,
             momentum=config.momentum,

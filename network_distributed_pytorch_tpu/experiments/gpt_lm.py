@@ -62,6 +62,8 @@ def run(
         vocab_size=vocab, max_position_embeddings=seq_len,
         dtype=jnp.dtype(config.compute_dtype), remat=remat,
         scan_layers=scan_layers,
+        # None = keep the model default ("auto": flash on TPU, einsum off)
+        **({} if config.attn_impl is None else {"attn_impl": config.attn_impl}),
     )
     ids = jnp.zeros((1, seq_len), jnp.int32)
     params = model.init(jax.random.PRNGKey(config.seed), ids)["params"]

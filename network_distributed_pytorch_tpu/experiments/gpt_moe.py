@@ -296,6 +296,8 @@ def run(
         ce = next_token_loss(logits, y)
         return tuple(jax.lax.pmean(m, AXIS) for m in (ce, aux_, dropped_))
 
+    # lint: no-donate — one-shot diagnostic over the final params; the
+    # caller still holds fp/fr/fe afterwards
     diag = jax.jit(
         jax.shard_map(
             diag_fn,

@@ -26,7 +26,7 @@ from .common import (
     accum_batch_sharding,
     accumulated_batches,
     image_classifier_loss,
-    reducer_comm_kwargs,
+    powersgd_reducer_kwargs,
     summarize,
     train_loop,
 )
@@ -69,7 +69,7 @@ def run(
         compression_rank=config.reducer_rank,
         reuse_query=config.reuse_query,
         matricize="last",  # flax HWIO/(in,out) layouts put output features last
-        **reducer_comm_kwargs(config),
+        **powersgd_reducer_kwargs(config),
     )
     loss_fn = image_classifier_loss(model, has_batch_stats=True)
     step = make_train_step(

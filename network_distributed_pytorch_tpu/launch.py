@@ -99,6 +99,32 @@ def build_parser() -> argparse.ArgumentParser:
              " deterministic but reassociated)",
     )
     p.add_argument(
+        "--bucket-bytes", type=int, default=None,
+        help="bucketed backward overlap (cifar exact-DDP experiments): pack"
+             " gradients into ~B-byte buckets in backward production order,"
+             " one fenced collective each, so early buckets' wire time"
+             " overlaps the rest of the backward (DESIGN.md: raw speed)",
+    )
+    p.add_argument(
+        "--compress-impl", choices=["xla", "pallas"], default=None,
+        help="PowerSGD compress pipeline: 'pallas' runs the fused kernels"
+             " (EF add + P=MQ; Gram-Schmidt + Q=M^T P; decompress +"
+             " residual — one HBM round-trip each per shape bucket);"
+             " interpret mode off-TPU",
+    )
+    p.add_argument(
+        "--orthogonalize-impl", choices=["auto", "xla", "pallas"],
+        default=None,
+        help="PowerSGD Gram-Schmidt engine ('auto': the Pallas VMEM kernel"
+             " on TPU, the XLA fori_loop elsewhere)",
+    )
+    p.add_argument(
+        "--attn-impl", choices=["auto", "einsum", "flash"], default=None,
+        help="attention engine override for the transformer experiments"
+             " ('auto': flash on TPU, einsum elsewhere; unset = each"
+             " model's own default, which is also 'auto')",
+    )
+    p.add_argument(
         "--remat", action="store_true",
         help="rematerialize transformer blocks in the backward pass"
              " (gpt_lm, powersgd_imdb)",
@@ -327,6 +353,14 @@ def config_from_args(args) -> ExperimentConfig:
         cfg.comm_chunks = args.comm_chunks
     if args.comm_strategy is not None:
         cfg.comm_strategy = args.comm_strategy
+    if args.bucket_bytes is not None:
+        cfg.bucket_bytes = args.bucket_bytes
+    if args.compress_impl is not None:
+        cfg.compress_impl = args.compress_impl
+    if args.orthogonalize_impl is not None:
+        cfg.orthogonalize_impl = args.orthogonalize_impl
+    if args.attn_impl is not None:
+        cfg.attn_impl = args.attn_impl
     cfg.event_log = args.event_log
     cfg.trace_dir = args.trace_dir
     cfg.audit_wire = args.audit_wire

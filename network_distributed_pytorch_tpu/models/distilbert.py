@@ -54,9 +54,11 @@ class DistilBertConfig:
     # NOTE: both schedules are flash-style (the attention-weight matrix never
     # materializes), so attention_dropout is not applied on this path.
     seq_impl: str = "ring"
-    # single-device attention engine: "einsum" (XLA) or "flash" (the Pallas
-    # VMEM-tiled kernel; no attention-weight dropout, as above).
-    attn_impl: str = "einsum"
+    # single-device attention engine: "auto" (flash on TPU, einsum
+    # elsewhere — ops.flash_attention.resolve_attn_impl), "einsum" (XLA),
+    # or "flash" (the Pallas VMEM-tiled kernel; no attention-weight
+    # dropout, as above).
+    attn_impl: str = "auto"
     # rematerialization: recompute each block in the backward pass instead of
     # storing activations (jax.checkpoint via nn.remat; see GPTConfig.remat).
     remat: bool = False
@@ -78,8 +80,22 @@ class MultiHeadSelfAttention(nn.Module):
             return t.reshape(t.shape[0], t.shape[1], cfg.n_heads, head_dim)
 
         q, k, v = split(q), split(k), split(v)
+        from .gpt import _resolve_attn_impl
+
+        attn_impl = _resolve_attn_impl(cfg.attn_impl)
         if (
-            (cfg.seq_axis is not None or cfg.attn_impl == "flash")
+            cfg.attn_impl == "auto"
+            and attn_impl == "flash"
+            and not deterministic
+            and cfg.attention_dropout > 0.0
+        ):
+            # "auto" must never change the math across backends: flash
+            # cannot dropout-mask the attention weights, so training with
+            # attention_dropout stays on einsum (explicit "flash" still
+            # fails loudly below — same contract as before).
+            attn_impl = "einsum"
+        if (
+            (cfg.seq_axis is not None or attn_impl == "flash")
             and not deterministic
             and cfg.attention_dropout > 0.0
         ):
@@ -105,7 +121,7 @@ class MultiHeadSelfAttention(nn.Module):
                     f" are {sorted(impls)}"
                 )
             ctx = impls[cfg.seq_impl](q, k, v, cfg.seq_axis, mask=mask)
-        elif cfg.attn_impl == "flash":
+        elif attn_impl == "flash":
             from ..ops.flash_attention import flash_attention
 
             ctx = flash_attention(

@@ -49,11 +49,13 @@ class GPTConfig:
     # unsharded training regularize slightly differently when dropout > 0.
     seq_axis: Any = None
     seq_impl: str = "ring"
-    # single-device attention engine: "einsum" (XLA) or "flash" (the Pallas
-    # VMEM-tiled kernel, ops.flash_attention; interpret mode off-TPU). Like
-    # the sequence-parallel schedules it never materializes the score matrix,
-    # so attention-weight dropout does not apply on this path either.
-    attn_impl: str = "einsum"
+    # single-device attention engine: "auto" (flash on TPU, einsum
+    # elsewhere — ops.flash_attention.resolve_attn_impl), "einsum" (XLA),
+    # or "flash" (the Pallas VMEM-tiled kernel, ops.flash_attention;
+    # interpret mode off-TPU). Like the sequence-parallel schedules flash
+    # never materializes the score matrix, so attention-weight dropout does
+    # not apply on that path.
+    attn_impl: str = "auto"
     # rematerialization: recompute each block's activations in the backward
     # pass instead of storing them (jax.checkpoint via nn.remat) — activation
     # memory drops from O(n_layers · seq · dim) to O(seq · dim) at ~1/3 more
@@ -75,6 +77,16 @@ class GPTConfig:
     scan_layers: bool = False
 
 
+def _resolve_attn_impl(attn_impl: str) -> str:
+    if attn_impl != "auto":
+        return attn_impl
+    # lazy import for the same reason flash_attention itself is imported at
+    # dispatch time: keep pallas off the plain-einsum module-import path
+    from ..ops.flash_attention import resolve_attn_impl
+
+    return resolve_attn_impl(attn_impl)
+
+
 class CausalSelfAttention(nn.Module):
     config: GPTConfig
 
@@ -91,6 +103,18 @@ class CausalSelfAttention(nn.Module):
             return t.reshape(t.shape[0], t.shape[1], cfg.n_heads, head_dim)
 
         q, k, v = split(q), split(k), split(v)
+        attn_impl = _resolve_attn_impl(cfg.attn_impl)
+        if (
+            cfg.attn_impl == "auto"
+            and attn_impl == "flash"
+            and not deterministic
+            and cfg.dropout > 0.0
+        ):
+            # "auto" must never change the math across backends: flash
+            # cannot dropout-mask the attention weights, so a training step
+            # with dropout stays on einsum. Explicit attn_impl="flash"
+            # keeps flash (the documented no-weight-dropout trade).
+            attn_impl = "einsum"
         if cfg.seq_axis is not None:
             from ..parallel.sequence import ring_attention, ulysses_attention
 
@@ -101,7 +125,7 @@ class CausalSelfAttention(nn.Module):
                     f" {sorted(impls)}"
                 )
             ctx = impls[cfg.seq_impl](q, k, v, cfg.seq_axis, causal=True)
-        elif cfg.attn_impl == "flash":
+        elif attn_impl == "flash":
             from ..ops.flash_attention import flash_attention
 
             ctx = flash_attention(

@@ -59,6 +59,20 @@ _LSE_EMPTY = float(1e30)  # lse for fully-masked rows: exp(s - 1e30) == 0
 _MASK_PAD = float(-1e29)
 
 
+def resolve_attn_impl(attn_impl: str) -> str:
+    """Resolve the ``"auto"`` attention engine at dispatch time.
+
+    On TPU the Pallas kernel compiles natively (Mosaic) and is the fast
+    path; everywhere else it would only run in interpret mode — orders of
+    magnitude slower than XLA's fused einsum — so "auto" means flash on
+    TPU and einsum elsewhere. Explicit "flash"/"einsum" pass through
+    untouched (tests pin both engines regardless of backend).
+    """
+    if attn_impl == "auto":
+        return "flash" if jax.default_backend() == "tpu" else "einsum"
+    return attn_impl
+
+
 def _flash_kernel(
     block_q: int,
     block_k: int,

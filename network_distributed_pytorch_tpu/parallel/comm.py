@@ -214,6 +214,42 @@ def chunk_bounds(total: int, n_chunks: int) -> List[Tuple[int, int]]:
     return bounds
 
 
+def bucket_assignments(
+    sizes_bytes: List[int], bucket_bytes: int
+) -> List[List[int]]:
+    """Assign leaf indices to size-targeted buckets in REVERSE index order.
+
+    Backward-order bucketing (the DDP gradient-bucket strategy): autodiff
+    produces gradients roughly in reverse parameter order — the loss-side
+    layers' grads materialize first — so walking the leaves last-to-first
+    and closing a bucket once it reaches ``bucket_bytes`` yields buckets in
+    gradient *production* order. Bucket 0's collective depends only on the
+    last few leaves and can launch while the front of the backward pass is
+    still computing; each later bucket is fenced behind its predecessor's
+    result (see ``ExactReducer``), which pins the DDP launch order into the
+    schedule.
+
+    Pure Python over static sizes — usable at trace time and in
+    ledger/bits bookkeeping alike (like :func:`chunk_bounds`). Every bucket
+    is non-empty; indices *within* a bucket stay in ascending order so the
+    per-bucket packer layout is deterministic. ``bucket_bytes`` clamps to
+    >= 1 byte; a target at or above the total yields one bucket.
+    """
+    target = max(1, int(bucket_bytes))
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    acc = 0
+    for i in reversed(range(len(sizes_bytes))):
+        cur.append(i)
+        acc += int(sizes_bytes[i])
+        if acc >= target:
+            buckets.append(sorted(cur))
+            cur, acc = [], 0
+    if cur:
+        buckets.append(sorted(cur))
+    return buckets
+
+
 def fence(*values):
     """``lax.optimization_barrier`` over one or more pytrees: the returned
     values are identical but XLA may neither reorder computations across the

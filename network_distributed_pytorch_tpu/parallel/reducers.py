@@ -47,13 +47,21 @@ dead ``precalc_numbers`` allocation (``reducer.py:9-12``) and the
 from __future__ import annotations
 
 
+import functools
 from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from ..ops.orthogonalize import orthogonalize
-from .comm import all_reduce_mean, chunk_bounds, chunked_all_reduce_mean, n_bits
+from .comm import (
+    all_reduce_mean,
+    bucket_assignments,
+    chunk_bounds,
+    chunked_all_reduce_mean,
+    fence,
+    n_bits,
+)
 from .packing import TensorPacker
 
 PyTree = Any
@@ -88,6 +96,19 @@ class ExactReducer:
     ``comm_strategy="ring"`` swaps each chunk's pmean for the explicit
     ``ppermute`` ring schedule (deterministic, reassociated — see
     ``comm.ring_all_reduce_mean``).
+
+    ``bucket_bytes=B`` is the DDP bucketed-backward-overlap structure
+    (``comm.bucket_assignments``): leaves are assigned to ~B-byte buckets
+    in REVERSE leaf order — gradient *production* order in the backward
+    pass — and each bucket packs and reduces only its own leaves, so its
+    collective's operands are ready as soon as the backward has produced
+    that bucket's gradients. Consecutive bucket launches are fenced
+    (``optimization_barrier``) to pin the DDP launch order and keep the
+    all-reduce combiner from re-merging the buckets; each bucket still
+    rides the chunked engine (``comm_chunks`` applies per bucket). An
+    all-reduce is elementwise, so partitioning the payload commutes with
+    it: the bucketed reduction is **bitwise identical** to the monolithic
+    one, and ledger bytes are invariant (the buckets partition the leaves).
     """
 
     def __init__(
@@ -95,6 +116,7 @@ class ExactReducer:
         packed: bool = True,
         comm_chunks: Optional[int] = None,
         comm_strategy: str = "interleave",
+        bucket_bytes: Optional[int] = None,
     ):
         assert comm_strategy in ("interleave", "ring"), comm_strategy
         assert comm_chunks is None or comm_chunks >= 1
@@ -102,20 +124,41 @@ class ExactReducer:
         # is already per-tensor (the latency-study structure) and has no
         # flat buffer to split
         assert comm_chunks is None or packed, "comm_chunks requires packed=True"
+        # bucketing likewise re-partitions the packed payload
+        assert bucket_bytes is None or (packed and bucket_bytes >= 1), (
+            "bucket_bytes requires packed=True"
+        )
         self.packed = packed
         self.comm_chunks = comm_chunks
         self.comm_strategy = comm_strategy
+        self.bucket_bytes = bucket_bytes
 
     def _n_chunks(self, leaves) -> int:
         total = sum(int(l.size) for l in leaves)
         return _n_chunk_collectives(total, self.comm_chunks)
+
+    def _buckets(self, leaves) -> List[List[int]]:
+        """Leaf-index buckets in backward (production) order; one bucket
+        holding every leaf when bucketing is off."""
+        if self.bucket_bytes is None:
+            return [list(range(len(leaves)))]
+        return bucket_assignments(
+            [n_bits(l) // 8 for l in leaves], self.bucket_bytes
+        )
 
     def init(self, grads_template: PyTree) -> dict:
         return {}
 
     def n_collectives(self, grads_template: PyTree) -> int:
         leaves = jax.tree_util.tree_leaves(grads_template)
-        return self._n_chunks(leaves) if self.packed else len(leaves)
+        if not self.packed:
+            return len(leaves)
+        return sum(
+            _n_chunk_collectives(
+                sum(int(leaves[i].size) for i in idxs), self.comm_chunks
+            )
+            for idxs in self._buckets(leaves)
+        )
 
     # named_scope: label the reduction's HLO so device traces attribute
     # collective/compress time to the reducer (pairs with the host-side
@@ -127,7 +170,31 @@ class ExactReducer:
         leaves, treedef = jax.tree_util.tree_flatten(send)
         if not leaves:
             return state, send, send, 0
-        if self.packed:
+        if self.packed and self.bucket_bytes is not None:
+            # bucketed backward overlap: one fenced collective chain in
+            # gradient-production order — bucket i's payload depends only
+            # on its own leaves (so it launches as soon as the backward
+            # produced them) plus bucket i-1's RESULT (the fence that pins
+            # the DDP launch order and defeats the all-reduce combiner)
+            buckets = self._buckets(leaves)
+            out_leaves: List[jax.Array] = [None] * len(leaves)
+            bits = 0
+            prev = None
+            for bi, idxs in enumerate(buckets):
+                blk = [leaves[i] for i in idxs]
+                packer = TensorPacker.for_arrays(blk)
+                flat = packer.pack(blk)
+                if prev is not None:
+                    flat, prev = fence(flat, prev)
+                reduced = chunked_all_reduce_mean(
+                    flat, axis_name, self.comm_chunks, self.comm_strategy,
+                    tag=f"grads.b{bi}",
+                )
+                prev = reduced
+                bits += packer.bits()
+                for i, o in zip(idxs, packer.unpack(reduced)):
+                    out_leaves[i] = o.astype(leaves[i].dtype)
+        elif self.packed:
             packer = TensorPacker.for_arrays(leaves)
             flat = packer.pack(leaves)
             # always through the chunked engine: with comm_chunks=None this
@@ -151,29 +218,65 @@ class ExactReducer:
         new_memory = jax.tree_util.tree_map(jnp.zeros_like, send)
         return state, out, new_memory, bits
 
+    def reduce_ef(
+        self,
+        state: dict,
+        grads: PyTree,
+        memories: PyTree,
+        axis_name: Optional[str],
+    ) -> Tuple[dict, PyTree, PyTree, int]:
+        """Error-feedback entry point (``send = grads + memories`` then
+        :meth:`reduce`) — the uniform protocol the trainer calls so reducers
+        that CAN fuse the add (``PowerSGDReducer`` with
+        ``compress_impl="pallas"``) get the separated operands."""
+        send = jax.tree_util.tree_map(jnp.add, grads, memories)
+        return self.reduce(state, send, axis_name)
+
     def ledger_entries(self, grads_template: PyTree, axis: str = "", n_workers: int = 1):
         """Wire-ledger itemization of one exact reduction: the whole gradient
         as one flat-packed all-reduce (or, unpacked, one per-tensor all-reduce
         batch; chunked, one all-reduce per chunk — the chunk payloads
-        partition the flat buffer, so ``payload_bytes`` is K-invariant).
+        partition the flat buffer, so ``payload_bytes`` is K-invariant;
+        bucketed, one entry per backward-order bucket tagged ``grads.b{i}``
+        — the buckets partition the leaves, so total bytes stay put).
         Sums to ``reduce``'s analytic ``bits``."""
         from ..observe.ledger import LedgerEntry
 
         leaves = jax.tree_util.tree_leaves(grads_template)
         if not leaves:
             return []
-        dtypes = {str(l.dtype) for l in leaves}
-        return [
-            LedgerEntry(
-                tag="grads",
+
+        def _entry(tag, idxs, count):
+            dtypes = {str(leaves[i].dtype) for i in idxs}
+            return LedgerEntry(
+                tag=tag,
                 layer="reducer",
                 op="all-reduce",
                 axis=axis,
                 dtype=dtypes.pop() if len(dtypes) == 1 else "mixed",
                 # per-leaf analytic bytes (the trainer's bits_per_step model);
                 # equals the packed flat buffer for uniform-dtype params
-                payload_bytes=sum(n_bits(l) for l in leaves) // 8,
-                count=self._n_chunks(leaves) if self.packed else len(leaves),
+                payload_bytes=sum(n_bits(leaves[i]) for i in idxs) // 8,
+                count=count,
+            )
+
+        if self.packed and self.bucket_bytes is not None:
+            return [
+                _entry(
+                    f"grads.b{bi}",
+                    idxs,
+                    _n_chunk_collectives(
+                        sum(int(leaves[i].size) for i in idxs), self.comm_chunks
+                    ),
+                )
+                for bi, idxs in enumerate(self._buckets(leaves))
+            ]
+        all_idx = list(range(len(leaves)))
+        return [
+            _entry(
+                "grads",
+                all_idx,
+                self._n_chunks(leaves) if self.packed else len(leaves),
             )
         ]
 
@@ -223,6 +326,22 @@ class PowerSGDReducer:
     Bitwise identical to the monolithic path; ledger bytes are K-invariant.
     ``comm_strategy="ring"`` swaps each chunk's pmean for the explicit
     ``ppermute`` ring (deterministic, reassociated).
+
+    ``orthogonalize_impl="auto"`` (the default) resolves to the Pallas
+    VMEM-resident Gram-Schmidt kernel on TPU and the XLA ``fori_loop``
+    lowering elsewhere (DESIGN.md: the kernels exist so the TPU default
+    should exercise them); explicit ``"xla"``/``"pallas"`` pin either.
+
+    ``compress_impl="pallas"`` (opt-in; default ``"xla"``) swaps the whole
+    per-bucket compress pipeline for the fused Pallas kernels of
+    ``ops.pallas_powersgd``: the error-feedback add + ``P = M·Q`` ride one
+    kernel, the Gram-Schmidt + ``Q = Mᵀ·P̂`` another (the factor stays in
+    VMEM between them, absorbing ``orthogonalize_impl``), and the
+    decompress + EF-residual a third — one HBM round-trip per shape bucket
+    per stage instead of ~5 separate XLA ops per matrix. Math is identical
+    up to fp32 MXU accumulation order (parity pinned in
+    ``tests/test_pallas_powersgd.py``); on CPU the kernels run in interpret
+    mode, so the fused path stays testable without a chip.
     """
 
     def __init__(
@@ -232,10 +351,11 @@ class PowerSGDReducer:
         reuse_query: bool = True,
         compression_rank: int = 1,
         matricize: str = "first",
-        orthogonalize_impl: str = "xla",
+        orthogonalize_impl: str = "auto",
         compression_dtype=None,
         comm_chunks: Optional[int] = None,
         comm_strategy: str = "interleave",
+        compress_impl: str = "xla",
     ):
         # The reference asserts n_power_iterations == 0 (reducer.py:30 — "0"
         # meaning the single fused iteration). Beyond parity, we support k
@@ -245,7 +365,8 @@ class PowerSGDReducer:
         # static Python unroll — shapes differ per matrix, count is tiny.
         assert n_power_iterations >= 0
         assert matricize in ("first", "last")
-        assert orthogonalize_impl in ("xla", "pallas")
+        assert orthogonalize_impl in ("auto", "xla", "pallas")
+        assert compress_impl in ("xla", "pallas")
         assert comm_strategy in ("interleave", "ring"), comm_strategy
         assert comm_chunks is None or comm_chunks >= 1
         self.comm_chunks = comm_chunks
@@ -261,11 +382,19 @@ class PowerSGDReducer:
         # argument the PowerSGD paper makes for rank truncation). None = the
         # gradients' own dtype (the reference's fp32 behavior).
         self.compression_dtype = jnp.dtype(compression_dtype) if compression_dtype else None
+        # off-TPU the Pallas kernels run in interpret mode (the test path)
+        self._interpret = jax.default_backend() != "tpu"
+        if orthogonalize_impl == "auto":
+            orthogonalize_impl = "pallas" if not self._interpret else "xla"
+        self.orthogonalize_impl = orthogonalize_impl
+        self.compress_impl = compress_impl
         if orthogonalize_impl == "pallas":
             # VMEM-resident Gram-Schmidt TPU kernel (ops.pallas_orthogonalize)
             from ..ops.pallas_orthogonalize import orthogonalize_pallas
 
-            self._orthogonalize = orthogonalize_pallas
+            self._orthogonalize = functools.partial(
+                orthogonalize_pallas, interpret=self._interpret
+            )
         else:
             self._orthogonalize = orthogonalize
 
@@ -384,13 +513,62 @@ class PowerSGDReducer:
         Step numbering follows the reference (``reducer.py:43-170``).
         """
         leaves, treedef = jax.tree_util.tree_flatten(send)
+        return self._reduce(state, leaves, None, treedef, axis_name)
+
+    @jax.named_scope("reduce.powersgd")
+    def reduce_ef(
+        self,
+        state: PowerSGDState,
+        grads: PyTree,
+        memories: PyTree,
+        axis_name: Optional[str],
+    ) -> Tuple[PowerSGDState, PyTree, PyTree, int]:
+        """Error-feedback reduction with the add INSIDE the reducer:
+        mathematically ``reduce(state, grads + memories, axis_name)``, but
+        with ``compress_impl="pallas"`` the high-rank adds fuse into the
+        compress kernel's VMEM pass (``ops.pallas_powersgd``) — the summed
+        send matrix is never materialized as a separate XLA op."""
+        g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+        e_leaves = jax.tree_util.tree_leaves(memories)
+        assert len(e_leaves) == len(g_leaves)
+        return self._reduce(state, g_leaves, e_leaves, treedef, axis_name)
+
+    def _reduce(
+        self,
+        state: PowerSGDState,
+        g_leaves: List[jax.Array],
+        e_leaves: Optional[List[jax.Array]],
+        treedef,
+        axis_name: Optional[str],
+    ) -> Tuple[PowerSGDState, PyTree, PyTree, int]:
+        fused = self.compress_impl == "pallas"
+        interp = self._interpret
+        if fused:
+            from ..ops.pallas_powersgd import (
+                fused_decompress_residual,
+                fused_ef_compress,
+                fused_orthogonalize_project,
+            )
+        # the leaves the rest of the pipeline sees are the SEND values
+        # (grads + error memory). On the fused path the high-rank adds
+        # happen inside the compress kernel instead; rank-1 leaves add here
+        # either way (their error memory is identically zero under the
+        # trainer contract, but reduce_ef keeps the general semantics).
+        if e_leaves is None:
+            leaves = list(g_leaves)
+        elif not fused:
+            leaves = [g + e for g, e in zip(g_leaves, e_leaves)]
+        else:
+            leaves = [
+                g if g.ndim > 1 else g + e
+                for g, e in zip(g_leaves, e_leaves)
+            ]
         rank1_idx, _ = self._split(leaves)
         metas = self._metas(leaves)
         p_packer, q_packer, rank1_packer = self._packers(leaves, metas)
         groups = self._shape_groups(metas)
 
         bits = 0
-        matrices = [leaves[meta.leaf_index].reshape(meta.n, meta.m) for meta in metas]
 
         # Step 2: Q — warm-start from previous step, or re-randomize
         # (reducer.py:100-111)
@@ -404,6 +582,34 @@ class PowerSGDReducer:
                 for t, meta in enumerate(metas)
             ]
 
+        # Step 1/3 (fused): M = G + E and P = M·Q in ONE kernel pass per
+        # shape bucket — the EF add never round-trips HBM on its own. The
+        # kernel writes M back once because steps 6 and 8-9 re-read it.
+        first_ps: Optional[List[jax.Array]] = None
+        if fused and metas and e_leaves is not None:
+            matrices = [None] * len(metas)
+            first_ps = [None] * len(metas)
+            for poss in groups:
+                g_stack = jnp.stack([
+                    g_leaves[metas[p].leaf_index].reshape(metas[p].n, metas[p].m)
+                    for p in poss
+                ])
+                e_stack = jnp.stack([
+                    e_leaves[metas[p].leaf_index].reshape(metas[p].n, metas[p].m)
+                    for p in poss
+                ])
+                q_stack = jnp.stack([qs[p] for p in poss])
+                m_stack, p_stack = fused_ef_compress(
+                    g_stack, q_stack, e_stack, interpret=interp
+                )
+                for j, p in enumerate(poss):
+                    matrices[p] = m_stack[j]
+                    first_ps[p] = p_stack[j]
+        else:
+            matrices = [
+                leaves[meta.leaf_index].reshape(meta.n, meta.m) for meta in metas
+            ]
+
         # Steps 3-7, run (1 + n_power_iterations) times: the reference's single
         # fused round (reducer.py:120-147), plus optional extra subspace
         # iterations on the mean matrix (beyond parity — the reference asserts
@@ -413,10 +619,19 @@ class PowerSGDReducer:
         ps: List[jax.Array] = []
         for it in range(1 + self.n_power_iterations):
             # Step 3: P <- M Q (reducer.py:120-123) — one batched matmul per
-            # distinct matrix shape
-            ps = self._grouped_map(
-                lambda M, Q: M @ Q, groups, matrices, qs, out_len=len(metas)
-            )
+            # distinct matrix shape (fused: the Pallas compress kernel; the
+            # EF-fused first round already produced its Ps above)
+            if it == 0 and first_ps is not None:
+                ps = first_ps
+            elif fused:
+                ps = self._grouped_map(
+                    lambda M, Q: fused_ef_compress(M, Q, interpret=interp)[1],
+                    groups, matrices, qs, out_len=len(metas),
+                )
+            else:
+                ps = self._grouped_map(
+                    lambda M, Q: M @ Q, groups, matrices, qs, out_len=len(metas)
+                )
 
             # Step 4: ALL_REDUCE_MEAN(P) — ONE collective for all Ps
             # (reducer.py:125-128)
@@ -444,21 +659,39 @@ class PowerSGDReducer:
                     for i, o in zip(rank1_idx, rank1_packer.unpack(rank1_reduced))
                 ]
 
-            # Step 5: P_hat <- ORTHOGONALIZE(P) (reducer.py:135-137) —
-            # vmapped over each shape bucket (the pallas kernel stays
-            # per-matrix: its grid is already the whole op)
-            if self._orthogonalize is orthogonalize:
-                ps = self._grouped_map(
-                    jax.vmap(self._orthogonalize), groups, ps, out_len=len(metas)
-                )
+            # Steps 5-6: P_hat <- ORTHOGONALIZE(P), Q <- M^T P_hat
+            # (reducer.py:135-142). Fused: ONE kernel per shape bucket —
+            # the Gram-Schmidt result stays VMEM-resident through the
+            # Q = MᵀP̂ matmul (absorbing ops.pallas_orthogonalize).
+            if fused:
+                next_ps: List[jax.Array] = [None] * len(metas)
+                next_qs: List[jax.Array] = [None] * len(metas)
+                for poss in groups:
+                    p_stack = jnp.stack([ps[p] for p in poss])
+                    m_stack = jnp.stack([matrices[p] for p in poss])
+                    phat_stack, q_stack = fused_orthogonalize_project(
+                        p_stack, m_stack, interpret=interp
+                    )
+                    for j, p in enumerate(poss):
+                        next_ps[p] = phat_stack[j]
+                        next_qs[p] = q_stack[j]
+                ps, qs = next_ps, next_qs
             else:
-                ps = [self._orthogonalize(p) for p in ps]
+                # Step 5: vmapped over each shape bucket (the standalone
+                # pallas GS kernel stays per-matrix: its grid is already
+                # the whole op)
+                if self._orthogonalize is orthogonalize:
+                    ps = self._grouped_map(
+                        jax.vmap(self._orthogonalize), groups, ps, out_len=len(metas)
+                    )
+                else:
+                    ps = [self._orthogonalize(p) for p in ps]
 
-            # Step 6: Q <- M^T P_hat (reducer.py:139-142)
-            qs = self._grouped_map(
-                lambda M, Phat: jnp.einsum("gnm,gnr->gmr", M, Phat),
-                groups, matrices, ps, out_len=len(metas),
-            )
+                # Step 6: Q <- M^T P_hat (reducer.py:139-142)
+                qs = self._grouped_map(
+                    lambda M, Phat: jnp.einsum("gnm,gnr->gmr", M, Phat),
+                    groups, matrices, ps, out_len=len(metas),
+                )
 
             # Step 7: ALL_REDUCE_MEAN(Q) — ONE collective for all Qs
             # (reducer.py:144-147)
@@ -474,16 +707,33 @@ class PowerSGDReducer:
         # (reducer.py:157-163). Rank-1 error memory stays zero: the reference
         # never writes it (reducer.py only touches high-rank memories) and it
         # is zero-initialized in the trainer, so zeros_like is exact parity.
+        # Fused: one kernel per shape bucket computes the P·Qᵀ matmul AND
+        # the residual against the VMEM-resident send matrix M in the same
+        # pass (fp32 accumulation; M is `matrices`, i.e. G+E even when the
+        # add itself was kernel-fused).
         out_leaves = list(leaves)
         mem_leaves = [jnp.zeros_like(l) for l in leaves]
-        approxes = self._grouped_map(
-            lambda P, Q: jnp.einsum("gnr,gmr->gnm", P, Q),
-            groups, ps, qs, out_len=len(metas),
-        )
-        for meta, approx in zip(metas, approxes):
-            approx = approx.reshape(meta.shape)
-            out_leaves[meta.leaf_index] = approx
-            mem_leaves[meta.leaf_index] = leaves[meta.leaf_index] - approx
+        if fused and metas:
+            for poss in groups:
+                p_stack = jnp.stack([ps[p] for p in poss])
+                q_stack = jnp.stack([qs[p] for p in poss])
+                m_stack = jnp.stack([matrices[p] for p in poss])
+                out_stack, mem_stack = fused_decompress_residual(
+                    p_stack, q_stack, m_stack, interpret=interp
+                )
+                for j, pos in enumerate(poss):
+                    meta = metas[pos]
+                    out_leaves[meta.leaf_index] = out_stack[j].reshape(meta.shape)
+                    mem_leaves[meta.leaf_index] = mem_stack[j].reshape(meta.shape)
+        else:
+            approxes = self._grouped_map(
+                lambda P, Q: jnp.einsum("gnr,gmr->gnm", P, Q),
+                groups, ps, qs, out_len=len(metas),
+            )
+            for meta, approx in zip(metas, approxes):
+                approx = approx.reshape(meta.shape)
+                out_leaves[meta.leaf_index] = approx
+                mem_leaves[meta.leaf_index] = leaves[meta.leaf_index] - approx
         for i, reduced in zip(rank1_idx, rank1_out):
             out_leaves[i] = reduced
 

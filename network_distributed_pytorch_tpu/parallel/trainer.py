@@ -305,12 +305,22 @@ def make_step_fn(
         # ~230KB/step of unaccounted BN traffic on ResNet-152).
 
         if algorithm == "ef_momentum":
-            # (Algo 2 line 7) send = g + e  (ddp_init.py:156-157)
-            send = jax.tree_util.tree_map(jnp.add, grads, state.memories)
+            # (Algo 2 line 7) send = g + e  (ddp_init.py:156-157), via the
+            # reducer's error-feedback entry point when it has one: with
+            # the fused Pallas compress path the add happens in VMEM inside
+            # the compress kernel (ops.pallas_powersgd) instead of as a
+            # separate XLA op. Reducers without reduce_ef (the gather-family
+            # compressors) keep the explicit add.
             # (Algo 2 lines 8-11) compress → allreduce → decompress; e updated
-            reducer_state, delta, memories, _ = reducer.reduce(
-                state.reducer_state, send, axis_name
-            )
+            if hasattr(reducer, "reduce_ef"):
+                reducer_state, delta, memories, _ = reducer.reduce_ef(
+                    state.reducer_state, grads, state.memories, axis_name
+                )
+            else:
+                send = jax.tree_util.tree_map(jnp.add, grads, state.memories)
+                reducer_state, delta, memories, _ = reducer.reduce(
+                    state.reducer_state, send, axis_name
+                )
             delta = clip_by_global_norm(delta)
             # (Algo 2 lines 12-13)
             params, momenta = ef_momentum_update(

@@ -121,8 +121,11 @@ class SlotEngine:
             )
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
-        # one program for the engine's lifetime (shapes never change)
-        self._decode = jax.jit(_decode)
+        # one program for the engine's lifetime (shapes never change); the
+        # cache is strictly threaded (step() rebinds self.cache every tick),
+        # so donating it updates the KV buffers in place instead of copying
+        # the engine's largest allocation once per decoded token
+        self._decode = jax.jit(_decode, donate_argnums=(1,))
 
         def _admit(params, cache, prompt, slot):
             # fresh single-request prefill at the ENGINE's cache capacity —
@@ -135,8 +138,9 @@ class SlotEngine:
             first = jnp.argmax(last_logits[0], axis=-1).astype(jnp.int32)
             return first, cache
 
-        # one program per distinct prompt length (slot index is traced)
-        self._admit = jax.jit(_admit)
+        # one program per distinct prompt length (slot index is traced);
+        # cache donated for the same threaded-carry reason as _decode
+        self._admit = jax.jit(_admit, donate_argnums=(1,))
 
     # --- queue interface --------------------------------------------------
 

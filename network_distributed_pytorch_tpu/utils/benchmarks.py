@@ -43,7 +43,7 @@ def time_gpt_train_step(
     seq_len: int = 1024,
     batch: int = 8,
     vocab: int = 50257,
-    attn_impl: str = "einsum",
+    attn_impl: str = "auto",
     scan_layers: bool = False,
     reps: int = 10,
     learning_rate: float = 1e-3,

@@ -50,6 +50,23 @@ class ExperimentConfig:
     # monolithic path) or "ring" (explicit ppermute reduce-scatter/
     # all-gather schedule — deterministic but reassociated, ~1 ulp)
     comm_strategy: str = "interleave"
+    # DDP-style backward-order gradient buckets for the exact reducer
+    # (parallel.comm.bucket_assignments): target bytes per bucket; each
+    # bucket's collective launches as soon as the backward pass has
+    # produced its gradients. None = one monolithic packed collective.
+    bucket_bytes: Optional[int] = None
+
+    # kernel implementation overrides (DESIGN.md "Raw speed"). "auto"
+    # resolves per backend at construction: Pallas kernels on TPU, the XLA
+    # reference lowerings on CPU (where Pallas would only run interpreted).
+    # compress_impl: "xla" | "pallas" — the fused PowerSGD compress
+    # pipeline (ops.pallas_powersgd); opt-in, never implied by "auto".
+    compress_impl: str = "xla"
+    # orthogonalize_impl: "auto" | "xla" | "pallas" — PowerSGD Gram-Schmidt
+    orthogonalize_impl: str = "auto"
+    # attn_impl: None = keep each model's own default ("auto" → flash on
+    # TPU, einsum elsewhere); "einsum" | "flash" | "auto" to force
+    attn_impl: Optional[str] = None
 
     # observability (observe/): structured JSONL run log, jax.profiler trace
     # directory, and the compile-time wire-ledger-vs-HLO audit. audit_wire

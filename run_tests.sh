@@ -19,6 +19,11 @@ cd "$(dirname "$0")"
 # structured JSONL log cannot drift apart
 python scripts/lint_no_print.py
 
+# donation lint: every hot jax.jit in experiments//parallel//serving/ must
+# donate its carry or carry a justified '# lint: no-donate' opt-out — an
+# un-donated train step doubles peak params+optimizer memory
+python scripts/lint_donation.py
+
 mkdir -p artifacts
 
 # Round-6 schedule smoke: AOT-compile (CPU, no execution) one chunked step

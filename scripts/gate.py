@@ -34,6 +34,14 @@ wall-clock grew by more than ``--span-tolerance`` (absolute, default
 0.10) is a regression — e.g. checkpointing creeping from 5% to 20% of the
 run fails the gate even when throughput metrics still pass.
 
+MFU additionally gates against an ABSOLUTE floor when the baseline (or
+report) carries ``mfu_target`` — bench.py publishes one per preset tier
+(``bench.MFU_TARGETS`` / ``BENCH_MFU_TARGET``). The relative comparison
+alone lets a slow regression ratchet: each round can lose just under the
+tolerance against the previous round's baseline, compounding unbounded.
+The floor verdict (``metric: "mfu_vs_target"``) has no tolerance — the
+current MFU is simply below the published tier target or it is not.
+
 Usage::
 
     python scripts/gate.py --report artifacts/run_report.json \
@@ -230,6 +238,37 @@ def compare(
     return verdicts
 
 
+def mfu_target_verdict(
+    current: Dict[str, float], report: Dict, baseline_doc: Dict
+) -> List[Dict]:
+    """Absolute-floor verdict for MFU against the published per-tier
+    target (``mfu_target``, recorded by bench.py into GATE_BASELINE.json
+    and the flagship phase record). No tolerance: the target IS the limit.
+    Emitted only when a current MFU and a target are both available; the
+    baseline's target wins over the report's own (the recorded baseline is
+    the tier the gate compares against)."""
+    mfu = current.get("mfu")
+    target = None
+    for doc in (baseline_doc, report):
+        v = doc.get("mfu_target")
+        if isinstance(v, (int, float)) and v == v and v > 0:
+            target = float(v)
+            break
+    if mfu is None or target is None:
+        return []
+    return [
+        {
+            "metric": "mfu_vs_target",
+            "direction": "higher",
+            "current": mfu,
+            "baseline": target,
+            "limit": target,
+            "ratio": mfu / target,
+            "regressed": mfu < target,
+        }
+    ]
+
+
 def compare_span_shares(
     current: Dict[str, float], baseline: Dict[str, float], tolerance: float
 ) -> List[Dict]:
@@ -303,6 +342,7 @@ def main(argv=None) -> int:
     baseline = extract_metrics(baseline_doc)
 
     verdicts = compare(current, baseline, args.tolerance)
+    verdicts.extend(mfu_target_verdict(current, report, baseline_doc))
     verdicts.extend(
         compare_span_shares(
             extract_span_shares(report),
@@ -326,6 +366,7 @@ def main(argv=None) -> int:
         is_span = v["metric"].startswith("span:")
         tol = (
             f"tol +{args.span_tolerance:.2f} abs" if is_span
+            else "absolute floor" if v["metric"] == "mfu_vs_target"
             else f"tol {args.tolerance:.0%}"
         )
         _say(

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Lint: every hot ``jax.jit`` in the training/serving trees must donate
+its carry (or carry an explicit opt-out).
+
+A compiled train step that does NOT donate its state doubles the peak
+parameter+optimizer memory (input and output buffers live simultaneously)
+and pays an extra device copy per step — the exact regression the donation
+audit closed (DESIGN.md "Raw speed"). This walks the AST of
+``experiments/``, ``parallel/``, and ``serving/`` and fails (exit 1) on
+any ``jax.jit`` call or ``@jax.jit`` decorator that neither passes
+``donate_argnums``/``donate_argnames`` nor is marked with a
+``# lint: no-donate`` comment on or just above the call.
+
+The opt-out is deliberate and must be justified in an adjacent comment:
+legitimate non-donators re-use their inputs — step-replay guards
+(``GuardedStep``/adaptive loops re-run a failed step on its inputs, which
+a donated buffer cannot survive), timing loops that call the same jit
+repeatedly on one batch, and one-shot eval/diagnostic jits with no carry.
+Factory sites that thread ``donate_argnums=(0,) if donate_state else ()``
+pass the lint — the policy decision is the caller's, surfaced as an
+explicit keyword.
+
+Usage::
+
+    python scripts/lint_donation.py            # lint the default trees
+    python scripts/lint_donation.py path [..]  # lint specific trees
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "network_distributed_pytorch_tpu")
+DEFAULT_TREES = [
+    os.path.join(PKG, "experiments"),
+    os.path.join(PKG, "parallel"),
+    os.path.join(PKG, "serving"),
+]
+
+ESCAPE = "lint: no-donate"
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "jit"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "jax"
+    )
+
+
+def _escaped(lines, lineno: int, end_lineno: int) -> bool:
+    """True if ``# lint: no-donate`` appears on the call's lines or in the
+    contiguous comment block immediately above it (the justification is
+    expected to be a multi-line comment)."""
+    hi = min(len(lines), end_lineno)
+    if any(ESCAPE in lines[i] for i in range(lineno - 1, hi)):
+        return True
+    i = lineno - 2  # 0-indexed line above the call
+    while i >= 0 and lines[i].lstrip().startswith("#"):
+        if ESCAPE in lines[i]:
+            return True
+        i -= 1
+    return False
+
+
+def lint_file(path: str):
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    lines = src.splitlines()
+    tree = ast.parse(src, filename=path)
+    rel = os.path.relpath(path, REPO)
+    problems = []
+    for node in ast.walk(tree):
+        # jax.jit(fn, ...) call form
+        if isinstance(node, ast.Call) and _is_jax_jit(node.func):
+            kw = {k.arg for k in node.keywords}
+            if kw & {"donate_argnums", "donate_argnames"}:
+                continue
+            if _escaped(lines, node.lineno, node.end_lineno or node.lineno):
+                continue
+            problems.append(
+                f"{rel}:{node.lineno}: jax.jit without donate_argnums — "
+                f"donate the carry or mark '# {ESCAPE}' with a reason"
+            )
+        # bare @jax.jit decorator form (can never pass donate_argnums)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jax_jit(dec) and not _escaped(
+                    lines, dec.lineno, dec.end_lineno or dec.lineno
+                ):
+                    problems.append(
+                        f"{rel}:{dec.lineno}: bare @jax.jit decorator — "
+                        f"use the call form with donate_argnums or mark "
+                        f"'# {ESCAPE}' with a reason"
+                    )
+    return problems
+
+
+def main(argv) -> int:
+    trees = argv or DEFAULT_TREES
+    problems = []
+    for tree in trees:
+        for dirpath, _dirnames, filenames in os.walk(tree):
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    problems.extend(lint_file(os.path.join(dirpath, name)))
+    for p in problems:
+        sys.stderr.write(f"lint_donation: {p}\n")
+    if problems:
+        sys.stderr.write(f"lint_donation: {len(problems)} problem(s)\n")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -758,6 +758,108 @@ def render_span_section(spans: Dict, top_n: int = 8) -> List[str]:
     return lines
 
 
+def bucket_attribution(
+    bandwidth: Optional[Dict], overlap: Optional[Dict]
+) -> List[Dict]:
+    """Per-bucket exposed-comm rows for DDP backward-order buckets
+    (``ExactReducer(bucket_bytes=...)`` tags its ledger entries
+    ``grads.b<i>``). Each row carries the bucket id, its wire bytes and
+    chunk count, its share of the step's exposed comm budget, and an
+    overlap fraction: how many of the bucket's collectives have backward
+    compute scheduled behind them in the compiled module.
+
+    The per-bucket overlap join is POSITIONAL: buckets are fence-chained in
+    id order, so their collectives occupy the tail of the schedule's sync
+    sequence in that order. It is only trusted when the schedule carries at
+    least as many sync collectives as the buckets' total chunk count
+    (``join: "positional"``); otherwise every bucket falls back to the
+    run-level exposed fraction (``join: "global"``)."""
+    import re as _re
+
+    if not isinstance(bandwidth, dict):
+        return []
+    tagged = []
+    for row in bandwidth.get("by_tag") or []:
+        m = _re.match(r"^grads\.b(\d+)$", str(row.get("tag") or ""))
+        if m:
+            tagged.append((int(m.group(1)), row))
+    if not tagged:
+        return []
+    tagged.sort(key=lambda t: t[0])
+    total_bytes = sum(float(r["payload_bytes"]) for _, r in tagged)
+    counts = [max(1, int(r.get("count", 1))) for _, r in tagged]
+    global_exposed = (bandwidth.get("attribution") or {}).get(
+        "exposed_fraction", 1.0
+    )
+    sync = (overlap or {}).get("sync_collectives") or []
+    positional = len(sync) >= sum(counts)
+    rows = []
+    cursor = len(sync) - sum(counts)  # buckets trail the loss sync
+    for (bucket_id, row), count in zip(tagged, counts):
+        if positional:
+            ops = sync[cursor : cursor + count]
+            cursor += count
+            # a collective is overlapped when compute is scheduled in the
+            # gap behind it; the schedule's final collective has no
+            # successor gap and is always exposed (comm_attribution rule)
+            overlapped = sum(
+                1
+                for op in ops
+                if int(op.get("compute_ops_after") or 0) > 0
+                and op is not sync[-1]
+            )
+            overlap_fraction = overlapped / count
+            join = "positional"
+        else:
+            overlap_fraction = 1.0 - float(global_exposed)
+            join = "global"
+        payload = float(row["payload_bytes"])
+        rows.append(
+            {
+                "bucket": bucket_id,
+                "tag": row.get("tag"),
+                "payload_bytes": payload,
+                "count": count,
+                "share_of_grads_bytes": (
+                    payload / total_bytes if total_bytes else 0.0
+                ),
+                "overlap_fraction": overlap_fraction,
+                "exposed_fraction": 1.0 - overlap_fraction,
+                "comm_time_s": row.get("comm_time_s"),
+                "join": join,
+            }
+        )
+    return rows
+
+
+def render_bucket_section(buckets: List[Dict]) -> List[str]:
+    """The per-bucket exposed-comm table (empty list when the run had no
+    backward-order buckets — the section is omitted entirely)."""
+    if not buckets:
+        return []
+    lines = ["", "backward-bucket comm attribution",
+             "-" * 42]
+    for b in buckets:
+        lines.append(
+            f"  bucket {b['bucket']:<3} {_fmt_bytes(b['payload_bytes']):>12}"
+            f"/step x{b['count']:<3} "
+            f"overlap {b['overlap_fraction']:.2f} "
+            f"(exposed {b['exposed_fraction']:.2f}, "
+            f"{100 * b['share_of_grads_bytes']:.1f}% of grad bytes, "
+            f"join: {b['join']})"
+        )
+    exposed_bytes = sum(
+        b["payload_bytes"] * b["exposed_fraction"] for b in buckets
+    )
+    total = sum(b["payload_bytes"] for b in buckets)
+    if total:
+        lines.append(
+            f"  exposed grad bytes {_fmt_bytes(exposed_bytes)}/step of "
+            f"{_fmt_bytes(total)} ({100 * exposed_bytes / total:.1f}%)"
+        )
+    return lines
+
+
 def render_mfu_section(mfu_records: List[Dict]) -> List[str]:
     """Per-phase MFU + roofline verdicts (already record() dicts)."""
     lines = ["", "mfu & roofline (steady-state)",
@@ -928,6 +1030,8 @@ def run_report(
         merged, stats, stragglers, bandwidth, straggler_factor
     )
     sections.extend(render_mfu_section(mfu_records))
+    comm_buckets = bucket_attribution(bandwidth, overlap)
+    sections.extend(render_bucket_section(comm_buckets))
     # the span attribution section itself renders inside render_report
     # (shared with the single-file mode); here we only keep the summary
     # for the machine-readable report dict
@@ -973,6 +1077,9 @@ def run_report(
         "straggler_factor": straggler_factor,
         "stragglers": [ev.record() for ev in stragglers],
         "bandwidth": bandwidth,
+        # per-bucket exposed-comm attribution (DDP backward-order buckets;
+        # empty when the run used a monolithic packed collective)
+        "comm_buckets": comm_buckets,
         "mfu": mfu_records,
         # the gate's scalar: the best steady-state MFU across phases
         # (higher = better; a regression means the run got less efficient)

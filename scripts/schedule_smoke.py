@@ -7,6 +7,13 @@ carries the decomposed pipeline:
   2. HLO collective payload bytes == ledger bytes (per-chunk itemization
      stays byte-exact against the analytic bits_per_step model).
 
+The bucketed check additionally asserts the DDP backward-overlap property:
+with ``bucket_bytes`` splitting a 3-layer MLP's gradients into per-layer
+buckets, the compiled module must INTERLEAVE reduce ops with backward
+compute fusions (``overlap_report``'s ``sync_interleaved``) — i.e. bucket
+0's collective launches before the earlier layers' gradients are even
+produced, instead of all compute then one blocking comm tail.
+
 Fails loudly on either drift — this is the cheap canary for an XLA upgrade
 (or a comm.py edit) silently un-pipelining the chunk schedule. Runs in a
 few seconds: tiny MLP, ``lower().compile()`` on abstract args only.
@@ -46,15 +53,16 @@ from network_distributed_pytorch_tpu.utils.hlo_audit import (
 from network_distributed_pytorch_tpu.utils.overlap import overlap_report
 
 
-def check(label, reducer, params, mesh):
-    loss = stateless_loss(
+def check(label, reducer, params, mesh, loss=None, batch_abs=None,
+          require_interleave=False):
+    loss = loss or stateless_loss(
         lambda p, b: jnp.mean((b[0] @ p["w"] + p["b"] - b[1]) ** 2)
     )
     step = make_train_step(
         loss, reducer, params, 0.05, mesh=mesh, donate_state=False
     )
     state_abs = jax.eval_shape(step.init_state, params)
-    batch_abs = (
+    batch_abs = batch_abs or (
         jax.ShapeDtypeStruct((16, 32), jnp.float32),
         jax.ShapeDtypeStruct((16, 16), jnp.float32),
     )
@@ -76,6 +84,14 @@ def check(label, reducer, params, mesh):
         )
     rep = overlap_report(hlo)
     interleaved = rep["sync_interleaved"] or rep["n_overlapped"] >= 2
+    if require_interleave and not rep["sync_interleaved"]:
+        errors.append(
+            "backward overlap lost: the bucketed reduce ops are NOT "
+            "interleaved with compute fusions — "
+            f"{rep['n_sync_collectives']} sync collectives, "
+            f"{rep['n_sync_gaps_with_compute']} interior gaps with compute. "
+            "The scheduler re-sank every bucket behind the full backward."
+        )
     status = "ok" if not errors else "FAIL"
     sys.stderr.write(
         f"# schedule-smoke {label}: {status} — {summary['count']} collectives"
@@ -99,6 +115,29 @@ def main() -> int:
         ),
         params,
         mesh,
+    )
+    # DDP backward-order buckets: 3-layer MLP so there are distinct backward
+    # fusions per layer; bucket_bytes=8192 splits the 6 leaves into ~3
+    # buckets in gradient-production order (last layer's grads first). The
+    # compiled HLO must interleave the bucket collectives with that compute.
+    deep_params = {
+        "w1": jnp.zeros((32, 64)), "b1": jnp.zeros((64,)),
+        "w2": jnp.zeros((64, 64)), "b2": jnp.zeros((64,)),
+        "w3": jnp.zeros((64, 16)), "b3": jnp.zeros((16,)),
+    }
+
+    def _deep_loss(p, b):
+        h = jnp.tanh(b[0] @ p["w1"] + p["b1"])
+        h = jnp.tanh(h @ p["w2"] + p["b2"])
+        return jnp.mean((h @ p["w3"] + p["b3"] - b[1]) ** 2)
+
+    errors += check(
+        "exact-bucketed",
+        ExactReducer(bucket_bytes=8192),
+        deep_params,
+        mesh,
+        loss=stateless_loss(_deep_loss),
+        require_interleave=True,
     )
     for e in errors:
         sys.stderr.write(f"# schedule-smoke ERROR: {e}\n")

@@ -1,0 +1,385 @@
+"""Fused Pallas PowerSGD kernels (``ops.pallas_powersgd``) vs NumPy and vs
+the reference XLA pipeline (interpret mode on CPU; the same kernels compile
+for TPU with Mosaic).
+
+Three layers of pinning:
+
+- kernel level: each fused op against plain NumPy fp32 math, including
+  ragged (non-tile-multiple) matrix shapes;
+- reducer level: ``PowerSGDReducer(compress_impl="pallas")`` against the
+  default XLA pipeline for r ∈ {1, 4, 8}, uneven shape-bucket tails,
+  rank-clipped matrices, and the bf16 wire dtype — same bits, same state,
+  same out/mem up to fp32 accumulation order;
+- step level: a full ef_momentum train step (grads flowing through the
+  fused compress/decompress) lands on the same params as the XLA step.
+
+Plus the bucketed-backward twin: ``ExactReducer(bucket_bytes=B)`` must stay
+BITWISE identical to the monolithic reduction for K ∈ {1, 4} buckets (an
+all-reduce is elementwise, so partitioning the payload commutes with it).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from network_distributed_pytorch_tpu.ops.pallas_powersgd import (
+    fused_decompress_residual,
+    fused_ef_compress,
+    fused_orthogonalize_project,
+)
+from network_distributed_pytorch_tpu.parallel import (
+    DATA_AXIS,
+    ExactReducer,
+    PowerSGDReducer,
+    make_mesh,
+)
+from network_distributed_pytorch_tpu.parallel.reducers import PowerSGDState
+from oracle_powersgd import orthogonalize_np
+
+W = 8
+
+
+def _bits(x):
+    """uint bit-pattern view — equality here is BITWISE, not allclose."""
+    x = np.asarray(x)
+    return x.view({2: np.uint16, 4: np.uint32, 8: np.uint64}[x.dtype.itemsize])
+
+
+def _rand(key, shape, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, dtype=dtype)
+
+
+# ---- kernel level: fused ops vs NumPy fp32 math ---------------------------
+
+
+@pytest.mark.parametrize("g,n,m,r", [(1, 64, 32, 4), (3, 100, 37, 8), (2, 5, 3, 2)])
+def test_fused_ef_compress_matches_numpy(g, n, m, r):
+    """M = G + E and P = M·Q, ragged shapes included (interpret mode has no
+    tile constraint; the BlockSpec carries whole matrices)."""
+    grads = _rand(1, (g, n, m))
+    resid = _rand(2, (g, n, m))
+    q = _rand(3, (g, m, r))
+    m_out, p_out = fused_ef_compress(grads, q, resid, interpret=True)
+    exp_m = np.asarray(grads) + np.asarray(resid)
+    exp_p = np.einsum("gnm,gmr->gnr", exp_m, np.asarray(q))
+    np.testing.assert_allclose(np.asarray(m_out), exp_m, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(p_out), exp_p, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_compress_without_residual_is_plain_matmul():
+    grads = _rand(4, (2, 48, 16))
+    q = _rand(5, (2, 16, 4))
+    m_out, p_out = fused_ef_compress(grads, q, interpret=True)
+    # no EF add → the send matrix IS the gradient (modulo the jit boundary)
+    np.testing.assert_array_equal(_bits(m_out), _bits(grads))
+    np.testing.assert_allclose(
+        np.asarray(p_out),
+        np.einsum("gnm,gmr->gnr", np.asarray(grads), np.asarray(q)),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("g,n,m,r", [(1, 64, 32, 4), (2, 100, 37, 8), (2, 6, 9, 1)])
+def test_fused_orthogonalize_project_matches_numpy(g, n, m, r):
+    p = _rand(6, (g, n, r))
+    mat = _rand(7, (g, n, m))
+    phat, q = fused_orthogonalize_project(p, mat, interpret=True)
+    for i in range(g):
+        exp_phat = orthogonalize_np(np.asarray(p)[i])
+        np.testing.assert_allclose(
+            np.asarray(phat)[i], exp_phat, rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(q)[i], np.asarray(mat)[i].T @ exp_phat,
+            rtol=1e-4, atol=1e-4,
+        )
+
+
+def test_fused_orthogonalize_output_is_orthonormal():
+    phat, _ = fused_orthogonalize_project(
+        _rand(8, (3, 200, 6)), _rand(9, (3, 200, 10)), interpret=True
+    )
+    for i in range(3):
+        p = np.asarray(phat)[i]
+        np.testing.assert_allclose(p.T @ p, np.eye(6), atol=1e-4)
+
+
+@pytest.mark.parametrize("g,n,m,r", [(1, 64, 32, 4), (3, 100, 37, 8)])
+def test_fused_decompress_residual_matches_numpy(g, n, m, r):
+    p = _rand(10, (g, n, r))
+    q = _rand(11, (g, m, r))
+    mat = _rand(12, (g, n, m))
+    out, mem = fused_decompress_residual(p, q, mat, interpret=True)
+    exp_out = np.einsum("gnr,gmr->gnm", np.asarray(p), np.asarray(q))
+    np.testing.assert_allclose(np.asarray(out), exp_out, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(mem), np.asarray(mat) - exp_out, rtol=1e-4, atol=1e-5
+    )
+
+
+def test_fused_decompress_bf16_accumulates_in_fp32():
+    """The EF residual on a bf16 wire must be fp32 math cast ONCE at the
+    end — bitwise equal to the fp32 NumPy computation, not to a bf16
+    accumulation chain (r=8 inner products would diverge there)."""
+    p = _rand(13, (2, 64, 8)).astype(jnp.bfloat16)
+    q = _rand(14, (2, 32, 8)).astype(jnp.bfloat16)
+    mat = _rand(15, (2, 64, 32)).astype(jnp.bfloat16)
+    out, mem = fused_decompress_residual(p, q, mat, interpret=True)
+    assert out.dtype == jnp.bfloat16 and mem.dtype == jnp.bfloat16
+    exp_out = np.einsum(
+        "gnr,gmr->gnm",
+        np.asarray(p, np.float32), np.asarray(q, np.float32),
+    )
+    exp_mem = np.asarray(mat, np.float32) - exp_out
+    np.testing.assert_array_equal(
+        _bits(mem), _bits(jnp.asarray(exp_mem).astype(jnp.bfloat16))
+    )
+    np.testing.assert_array_equal(
+        _bits(out), _bits(jnp.asarray(exp_out).astype(jnp.bfloat16))
+    )
+
+
+# ---- reducer level: fused pipeline vs the XLA reference -------------------
+
+
+def _template_leaves(key):
+    """A CNN-ish mix: conv-like 4D, linear-like 2D, and rank-1 bias leaves."""
+    ks = jax.random.split(key, 5)
+    return [
+        jax.random.normal(ks[0], (8, 3, 3, 3)),
+        jax.random.normal(ks[1], (16, 8)),
+        jax.random.normal(ks[2], (16,)),
+        jax.random.normal(ks[3], (10, 16)),
+        jax.random.normal(ks[4], (10,)),
+    ]
+
+
+def _ragged_leaves(key):
+    """Uneven shape buckets: three (16, 8) twins in ONE group (a ragged
+    stack of 3 next to singleton groups), a (10, 16), and a (2, 3) whose
+    rank clips to min(n, m) below every tested compression_rank."""
+    ks = jax.random.split(key, 6)
+    return [
+        jax.random.normal(ks[0], (16, 8)),
+        jax.random.normal(ks[1], (16, 8)),
+        jax.random.normal(ks[2], (16, 8)),
+        jax.random.normal(ks[3], (10, 16)),
+        jax.random.normal(ks[4], (2, 3)),
+        jax.random.normal(ks[5], (7,)),
+    ]
+
+
+def _compare_impls(template_fn, rank, seed, dtype_kw=None, rtol=2e-4, atol=1e-4):
+    """reduce_ef (nonzero memories → the EF-fused kernel) on the fused and
+    XLA paths: same bits, same state, same out/mem up to fp32 accumulation
+    order. Single-process (axis_name=None) — the collectives are identity,
+    so this isolates the compress pipeline itself."""
+    kwargs = dict(random_seed=seed, compression_rank=rank, **(dtype_kw or {}))
+    grads = [jnp.asarray(l) for l in template_fn(jax.random.PRNGKey(seed))]
+    mems = [
+        m if m.ndim <= 1 else m * 0.3
+        for m in (jnp.zeros_like(l) if l.ndim <= 1 else l for l in
+                  template_fn(jax.random.PRNGKey(seed + 1)))
+    ]
+    results = {}
+    for impl in ("xla", "pallas"):
+        reducer = PowerSGDReducer(compress_impl=impl, **kwargs)
+        state = reducer.init(grads)
+        results[impl] = reducer.reduce_ef(state, grads, mems, None)
+    (st_x, out_x, mem_x, bits_x) = results["xla"]
+    (st_p, out_p, mem_p, bits_p) = results["pallas"]
+    assert bits_p == bits_x
+    np.testing.assert_allclose(
+        np.asarray(st_p.q_memory), np.asarray(st_x.q_memory),
+        rtol=rtol, atol=atol,
+    )
+    for a, b in zip(out_p + mem_p, out_x + mem_x):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=rtol, atol=atol,
+        )
+
+
+@pytest.mark.parametrize("rank", [1, 4, 8])
+def test_fused_reducer_matches_xla(rank):
+    _compare_impls(_template_leaves, rank, seed=17 + rank)
+
+
+@pytest.mark.parametrize("rank", [1, 4, 8])
+def test_fused_reducer_matches_xla_ragged_buckets(rank):
+    _compare_impls(_ragged_leaves, rank, seed=29 + rank)
+
+
+def test_fused_reducer_matches_xla_bf16_wire():
+    # bf16 on the wire, fp32 in the kernels: both impls quantize at the
+    # same packer boundaries, so they still agree to bf16 resolution
+    _compare_impls(
+        _template_leaves, 4, seed=41,
+        dtype_kw=dict(compression_dtype=jnp.bfloat16), rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_fused_reducer_ef_identity():
+    """send = out + memory exactly on the fused path too, per high-rank
+    leaf — decompress subtracts against the VMEM-resident M = G + E."""
+    reducer = PowerSGDReducer(
+        random_seed=5, compression_rank=4, compress_impl="pallas"
+    )
+    grads = [jnp.asarray(l) for l in _template_leaves(jax.random.PRNGKey(7))]
+    mems = [jnp.zeros_like(l) if l.ndim <= 1 else l * 0.5
+            for l in _template_leaves(jax.random.PRNGKey(8))]
+    _, out, mem, _ = reducer.reduce_ef(reducer.init(grads), grads, mems, None)
+    for g, e, o, m in zip(grads, mems, out, mem):
+        if g.ndim > 1:
+            np.testing.assert_allclose(
+                np.asarray(o) + np.asarray(m), np.asarray(g) + np.asarray(e),
+                rtol=1e-5, atol=1e-5,
+            )
+
+
+def test_fused_reducer_matches_xla_multiworker(devices):
+    """8-device shard_map: the fused pipeline slots between the SAME P/Q
+    collectives (same placement, same bits) as the reference."""
+    mesh = make_mesh()
+    template = [jnp.zeros_like(l) for l in _template_leaves(jax.random.PRNGKey(0))]
+    per_worker = [_template_leaves(jax.random.PRNGKey(100 + w)) for w in range(W)]
+    stacked = [jnp.stack([pw[i] for pw in per_worker]) for i in range(5)]
+
+    def run(impl):
+        reducer = PowerSGDReducer(
+            random_seed=11, compression_rank=2, compress_impl=impl
+        )
+        state = reducer.init(template)
+
+        def f(q_memory, key, *send):
+            send = [s[0] for s in send]
+            st, out, mem, _ = reducer.reduce(
+                PowerSGDState(q_memory, key), send, DATA_AXIS
+            )
+            return (
+                st.q_memory,
+                tuple(o[None] for o in out),
+                tuple(m[None] for m in mem),
+            )
+
+        return jax.jit(
+            jax.shard_map(
+                f,
+                mesh=mesh,
+                in_specs=(P(), P()) + (P(DATA_AXIS),) * 5,
+                out_specs=(P(), (P(DATA_AXIS),) * 5, (P(DATA_AXIS),) * 5),
+            )
+        )(state.q_memory, state.key, *stacked)
+
+    q_x, out_x, mem_x = run("xla")
+    q_p, out_p, mem_p = run("pallas")
+    np.testing.assert_allclose(
+        np.asarray(q_p), np.asarray(q_x), rtol=2e-4, atol=1e-4
+    )
+    for a, b in zip(out_p + mem_p, out_x + mem_x):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-4
+        )
+
+
+# ---- step level: grads through the fused path -----------------------------
+
+
+def test_train_step_fused_matches_xla(devices):
+    """Full ef_momentum steps (the trainer's reduce_ef → fused EF add →
+    compress → decompress → SGD update) land on the same params."""
+    from network_distributed_pytorch_tpu.models import SmallCNN
+    from network_distributed_pytorch_tpu.parallel.trainer import (
+        make_train_step,
+        stateless_loss,
+    )
+    from network_distributed_pytorch_tpu.utils import cross_entropy_loss
+
+    img = (8, 8, 3)
+    model = SmallCNN(width=4)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, *img)))["params"]
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return cross_entropy_loss(model.apply({"params": p}, x), y)
+
+    loss_fn = stateless_loss(loss_fn)
+    mesh = make_mesh()
+
+    def run(impl):
+        reducer = PowerSGDReducer(
+            random_seed=3, compression_rank=2, compress_impl=impl
+        )
+        step = make_train_step(
+            loss_fn, reducer, params, learning_rate=0.05, momentum=0.9,
+            algorithm="ef_momentum", mesh=mesh, donate_state=False,
+        )
+        state = step.init_state(params)
+        for i in range(3):
+            ky, kx = jax.random.split(jax.random.PRNGKey(i))
+            y = jax.random.randint(ky, (64,), 0, 10)
+            x = jax.random.normal(kx, (64, *img))
+            state, _ = step(state, (x, y))
+        return state
+
+    s_x = run("xla")
+    s_p = run("pallas")
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s_p.params),
+        jax.tree_util.tree_leaves(s_x.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4
+        )
+
+
+# ---- bucketed backward overlap: bitwise identity --------------------------
+
+
+def _run_exact(reducer, stacked):
+    mesh = make_mesh()
+
+    def f(*send):
+        send = [s[0] for s in send]
+        _, out, _, _ = reducer.reduce({}, send, DATA_AXIS)
+        return tuple(o[None] for o in out)
+
+    return jax.jit(
+        jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(P(DATA_AXIS),) * 5, out_specs=(P(DATA_AXIS),) * 5,
+        )
+    )(*stacked)
+
+
+@pytest.mark.parametrize("bucket_bytes", [10**9, 60])
+def test_bucketed_exact_bitwise_equals_monolithic(devices, bucket_bytes):
+    """One giant bucket (K=1) and 4 small buckets (K=4): partitioning the packed
+    payload commutes with the elementwise all-reduce, so the fenced bucket
+    chain is BITWISE the monolithic reduction."""
+    per_worker = [_template_leaves(jax.random.PRNGKey(50 + w)) for w in range(W)]
+    stacked = [jnp.stack([pw[i] for pw in per_worker]) for i in range(5)]
+    reducer = ExactReducer(bucket_bytes=bucket_bytes)
+    n_buckets = len(reducer._buckets([pw for pw in per_worker[0]]))
+    assert n_buckets == (1 if bucket_bytes == 10**9 else 4)
+    mono = _run_exact(ExactReducer(), stacked)
+    bucketed = _run_exact(reducer, stacked)
+    for a, b in zip(bucketed, mono):
+        np.testing.assert_array_equal(_bits(a), _bits(b))
+
+
+@pytest.mark.parametrize("bucket_bytes", [10**9, 60])
+def test_bucketed_ledger_bytes_invariant(bucket_bytes):
+    """The buckets partition the leaves: ledger bytes are invariant and the
+    entries itemize one backward-order bucket each."""
+    template = _template_leaves(jax.random.PRNGKey(0))
+    mono = ExactReducer()
+    bucketed = ExactReducer(bucket_bytes=bucket_bytes)
+    total = sum(e.payload_bytes * 1 for e in mono.ledger_entries(template))
+    entries = bucketed.ledger_entries(template)
+    assert sum(e.payload_bytes for e in entries) == total
+    assert [e.tag for e in entries] == [
+        f"grads.b{i}" for i in range(len(entries))
+    ]
