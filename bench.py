@@ -1538,6 +1538,19 @@ def _record_gate_baseline(out: dict, status: dict) -> None:
     mfu_target = out.get("mfu_target")
     if isinstance(mfu_target, (int, float)) and mfu_target > 0:
         rec["mfu_target"] = float(mfu_target)
+    # live-plane alert count from the newest probe run report (when one
+    # exists): rides along so gate.py's lower-is-better alerts_fired
+    # metric has a recorded reference. Zero is the healthy value and is
+    # recorded as such — a later round that starts firing MORE alerts than
+    # this baseline regresses the health envelope
+    try:
+        with open(os.path.join(HERE, "artifacts", "run_report.json")) as f:
+            doc = json.load(f)
+        fired = (doc.get("alerts") or {}).get("fired")
+        if isinstance(fired, (int, float)) and fired >= 0:
+            rec["alerts_fired"] = float(fired)
+    except (OSError, ValueError):
+        pass
     path = os.path.join(HERE, "artifacts", "GATE_BASELINE.json")
     try:
         os.makedirs(os.path.join(HERE, "artifacts"), exist_ok=True)

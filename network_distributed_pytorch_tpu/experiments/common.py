@@ -142,6 +142,7 @@ def train_loop(
     trace_dir: Optional[str] = None,
     audit: bool = False,
     run_name: str = "train",
+    health_every: int = 0,
 ) -> Tuple[TrainState, MetricsLogger]:
     """Run ``epochs`` passes, logging loss / step-time / cumulative bits
     (the reference's per-epoch banner + the bits it never reported).
@@ -167,11 +168,19 @@ def train_loop(
     checkpointing), and an ``on_step_end(epoch, steps_done, state) ->
     stop?`` callback after every completed step — returning True ends the
     loop early with the current state (the preemption-grace shutdown path).
+
+    ``health_every > 0`` (with a step carrying a ``health_fn`` and a
+    telemetry): every N completed steps the loop dispatches the separately
+    jitted health probe on the step's OWN batch and emits a
+    ``TrainHealthEvent`` (grad norm, EF memory norm, PowerSGD relative
+    compression error) — the live plane's NaN-precursor feed. Off the hot
+    path by construction: a distinct dispatch that reads state, never
+    mutates it; cost documented in DESIGN.md "health sampling".
     """
     import contextlib
 
     from ..data import device_prefetch
-    from ..observe import FailureEvent
+    from ..observe import FailureEvent, TrainHealthEvent
     from ..observe.spans import recording, span
     from ..parallel.mesh import DATA_AXIS, data_sharding
     from ..utils.profiling import step_annotation, trace
@@ -247,6 +256,43 @@ def train_loop(
                         loss = jax.device_get(loss)
                 logger.end_step(epoch, loss)
                 steps_done += 1
+                health_fn = getattr(step, "health_fn", None)
+                if (
+                    health_every > 0
+                    and health_fn is not None
+                    and telemetry is not None
+                    and logger._step % health_every == 0
+                ):
+                    # separately dispatched probe on the step's own batch —
+                    # the batch is NOT donated, so its buffers are live; the
+                    # probe reads the (new) state without mutating it
+                    with span("health_probe", step=logger._step):
+                        try:
+                            stats = jax.device_get(health_fn(state, batch))
+                            telemetry.emit(
+                                TrainHealthEvent(
+                                    step=logger._step,
+                                    epoch=epoch,
+                                    grad_norm=float(stats["grad_norm"]),
+                                    ef_memory_norm=float(
+                                        stats["ef_memory_norm"]
+                                    ),
+                                    powersgd_rel_error=float(
+                                        stats["powersgd_rel_error"]
+                                    ),
+                                    loss=float(stats["loss"]),
+                                    rank=rank,
+                                    label=run_name,
+                                )
+                            )
+                        except Exception as e:  # advisory, never fatal
+                            telemetry.emit(
+                                FailureEvent(
+                                    kind="health_probe_error",
+                                    label=run_name,
+                                    message=f"{type(e).__name__}: {e}",
+                                )
+                            )
                 if heartbeat is not None:
                     heartbeat.beat(epoch=epoch)
                 if on_step_end is not None and on_step_end(
@@ -491,6 +537,8 @@ def adaptive_train_loop(
     escalate_after: int = 3,
     step_retries: int = 2,
     stragglers_for_epoch: Optional[Callable[[int], int]] = None,
+    health_every: int = 0,
+    alert_feed: Any = None,
 ) -> Tuple[TrainState, MetricsLogger, Any]:
     """The degraded-fabric survival loop: :func:`train_loop`'s epoch/step
     structure, driven by a rebuildable step and closed through the
@@ -527,12 +575,24 @@ def adaptive_train_loop(
     lands in telemetry via ``controller.record`` with predicted (new
     rung's static ledger) vs realized (old rung, measured) bytes/step.
 
+    Live-plane hooks (PR 10): ``health_every > 0`` emits a
+    ``TrainHealthEvent`` every N steps via the step's ``health_fn`` probe
+    (same contract as :func:`train_loop`). ``alert_feed`` (an
+    ``observe.live.AlertFeed`` tailing the run's ``alerts.jsonl``) is
+    polled every step; each alert record is offered to
+    ``controller.nudge`` — a critical or comm-shaped alert descends one
+    rung IMMEDIATELY (mid-epoch rebuild, same single-recompile budget as a
+    boundary decision, just paid early), other warns pre-charge the
+    boundary hysteresis. The nudged epoch's boundary ``observe`` is a
+    no-op (the controller self-enforces it).
+
     Returns ``(state, logger, controller)``.
     """
     import contextlib
     import statistics
     import time as _time
 
+    from ..observe import FailureEvent, TrainHealthEvent
     from ..observe.spans import recording, span
     from ..parallel import comm
     from ..resilience.controller import EpochHealth
@@ -577,6 +637,27 @@ def adaptive_train_loop(
     # the epoch p50 the controller compares against — excluded from
     # step_times (still logged through the MetricsLogger)
     compile_grace = 2
+
+    def _rebuild(decision) -> None:
+        # ONE recompile per decision: rebuild at the new rung and carry
+        # the training state across the switch. Shared by the boundary
+        # observe and the mid-epoch alert nudge — the nudge spends the
+        # same single-recompile budget, just before the epoch edge.
+        nonlocal base, state, guard, compile_grace
+        realized = base.bits_per_step / 8
+        new_base = step_factory(controller.overrides)
+        carried_model = base.eval_model_state(state)
+        new_state = new_base.init_state(state.params, carried_model)
+        new_state = new_state._replace(momenta=state.momenta)
+        base, state = new_base, new_state
+        guard = _guard(base)
+        compile_grace = 2
+        controller.record(
+            decision,
+            predicted_bytes_per_step=base.bits_per_step / 8,
+            realized_bytes_per_step=realized,
+        )
+
     try:
         with recording(telemetry) if telemetry is not None else contextlib.nullcontext():
             for epoch in range(epochs):
@@ -597,6 +678,55 @@ def adaptive_train_loop(
                         step_times.append(_time.monotonic() - t0)
                     logger.end_step(epoch, loss, bits=base.bits_per_step)
                     gstep += 1
+                    health_fn = getattr(base, "health_fn", None)
+                    if (
+                        health_every > 0
+                        and health_fn is not None
+                        and telemetry is not None
+                        and gstep % health_every == 0
+                    ):
+                        with span("health_probe", step=gstep):
+                            try:
+                                stats = jax.device_get(
+                                    health_fn(state, batch)
+                                )
+                                telemetry.emit(
+                                    TrainHealthEvent(
+                                        step=gstep,
+                                        epoch=epoch,
+                                        grad_norm=float(stats["grad_norm"]),
+                                        ef_memory_norm=float(
+                                            stats["ef_memory_norm"]
+                                        ),
+                                        powersgd_rel_error=float(
+                                            stats["powersgd_rel_error"]
+                                        ),
+                                        loss=float(stats["loss"]),
+                                        rank=rank,
+                                        label=run_name,
+                                    )
+                                )
+                            except Exception as e:  # advisory, never fatal
+                                telemetry.emit(
+                                    FailureEvent(
+                                        kind="health_probe_error",
+                                        label=run_name,
+                                        message=f"{type(e).__name__}: {e}",
+                                    )
+                                )
+                    if alert_feed is not None:
+                        # the live plane's feedback channel: alerts the
+                        # supervisor-side detectors appended to
+                        # alerts.jsonl reach the controller HERE, before
+                        # the epoch boundary
+                        for rec in alert_feed.poll():
+                            d = controller.nudge(
+                                rec.get("alert", ""),
+                                epoch,
+                                severity=rec.get("severity", "warn"),
+                            )
+                            if d is not None:
+                                _rebuild(d)
                 logger.end_epoch(epoch, rank=rank)
                 if not step_times:
                     continue
@@ -620,21 +750,7 @@ def adaptive_train_loop(
                 decision = controller.observe(health)
                 if decision is None:
                     continue
-                # ONE recompile per decision: rebuild at the new rung and
-                # carry the state across the switch
-                realized = bytes_per_step
-                new_base = step_factory(controller.overrides)
-                carried_model = base.eval_model_state(state)
-                new_state = new_base.init_state(state.params, carried_model)
-                new_state = new_state._replace(momenta=state.momenta)
-                base, state = new_base, new_state
-                guard = _guard(base)
-                compile_grace = 2
-                controller.record(
-                    decision,
-                    predicted_bytes_per_step=base.bits_per_step / 8,
-                    realized_bytes_per_step=realized,
-                )
+                _rebuild(decision)
     finally:
         if injector is not None:
             comm.remove_fence_hook(injector)

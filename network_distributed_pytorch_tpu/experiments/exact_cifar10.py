@@ -270,12 +270,22 @@ def run(
             controller = FallbackController(
                 telemetry=telemetry, rank=config.process_id,
             )
+            # under a supervised run, tail the run's alerts.jsonl so the
+            # live plane's detectors can nudge the controller mid-epoch
+            import os as _os
+
+            from ..observe import runlog as _runlog
+            from ..observe.live import AlertFeed
+
+            _run_dir = _os.environ.get(_runlog.ENV_RUN_DIR)
+            feed = AlertFeed(_run_dir) if _run_dir else None
             state, logger, controller = adaptive_train_loop(
                 _build_step, params, model_state, batches,
                 config.training_epochs, controller,
                 injector=injector, telemetry=telemetry,
                 rank=config.process_id, log_every=config.log_every,
                 run_name="exact_cifar10", fabric=config.comm_fabric,
+                health_every=config.health_every, alert_feed=feed,
             )
         else:
             state, logger = train_loop(
@@ -286,6 +296,7 @@ def run(
                 trace_dir=config.trace_dir,
                 audit=audit_from_config(config),
                 run_name="exact_cifar10",
+                health_every=config.health_every,
             )
     finally:
         telemetry.close()

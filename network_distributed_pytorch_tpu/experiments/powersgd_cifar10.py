@@ -100,6 +100,7 @@ def run(
             trace_dir=config.trace_dir,
             audit=audit_from_config(config),
             run_name="powersgd_cifar10",
+            health_every=config.health_every,
         )
     finally:
         telemetry.close()

@@ -93,6 +93,14 @@ def run(
     )["params"]
 
     telemetry = telemetry_from_config(config)
+    # in-process live-plane adapter: every RequestEvent the engine emits
+    # also lands in a MetricRegistry (serving SLO split — queue / decode /
+    # total summaries, ms-per-token histogram), so an embedding process can
+    # serve /metrics straight off this registry with no run dir at all
+    from ..observe.live import MetricRegistry, MetricSink
+
+    registry = MetricRegistry()
+    telemetry.add_sink(MetricSink(registry))
     try:
         ckpt_step = None
         if checkpoint_dir is not None:
@@ -159,6 +167,11 @@ def run(
                 decode_lengths, slots
             ),
             "slo": slo_summary(finished),
+            # the live registry's view of the same run — proves the
+            # MetricSink path agrees with the post-hoc slo_summary
+            "live_requests_total": registry.get_counter(
+                "live_serving_requests_total", state="finished"
+            ),
             "device": getattr(
                 jax.devices()[0], "device_kind", jax.devices()[0].platform
             ),

@@ -134,6 +134,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="gpt_lm only: run decoder blocks as one lax.scan with stacked"
              " params — ~n_layers× smaller HLO and compile time, same math",
     )
+    p.add_argument(
+        "--health-every", type=int, default=None,
+        help="emit a TrainHealthEvent (grad norm, EF memory norm, PowerSGD"
+             " relative compression error) every N steps via the separately"
+             " jitted health probe — the live plane's NaN-precursor feed"
+             " (cifar experiments; 0/unset = never, zero overhead)",
+    )
     p.add_argument("--preset", choices=["small", "full"], default="small")
     p.add_argument("--data-dir", type=str, default="./data")
     p.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32")
@@ -302,6 +309,20 @@ def build_parser() -> argparse.ArgumentParser:
         help="supervise: per-rank-per-incarnation worker stdout logs",
     )
     p.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="supervise + --run-dir: serve the live telemetry plane's"
+             " Prometheus-text /metrics endpoint on this port (0 ="
+             " ephemeral; the bound port is advertised in"
+             " <run-dir>/metrics_port). Unset = live plane off",
+    )
+    p.add_argument(
+        "--alert-restart-after", type=int, default=0,
+        help="supervise live plane: restart a rank after this many"
+             " sustained CRITICAL alerts attributed to it (the NaN-"
+             "precursor path; restarts spend the ordinary restart budget;"
+             " 0 = log-only)",
+    )
+    p.add_argument(
         "--event-log", type=str, default=None,
         help="append structured JSONL telemetry (steps, wire ledger, compile"
              " audits) to this path; read it back with scripts/report.py",
@@ -368,6 +389,8 @@ def config_from_args(args) -> ExperimentConfig:
     cfg.adaptive_comm = args.adaptive_comm
     if args.comm_fabric is not None:
         cfg.comm_fabric = args.comm_fabric
+    if args.health_every is not None:
+        cfg.health_every = args.health_every
     return cfg
 
 
@@ -382,6 +405,8 @@ _SUPERVISOR_FLAGS = {
     "--min-world-size": True,
     "--no-degraded": False,
     "--worker-log-dir": True,
+    "--metrics-port": True,
+    "--alert-restart-after": True,
     # re-appended per worker with the supervisor's own numbering
     "--process-id": True,
     "--num-processes": True,
@@ -440,6 +465,8 @@ def _supervise(args, argv) -> dict:
                 allow_degraded=not args.no_degraded,
                 min_world_size=args.min_world_size,
                 seed=args.seed,
+                metrics_port=args.metrics_port,
+                alert_restart_after=args.alert_restart_after,
             ),
             telemetry=telemetry,
             log_dir=args.worker_log_dir,
@@ -474,6 +501,10 @@ def _supervise(args, argv) -> dict:
 
 def main(argv=None) -> dict:
     args = build_parser().parse_args(argv)
+    if args.metrics_port is not None and not (args.supervise and args.run_dir):
+        raise ValueError("--metrics-port requires --supervise and --run-dir")
+    if args.alert_restart_after and not args.supervise:
+        raise ValueError("--alert-restart-after requires --supervise")
     if args.supervise:
         return _supervise(args, argv if argv is not None else sys.argv[1:])
     if args.run_dir:

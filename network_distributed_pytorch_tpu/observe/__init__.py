@@ -35,6 +35,12 @@ that loop as a first-class subsystem instead of scattered fragments:
   device tables, the analytic-vs-``cost_analysis`` FLOPs join, and the
   roofline verdict (compute / hbm / comm-exposed) as typed ``MfuEvent``
   records.
+- :mod:`observe.live`      — the LIVE plane: streaming metric registry,
+  resumable shard tailing, the supervisor-side aggregator, and the
+  Prometheus-text ``/metrics`` exposition server.
+- :mod:`observe.health`    — EWMA streaming detectors (grad-norm spike,
+  loss plateau, step-time drift, bandwidth collapse, serving SLO burn)
+  emitting typed ``AlertEvent`` records back into the control plane.
 
 ``scripts/report.py`` turns a JSONL run log back into a human report
 (step-time percentiles, bytes/step by tag, compression ratio,
@@ -47,9 +53,10 @@ Everything imported here is jax-free, so the bench parent orchestrator
 (which deliberately imports no jax) can use the same sinks.
 """
 
-from . import analytics, mfu, runlog, spans  # noqa: F401
+from . import analytics, health, live, mfu, runlog, spans  # noqa: F401
 from .events import (  # noqa: F401
     SCHEMA_VERSION,
+    AlertEvent,
     CollectiveEvent,
     CompileEvent,
     DataDropEvent,
@@ -65,6 +72,7 @@ from .events import (  # noqa: F401
     SpanEvent,
     StepEvent,
     StragglerEvent,
+    TrainHealthEvent,
 )
 from .ledger import LedgerEntry, WireLedger  # noqa: F401
 from .spans import recording, set_ambient, span  # noqa: F401

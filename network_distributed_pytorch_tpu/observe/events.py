@@ -396,6 +396,67 @@ class RequestEvent(Event):
 
 
 @dataclass
+class TrainHealthEvent(Event):
+    """Periodic training-health sample — the runtime view of the paper's
+    central tradeoff (compression rank vs. gradient fidelity). Emitted
+    every ``--health-every`` steps OFF the hot path: the sampler is a
+    separately dispatched probe (one extra forward+backward plus one
+    collective-free compression round), never part of the compiled train
+    step. ``grad_norm`` is the (cross-worker mean of the) local gradient
+    2-norm, ``ef_memory_norm`` the error-feedback residual norm carried in
+    :class:`parallel.trainer.TrainState`, and ``powersgd_rel_error`` the
+    relative compression error ``‖M − P̂Qᵀ‖/‖M‖`` of one diagnostic
+    low-rank round on the current gradient (0.0 for exact reducers, whose
+    error is identically zero by construction; None when the emitter
+    sampled no compression round at all). Silent on stdout; the live
+    aggregator (:mod:`observe.live`) turns these into gauges and the
+    EWMA detectors (:mod:`observe.health`) watch them for NaN precursors."""
+
+    KIND: ClassVar[str] = "train_health"
+
+    step: int
+    epoch: int = 0
+    grad_norm: float = 0.0
+    ef_memory_norm: float = 0.0
+    powersgd_rel_error: Optional[float] = None
+    loss: Optional[float] = None
+    rank: Optional[int] = None
+    label: str = ""
+
+
+@dataclass
+class AlertEvent(Event):
+    """A streaming-detector verdict (:mod:`observe.health`): an EWMA
+    detector watching the live event stream decided a signal left its
+    healthy envelope. ``alert`` names the detector (``grad_spike`` /
+    ``loss_plateau`` / ``step_time_drift`` / ``bandwidth_collapse`` /
+    ``slo_burn``), ``severity`` is ``warn`` or ``critical`` (critical
+    grad-norm alerts are the sustained-NaN-precursor signal the supervisor
+    may restart on), and ``value``/``threshold`` carry the measurement
+    that fired so the record is auditable. Alerts flow BACK into the
+    control plane: the supervisor logs them in its own shard and appends
+    them to ``alerts.jsonl``, which in-run followers (the toy worker, the
+    adaptive train loop) tail to nudge the
+    :class:`resilience.controller.FallbackController` mid-epoch. The
+    banner is the record as JSON, like :class:`FailureEvent`."""
+
+    KIND: ClassVar[str] = "alert"
+
+    alert: str
+    severity: str = "warn"
+    value: float = 0.0
+    threshold: float = 0.0
+    message: str = ""
+    rank: Optional[int] = None
+    step: Optional[int] = None
+    source: str = "aggregator"
+
+    def banner(self) -> str:
+        rec = {k: v for k, v in self.record().items() if v is not None}
+        return json.dumps(rec, default=str)
+
+
+@dataclass
 class NoteEvent(Event):
     """A free-form human banner (init lifecycle, dropped-batch notes,
     study tables) that should also land in the structured log."""

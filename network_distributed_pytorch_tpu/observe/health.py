@@ -1,0 +1,360 @@
+"""Streaming health detectors: EWMA envelopes over the live event stream.
+
+The post-hoc report (``scripts/report.py``) can afford full-history
+percentiles; the live plane cannot — it sees events one at a time and must
+decide *now* whether a signal left its healthy envelope. Every detector
+here is built on the same O(1) primitive: an exponentially weighted moving
+average of the signal and of its square (:class:`Ewma`), giving a running
+mean and standard deviation with no history buffer. A detector fires when
+its condition holds for ``sustain`` consecutive observations (one noisy
+sample never pages anyone), then goes quiet for ``cooldown`` observations
+so a persistently sick signal produces a heartbeat of alerts rather than
+one per event.
+
+Detectors are deliberately clock-free: they consume values carried BY the
+events (``step_time_s``, ``grad_norm``, window bytes/s computed by the
+aggregator) and count observations instead of reading any clock, so the
+same code is exact in replay/tests and live. This module is jax-free and
+import-light — the supervisor runs it in its poll loop.
+
+Thresholds (see DESIGN.md "Live telemetry" for the rationale):
+
+- ``grad_spike``: value > mean + ``spike_sigma``·std (and > ``spike_factor``
+  × mean, guarding the near-zero-variance warmup); a NON-FINITE grad norm
+  or one beyond ``nan_factor`` × mean is severity ``critical`` — the
+  sustained-NaN-precursor signal the supervisor may restart on.
+- ``loss_plateau``: the EWMA of per-observation loss improvement stays
+  below ``plateau_eps`` (relative to the loss scale) for ``sustain`` obs.
+- ``step_time_drift``: a short-horizon EWMA of step time exceeds
+  ``drift_factor`` × the long-horizon EWMA.
+- ``bandwidth_collapse``: the achieved bytes/s window drops below
+  ``collapse_frac`` × its own long-horizon EWMA.
+- ``slo_burn``: the rolling serving p99 total latency exceeds
+  ``slo_target_s`` (budget burn, not mean shift — p99 comes from the
+  registry's ring-buffer histogram, computed by the aggregator).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .events import AlertEvent
+
+
+class Ewma:
+    """Exponentially weighted mean + standard deviation, O(1) per update.
+
+    ``alpha`` is the new-sample weight; 1/alpha is roughly the horizon in
+    observations. ``std`` is derived from the EW second moment and is 0.0
+    until two samples arrive.
+    """
+
+    def __init__(self, alpha: float):
+        self.alpha = float(alpha)
+        self.mean: Optional[float] = None
+        self._sq: Optional[float] = None
+        self.n = 0
+
+    def update(self, x: float) -> None:
+        x = float(x)
+        self.n += 1
+        if self.mean is None:
+            self.mean = x
+            self._sq = x * x
+            return
+        a = self.alpha
+        self.mean = (1.0 - a) * self.mean + a * x
+        self._sq = (1.0 - a) * self._sq + a * x * x
+
+    @property
+    def std(self) -> float:
+        if self.mean is None or self.n < 2:
+            return 0.0
+        var = max(0.0, self._sq - self.mean * self.mean)
+        return math.sqrt(var)
+
+
+@dataclass
+class DetectorConfig:
+    """Every detector threshold in one auditable record. Defaults are the
+    DESIGN.md values; the aggregator and the supervisor construct their
+    monitors from one shared instance so live behavior is reproducible
+    from the config alone."""
+
+    # grad-norm spike
+    spike_alpha: float = 0.1
+    spike_sigma: float = 6.0
+    spike_factor: float = 3.0  # also require > factor x mean (warmup guard)
+    nan_factor: float = 50.0  # beyond this x mean => critical (NaN precursor)
+    spike_sustain: int = 1  # a single genuine spike must not be averaged away
+    # loss plateau
+    plateau_alpha: float = 0.05
+    plateau_eps: float = 1e-3  # relative improvement per observation
+    plateau_sustain: int = 20
+    plateau_min_obs: int = 10
+    # step-time drift
+    drift_fast_alpha: float = 0.3
+    drift_slow_alpha: float = 0.02
+    drift_factor: float = 1.5
+    drift_sustain: int = 5
+    drift_min_obs: int = 10
+    # bandwidth collapse
+    collapse_alpha: float = 0.05
+    collapse_frac: float = 0.4
+    collapse_sustain: int = 3
+    collapse_min_obs: int = 5
+    # serving p99 burn rate
+    slo_target_s: float = 2.0
+    slo_sustain: int = 3
+    # shared
+    cooldown: int = 20  # observations of silence after a fired alert
+
+
+class _Detector:
+    """Shared sustain/cooldown machinery; subclasses implement
+    ``_check(value) -> Optional[(severity, threshold, message)]``."""
+
+    name = "detector"
+
+    def __init__(self, sustain: int, cooldown: int):
+        self._sustain = max(1, int(sustain))
+        self._cooldown = max(0, int(cooldown))
+        self._streak = 0
+        self._quiet = 0
+        self.fired = 0
+
+    def observe(
+        self, value: float, rank: Optional[int] = None, step: Optional[int] = None
+    ) -> Optional[AlertEvent]:
+        if self._quiet > 0:
+            self._quiet -= 1
+        verdict = self._check(value)
+        if verdict is None:
+            self._streak = 0
+            return None
+        self._streak += 1
+        if self._streak < self._sustain or self._quiet > 0:
+            return None
+        severity, threshold, message = verdict
+        self._streak = 0
+        self._quiet = self._cooldown
+        self.fired += 1
+        return AlertEvent(
+            alert=self.name,
+            severity=severity,
+            value=float(value) if math.isfinite(value) else float("inf"),
+            threshold=float(threshold),
+            message=message,
+            rank=rank,
+            step=step,
+            source="detector",
+        )
+
+    def _check(self, value: float):
+        raise NotImplementedError
+
+
+class GradNormSpikeDetector(_Detector):
+    name = "grad_spike"
+
+    def __init__(self, cfg: DetectorConfig):
+        super().__init__(cfg.spike_sustain, cfg.cooldown)
+        self._cfg = cfg
+        self._ewma = Ewma(cfg.spike_alpha)
+
+    def _check(self, value: float):
+        cfg = self._cfg
+        if not math.isfinite(value):
+            return ("critical", float("inf"), "non-finite grad norm")
+        mean, std = self._ewma.mean, self._ewma.std
+        verdict = None
+        if mean is not None and self._ewma.n >= 3 and mean > 0.0:
+            bound = mean + cfg.spike_sigma * std
+            if value > max(bound, cfg.spike_factor * mean):
+                if value > cfg.nan_factor * mean:
+                    verdict = (
+                        "critical",
+                        cfg.nan_factor * mean,
+                        f"grad norm {value:.3g} > {cfg.nan_factor:g}x EWMA "
+                        f"{mean:.3g} (NaN precursor)",
+                    )
+                else:
+                    verdict = (
+                        "warn",
+                        max(bound, cfg.spike_factor * mean),
+                        f"grad norm {value:.3g} > EWMA {mean:.3g} "
+                        f"+ {cfg.spike_sigma:g} sigma",
+                    )
+        # a spike must not poison the baseline it is judged against
+        if verdict is None:
+            self._ewma.update(value)
+        return verdict
+
+
+class LossPlateauDetector(_Detector):
+    name = "loss_plateau"
+
+    def __init__(self, cfg: DetectorConfig):
+        super().__init__(cfg.plateau_sustain, cfg.cooldown)
+        self._cfg = cfg
+        self._improve = Ewma(cfg.plateau_alpha)
+        self._last: Optional[float] = None
+
+    def _check(self, value: float):
+        cfg = self._cfg
+        if not math.isfinite(value):
+            self._last = None
+            return None
+        if self._last is not None:
+            self._improve.update(self._last - value)
+        self._last = value
+        if self._improve.n < cfg.plateau_min_obs:
+            return None
+        scale = max(abs(value), 1e-12)
+        rel = (self._improve.mean or 0.0) / scale
+        if rel < cfg.plateau_eps:
+            return (
+                "warn",
+                cfg.plateau_eps,
+                f"relative loss improvement {rel:.2e}/obs < {cfg.plateau_eps:g}",
+            )
+        return None
+
+
+class StepTimeDriftDetector(_Detector):
+    name = "step_time_drift"
+
+    def __init__(self, cfg: DetectorConfig):
+        super().__init__(cfg.drift_sustain, cfg.cooldown)
+        self._cfg = cfg
+        self._fast = Ewma(cfg.drift_fast_alpha)
+        self._slow = Ewma(cfg.drift_slow_alpha)
+
+    def _check(self, value: float):
+        cfg = self._cfg
+        if not math.isfinite(value) or value <= 0.0:
+            return None
+        self._fast.update(value)
+        slow = self._slow.mean
+        verdict = None
+        if (
+            self._slow.n >= cfg.drift_min_obs
+            and slow
+            and self._fast.mean > cfg.drift_factor * slow
+        ):
+            verdict = (
+                "warn",
+                cfg.drift_factor * slow,
+                f"step time {self._fast.mean * 1e3:.1f} ms > "
+                f"{cfg.drift_factor:g}x baseline {slow * 1e3:.1f} ms",
+            )
+        else:
+            # freeze the baseline while drifted, or recovery re-learns the
+            # degraded speed as "normal" and the alert self-silences
+            self._slow.update(value)
+        return verdict
+
+
+class BandwidthCollapseDetector(_Detector):
+    name = "bandwidth_collapse"
+
+    def __init__(self, cfg: DetectorConfig):
+        super().__init__(cfg.collapse_sustain, cfg.cooldown)
+        self._cfg = cfg
+        self._ewma = Ewma(cfg.collapse_alpha)
+
+    def _check(self, value: float):
+        cfg = self._cfg
+        if not math.isfinite(value) or value < 0.0:
+            return None
+        base = self._ewma.mean
+        verdict = None
+        if (
+            self._ewma.n >= cfg.collapse_min_obs
+            and base
+            and value < cfg.collapse_frac * base
+        ):
+            verdict = (
+                "warn",
+                cfg.collapse_frac * base,
+                f"bytes/s {value:.3g} < {cfg.collapse_frac:g}x baseline "
+                f"{base:.3g}",
+            )
+        else:
+            self._ewma.update(value)
+        return verdict
+
+
+class SloBurnRateDetector(_Detector):
+    name = "slo_burn"
+
+    def __init__(self, cfg: DetectorConfig):
+        super().__init__(cfg.slo_sustain, cfg.cooldown)
+        self._cfg = cfg
+
+    def _check(self, value: float):
+        cfg = self._cfg
+        if not math.isfinite(value):
+            return None
+        if value > cfg.slo_target_s:
+            return (
+                "warn",
+                cfg.slo_target_s,
+                f"serving p99 {value * 1e3:.0f} ms > SLO "
+                f"{cfg.slo_target_s * 1e3:.0f} ms",
+            )
+        return None
+
+
+class HealthMonitor:
+    """The detector bank, keyed by signal. The aggregator routes each
+    derived signal to :meth:`observe_*` as events stream in; every call
+    returns the alerts that fired (usually none). Per-rank signals get
+    per-rank detector instances so one slow rank cannot hide inside a
+    cross-rank mean."""
+
+    def __init__(self, config: Optional[DetectorConfig] = None):
+        self.config = config or DetectorConfig()
+        self._grad: Dict[Optional[int], GradNormSpikeDetector] = {}
+        self._loss = LossPlateauDetector(self.config)
+        self._drift: Dict[Optional[int], StepTimeDriftDetector] = {}
+        self._bandwidth = BandwidthCollapseDetector(self.config)
+        self._slo = SloBurnRateDetector(self.config)
+        self.alerts: List[AlertEvent] = []
+
+    def _keep(self, alert: Optional[AlertEvent]) -> List[AlertEvent]:
+        if alert is None:
+            return []
+        self.alerts.append(alert)
+        return [alert]
+
+    def observe_grad_norm(
+        self, value: float, rank: Optional[int] = None, step: Optional[int] = None
+    ) -> List[AlertEvent]:
+        det = self._grad.setdefault(rank, GradNormSpikeDetector(self.config))
+        return self._keep(det.observe(value, rank=rank, step=step))
+
+    def observe_loss(
+        self, value: float, step: Optional[int] = None
+    ) -> List[AlertEvent]:
+        return self._keep(self._loss.observe(value, step=step))
+
+    def observe_step_time(
+        self, value: float, rank: Optional[int] = None, step: Optional[int] = None
+    ) -> List[AlertEvent]:
+        det = self._drift.setdefault(rank, StepTimeDriftDetector(self.config))
+        return self._keep(det.observe(value, rank=rank, step=step))
+
+    def observe_bytes_per_s(self, value: float) -> List[AlertEvent]:
+        return self._keep(self._bandwidth.observe(value))
+
+    def observe_serving_p99(self, value: float) -> List[AlertEvent]:
+        return self._keep(self._slo.observe(value))
+
+    def fired_by_kind(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for a in self.alerts:
+            out[a.alert] = out.get(a.alert, 0) + 1
+        return out

@@ -53,6 +53,15 @@ _ENV_INCARNATION = "RESILIENCE_INCARNATION"
 
 MANIFEST_NAME = "run.json"
 SUPERVISOR_LOG = "events_supervisor.jsonl"
+# the control-plane feedback channel: the supervisor appends every fired
+# AlertEvent record here, and in-run followers (toy worker, adaptive train
+# loop) tail it with read_shard_from to nudge the FallbackController
+# mid-epoch. Plain JSONL, same torn-tail tolerance as the shards.
+ALERTS_LOG = "alerts.jsonl"
+# the supervisor writes the BOUND /metrics port here once the exposition
+# server is listening (metrics_port=0 binds an ephemeral port), so probes
+# and dashboards can discover the endpoint without racing the bind
+METRICS_PORT_NAME = "metrics_port"
 SCHEMA = 1
 
 
@@ -220,6 +229,54 @@ def load_shard(path: str) -> Tuple[List[Dict], int]:
             else:
                 skipped += 1
     return events, skipped
+
+
+def read_shard_from(path: str, offset: int = 0) -> Tuple[List[Dict], int, int]:
+    """The resumable form of :func:`load_shard`: parse the shard from byte
+    ``offset``, consuming only newline-TERMINATED lines, and return
+    ``(events, new_offset, skipped)``.
+
+    ``new_offset`` always points just past the last consumed newline, so a
+    half-written trailing line (a live writer mid-``write``, or the torn
+    tail of a SIGKILLed rank) is left UNCONSUMED — the next poll re-reads
+    it once its newline lands, which is what makes incremental tailing
+    duplicate-free AND drop-free. Complete lines that still fail to decode
+    (foreign stdout interleaved into the shard) are skipped and counted,
+    exactly like :func:`load_shard`. A shard that shrank below ``offset``
+    (never the case for append-only runlog shards, but possible for a
+    recreated file) resets the follower to the start of the file.
+
+    Offsets are plain byte positions: persist them (``json.dump``) and a
+    restarted follower resumes with ``read_shard_from(path, saved_offset)``
+    seeing every event exactly once.
+    """
+    events: List[Dict] = []
+    skipped = 0
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        if size < offset:
+            offset = 0  # file was truncated/recreated: start over
+        f.seek(offset)
+        chunk = f.read(size - offset)
+    end = chunk.rfind(b"\n")
+    if end < 0:
+        return [], offset, 0  # no complete line yet
+    new_offset = offset + end + 1
+    for raw in chunk[: end + 1].split(b"\n"):
+        line = raw.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line.decode("utf-8", errors="replace"))
+        except json.JSONDecodeError:
+            skipped += 1
+            continue
+        if isinstance(rec, dict):
+            events.append(rec)
+        else:
+            skipped += 1
+    return events, new_offset, skipped
 
 
 def _percentile(values: List[float], p: float) -> float:

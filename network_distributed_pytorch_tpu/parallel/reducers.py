@@ -232,6 +232,16 @@ class ExactReducer:
         send = jax.tree_util.tree_map(jnp.add, grads, memories)
         return self.reduce(state, send, axis_name)
 
+    def compression_error(
+        self, state: dict, send: PyTree, axis_name: Optional[str] = None
+    ) -> jax.Array:
+        """Relative compression error ``‖M − decompress(compress(M))‖/‖M‖``
+        for the health probe (``TrainHealthEvent.powersgd_rel_error``) —
+        identically zero by construction: an exact reduction loses nothing.
+        Same signature as PowerSGD's so the probe treats both uniformly."""
+        del state, send, axis_name
+        return jnp.zeros((), jnp.float32)
+
     def ledger_entries(self, grads_template: PyTree, axis: str = "", n_workers: int = 1):
         """Wire-ledger itemization of one exact reduction: the whole gradient
         as one flat-packed all-reduce (or, unpacked, one per-tensor all-reduce
@@ -532,6 +542,36 @@ class PowerSGDReducer:
         e_leaves = jax.tree_util.tree_leaves(memories)
         assert len(e_leaves) == len(g_leaves)
         return self._reduce(state, g_leaves, e_leaves, treedef, axis_name)
+
+    def compression_error(
+        self,
+        state: PowerSGDState,
+        send: PyTree,
+        axis_name: Optional[str] = None,
+    ) -> jax.Array:
+        """Relative compression error ``‖M − P̂Qᵀ‖/‖M‖`` over the whole send
+        tree, for the health probe (``TrainHealthEvent.powersgd_rel_error``).
+
+        Runs ONE diagnostic compression round with ``axis_name=None`` — the
+        P/Q exchange collapses to local matmuls, so the probe is
+        collective-free — and reads the residual off ``new_memory`` (which
+        :meth:`reduce` computes as exactly ``M − P̂Qᵀ`` for compressed
+        leaves, zero for rank-1 fallthrough leaves). The returned state is
+        DISCARDED: the probe must not advance the warm-start Q buffer or the
+        PRNG key the real step will consume."""
+        _, _, residual, _ = self.reduce(state, send, axis_name)
+
+        def _sq(tree):
+            leaves = jax.tree_util.tree_leaves(tree)
+            if not leaves:
+                return jnp.zeros((), jnp.float32)
+            return sum(
+                jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves
+            )
+
+        return jnp.sqrt(_sq(residual)) / jnp.maximum(
+            jnp.sqrt(_sq(send)), jnp.float32(1e-30)
+        )
 
     def _reduce(
         self,

@@ -376,7 +376,14 @@ class CompiledStep(NamedTuple):
     ``ledger`` is the itemization of ``bits_per_step``: one
     ``observe.ledger.LedgerEntry`` per collective the step issues, built at
     construction time with the guarantee that ``ledger.total_bits() ==
-    bits_per_step`` (asserted in ``observe.ledger.step_ledger``)."""
+    bits_per_step`` (asserted in ``observe.ledger.step_ledger``).
+
+    ``health_fn`` is the OFF-hot-path training-health probe
+    (:func:`make_health_fn`): ``health_fn(state, batch) -> {grad_norm,
+    ef_memory_norm, powersgd_rel_error, loss}``, a separately jitted
+    dispatch the loop calls every ``health_every`` steps — never traced
+    into ``fn``, never touching its donation or its ledger. None when the
+    builder could not construct one (hand-rolled steps)."""
 
     fn: Callable[[TrainState, Any], Tuple[TrainState, jax.Array]]
     bits_per_step: int
@@ -384,6 +391,7 @@ class CompiledStep(NamedTuple):
     reducer: Any
     optimizer: Any = None
     ledger: Any = None
+    health_fn: Optional[Callable[[TrainState, Any], Any]] = None
 
     def __call__(self, state, batch):
         return self.fn(state, batch)
@@ -498,6 +506,103 @@ def make_scanned_train_fn(
         reducer,
         optimizer,
         _step_ledger(reducer, params_template, mesh, axis_name, bits),
+        health_fn=make_health_fn(
+            loss_fn, reducer, mesh, axis_name, accum_steps
+        ),
+    )
+
+
+def _tree_sq_norm(tree: PyTree) -> jax.Array:
+    """Sum of squared elements over a pytree, accumulated in f32."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+
+
+def make_health_fn(
+    loss_fn: LossFn,
+    reducer,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = DATA_AXIS,
+    accum_steps: int = 1,
+) -> Callable[[TrainState, Any], Any]:
+    """The training-health probe behind ``TrainHealthEvent``: a separately
+    jitted ``(state, batch) -> {grad_norm, ef_memory_norm,
+    powersgd_rel_error, loss}`` dispatch, called every ``health_every``
+    steps by the training loops — OFF the hot path.
+
+    Sampling cost (documented in DESIGN.md): one extra forward+backward on
+    the probe batch (the gradient is recomputed — the compiled step's
+    gradients never leave the device, and widening its signature would
+    break donation and every wrapper contract), plus one COLLECTIVE-FREE
+    diagnostic compression round (``reducer.compression_error`` with
+    ``axis_name=None``) for the relative error ``‖M − P̂Qᵀ‖/‖M‖``, plus
+    four scalar all-reduces to average the stats across workers. With
+    ``accum_steps > 1`` the probe samples microbatch 0 only — a health
+    sample, not a training step. State is read, never mutated."""
+    ax = axis_name if mesh is not None else None
+
+    def health_body(state: TrainState, batch):
+        if accum_steps > 1:
+            batch = jax.tree_util.tree_map(lambda l: l[0], batch)
+        diff_params = state.params
+        if ax is not None:
+            # same pcast-before-grad rule as the step: the probe must see
+            # this worker's LOCAL gradient, not an auto-psum'd one
+            diff_params = jax.tree_util.tree_map(
+                lambda p: jax.lax.pcast(p, ax, to="varying"), state.params
+            )
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            diff_params, state.model_state, batch
+        )
+        send = jax.tree_util.tree_map(jnp.add, grads, state.memories)
+        gn2 = _tree_sq_norm(grads)
+        en2 = _tree_sq_norm(state.memories)
+        if hasattr(reducer, "compression_error"):
+            rel = reducer.compression_error(state.reducer_state, send, None)
+        else:
+            rel = jnp.zeros((), jnp.float32)
+        return {
+            "grad_norm": jnp.sqrt(all_reduce_mean(gn2, ax)),
+            "ef_memory_norm": jnp.sqrt(all_reduce_mean(en2, ax)),
+            "powersgd_rel_error": all_reduce_mean(rel, ax),
+            "loss": all_reduce_mean(loss, ax),
+        }
+
+    if mesh is None:
+        # lint: no-donate — diagnostic probe reads the LIVE training state
+        # the loop keeps stepping; donating it would free buffers in use
+        return jax.jit(health_body)
+
+    def sharded_health(state: TrainState, batch):
+        local = state._replace(
+            memories=strip_leading(state.memories),
+            model_state=strip_leading(state.model_state),
+        )
+        return health_body(local, batch)
+
+    state_specs = TrainState(
+        params=PartitionSpec(),
+        momenta=PartitionSpec(),
+        memories=PartitionSpec(axis_name),
+        reducer_state=PartitionSpec(),
+        model_state=PartitionSpec(axis_name),
+    )
+    batch_spec = (
+        PartitionSpec(axis_name)
+        if accum_steps == 1
+        else PartitionSpec(None, axis_name)
+    )
+    # lint: no-donate — same: the probe must not consume the state/batch
+    # buffers the hot step is about to reuse
+    return jax.jit(
+        jax.shard_map(
+            sharded_health,
+            mesh=mesh,
+            in_specs=(state_specs, batch_spec),
+            out_specs=PartitionSpec(),
+        )
     )
 
 
@@ -577,6 +682,9 @@ def make_train_step(
         return CompiledStep(
             fn, bits, None, reducer, optimizer,
             _step_ledger(reducer, params_template, None, axis_name, bits),
+            health_fn=make_health_fn(
+                loss_fn, reducer, None, axis_name, accum_steps
+            ),
         )
 
     body = make_step_fn(
@@ -626,4 +734,7 @@ def make_train_step(
         reducer,
         optimizer,
         _step_ledger(reducer, params_template, mesh, axis_name, bits),
+        health_fn=make_health_fn(
+            loss_fn, reducer, mesh, axis_name, accum_steps
+        ),
     )

@@ -35,6 +35,10 @@ Fault kinds and where they bite:
 ``comm_flap``          a transient throttle that clears by itself after
                        ``clears_after`` steps — the flaky-link case the
                        watchdog must survive WITHOUT a world restart
+``grad_spike``         the health sampler's grad-norm reading is multiplied
+                       by ``factor`` (default 1000) — an optimizer blow-up
+                       precursor the live plane's EWMA spike detector must
+                       catch and alert on (observe.health)
 ==================  =========================================================
 
 Process- and step-level faults carry an ``incarnation`` filter (default 0)
@@ -62,9 +66,10 @@ STEP_FAULTS = ("step_transient", "step_nan")
 CHECKPOINT_FAULTS = ("ckpt_torn", "ckpt_bitflip")
 PROCESS_FAULTS = ("proc_exit", "proc_kill", "proc_hang", "proc_preempt")
 COMM_FAULTS = ("comm_throttle", "comm_stall", "comm_flap")
+HEALTH_FAULTS = ("grad_spike",)
 FAULT_KINDS = (
     LOADER_FAULTS + STEP_FAULTS + CHECKPOINT_FAULTS + PROCESS_FAULTS
-    + COMM_FAULTS
+    + COMM_FAULTS + HEALTH_FAULTS
 )
 
 # The registry the satellite asks for: every fault kind names the ONE
@@ -86,6 +91,7 @@ INJECTION_SITES: Dict[str, str] = {
     "comm_throttle": "comm-hook",       # CommFaultInjector fence hook
     "comm_stall": "comm-hook",          # CommFaultInjector fence hook
     "comm_flap": "comm-hook",           # CommFaultInjector fence hook
+    "grad_spike": "health-probe",       # health sampler (TrainHealthEvent)
 }
 
 

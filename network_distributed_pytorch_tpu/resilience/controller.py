@@ -153,6 +153,7 @@ class FallbackController:
         self._degraded_streak = 0
         self._healthy_streak = 0
         self._best_achieved: Dict[int, float] = {}
+        self._nudged_epoch: Optional[int] = None
         self.decisions: List[PolicyDecision] = []
 
     @property
@@ -194,6 +195,11 @@ class FallbackController:
 
     def observe(self, health: EpochHealth) -> Optional[PolicyDecision]:
         """Fold one epoch's health in; return the ladder move, if any."""
+        if self._nudged_epoch == health.epoch:
+            # a mid-epoch alert nudge already spent this epoch's decision
+            # budget; the boundary verdict would double-move on the same
+            # evidence (the health numbers that raised the alert)
+            return None
         verdict = self._classify(health)
         if verdict.startswith("degraded"):
             self._degraded_streak += 1
@@ -219,6 +225,50 @@ class FallbackController:
         self._degraded_streak = 0
         self._healthy_streak = 0
         return None
+
+    def nudge(
+        self, alert: str, epoch: int, severity: str = "warn"
+    ) -> Optional[PolicyDecision]:
+        """Mid-epoch alert nudge — the live plane's entry point.
+
+        An :class:`observe.events.AlertEvent` from the streaming detectors
+        arrives BETWEEN epoch boundaries (tailed off the run's
+        ``alerts.jsonl`` feedback channel), so it cannot wait for
+        ``observe``. The contract (DESIGN.md "mid-epoch controller
+        nudges"):
+
+        - A ``critical`` alert, or any comm-shaped alert
+          (``bandwidth_collapse`` / ``step_time_drift``), descends ONE
+          rung immediately — the same single-recompile budget as a
+          boundary decision, just paid early.
+        - Any other ``warn`` alert pre-charges the degraded streak: the
+          next boundary ``observe`` needs one fewer degraded epoch to
+          descend. No decision is returned.
+        - At most one nudge-descend per epoch (the boundary hysteresis
+          still owns the cadence), and after a nudge-descend the SAME
+          epoch's boundary ``observe`` is a no-op — the epoch's decision
+          budget is spent. ``nudged_epoch`` exposes which epoch that was.
+        """
+        if self._nudged_epoch == epoch:
+            return None
+        immediate = severity == "critical" or alert in (
+            "bandwidth_collapse",
+            "step_time_drift",
+        )
+        if not immediate:
+            self._degraded_streak += 1
+            self._healthy_streak = 0
+            return None
+        if self.index >= len(self.ladder) - 1:
+            return None
+        self._nudged_epoch = epoch
+        return self._move(+1, f"alert:{alert}:{severity}", epoch)
+
+    @property
+    def nudged_epoch(self) -> Optional[int]:
+        """The epoch whose decision budget a nudge already spent (the
+        caller skips that epoch's boundary ``observe``), or None."""
+        return self._nudged_epoch
 
     def _move(self, delta: int, trigger: str, epoch: int) -> PolicyDecision:
         before = self.rung

@@ -76,6 +76,18 @@ class SupervisorConfig:
     min_world_size: int = 1
     deadline_s: Optional[float] = None  # whole-run wall clock cap
     seed: int = 0
+    # live telemetry plane (observe.live): None = disabled; 0 = bind an
+    # ephemeral port (advertised via the run dir's metrics_port file).
+    # Requires a run_dir — the aggregator tails the run's JSONL shards.
+    metrics_port: Optional[int] = None
+    # observe.health.DetectorConfig override for the aggregator's
+    # streaming detectors (None = defaults)
+    detector_config: Any = None
+    # restart a rank after this many sustained CRITICAL grad-spike alerts
+    # (the NaN-precursor signal) attributed to it; 0 = log-only. Restarts
+    # ride the normal kill -> poll -> backoff machinery and spend the
+    # rank's ordinary restart budget.
+    alert_restart_after: int = 0
 
 
 @dataclass
@@ -136,6 +148,12 @@ class Supervisor:
         self.run_dir = run_dir
         self.run_id: Optional[str] = None
         self._manifest = None
+        # the live plane (started lazily in run(), torn down in finally):
+        # aggregator tailing the shards + the /metrics exposition thread
+        self._aggregator = None
+        self._metrics_server = None
+        self._critical_alerts: Dict[int, int] = {}  # rank -> critical count
+        self.metrics_port: Optional[int] = None  # bound port once serving
         if run_dir is not None:
             from ..observe import runlog
 
@@ -249,8 +267,87 @@ class Supervisor:
         graceful = rc in (0, PREEMPT_EXIT_CODE, -int(signal.SIGTERM))
         return "graceful" if graceful else "hard"
 
+    # -- the live telemetry plane ------------------------------------------
+    def _start_live_plane(self) -> None:
+        cfg = self.config
+        if self.run_dir is None or cfg.metrics_port is None:
+            return
+        from ..observe import live as live_mod
+
+        self._aggregator = live_mod.LiveAggregator(
+            self.run_dir, detector_config=cfg.detector_config
+        )
+        try:
+            self._metrics_server = live_mod.MetricsHTTPServer(
+                self._aggregator.registry, port=cfg.metrics_port
+            ).start()
+        except OSError as e:
+            self._emit("metrics_error", message=f"exposition bind failed: {e}")
+            return
+        self.metrics_port = self._metrics_server.port
+        self._metrics_server.write_port_file(self.run_dir)
+        self._emit(
+            "metrics_up",
+            message=f"/metrics serving on port {self.metrics_port}",
+        )
+
+    def _close_live_plane(self) -> None:
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
+
+    def _poll_live(self, workers: Dict[int, "_Worker"]) -> None:
+        """Drain the aggregator: log every fired alert in the supervisor's
+        own shard, append it to the run's ``alerts.jsonl`` feedback channel
+        (what in-run followers nudge the FallbackController from), and —
+        when ``alert_restart_after`` is armed — kill a rank that sustains
+        critical NaN-precursor alerts so the ordinary restart machinery
+        respawns it from its last committed checkpoint."""
+        if self._aggregator is None:
+            return
+        from ..observe import live as live_mod
+
+        cfg = self.config
+        for alert in self._aggregator.poll():
+            rec = dict(alert.record())
+            rec.setdefault("ts", time.time())
+            live_mod.append_alert(self.run_dir, rec)
+            if self.telemetry is not None:
+                self.telemetry.emit(alert)
+            if alert.severity == "critical" and alert.rank is not None:
+                rank = int(alert.rank)
+                self._critical_alerts[rank] = (
+                    self._critical_alerts.get(rank, 0) + 1
+                )
+                if (
+                    cfg.alert_restart_after > 0
+                    and self._critical_alerts[rank] >= cfg.alert_restart_after
+                ):
+                    self._critical_alerts[rank] = 0
+                    w = workers.get(rank)
+                    if w is not None and not w.done and w.proc.poll() is None:
+                        self._emit(
+                            "alert_restart", rank=rank,
+                            incarnation=w.incarnation,
+                            message=(
+                                f"sustained critical {alert.alert} x"
+                                f"{cfg.alert_restart_after}; recycling rank"
+                            ),
+                        )
+                        self._kill(w)
+
     # -- the run loop -------------------------------------------------------
     def run(self) -> SupervisorResult:
+        self._start_live_plane()
+        try:
+            return self._run_loop()
+        finally:
+            # one last drain so events written in the workers' final
+            # moments still reach the gauges/alert feed before teardown
+            self._poll_live({})
+            self._close_live_plane()
+
+    def _run_loop(self) -> SupervisorResult:
         cfg = self.config
         world = self.world_size
         started = time.monotonic()
@@ -291,6 +388,11 @@ class Supervisor:
                 and time.monotonic() - started > cfg.deadline_s
             ):
                 return fail(f"deadline {cfg.deadline_s}s exceeded")
+
+            # live plane first: alerts should reach the feedback channel
+            # (and possibly recycle a sick rank) before this iteration's
+            # exit-code sweep observes the consequences
+            self._poll_live(workers)
 
             restart_queue: List[int] = []
             dead_rank: Optional[int] = None

@@ -76,6 +76,12 @@ class ExperimentConfig:
     event_log: Optional[str] = None
     trace_dir: Optional[str] = None
     audit_wire: Optional[bool] = None
+    # training-health sampling cadence (observe.events.TrainHealthEvent):
+    # every N steps the loop dispatches the separately jitted health probe
+    # (CompiledStep.health_fn — one extra fwd+bwd plus a collective-free
+    # diagnostic compression round; see DESIGN.md "health sampling cost").
+    # 0 = never sample (the probe is never dispatched, zero overhead).
+    health_every: int = 0
 
     # resilience (resilience/): path to a JSON fault schedule
     # (resilience.chaos.ChaosPlan) for experiments running through

@@ -70,6 +70,11 @@ METRICS: Dict[str, str] = {
     # the serving/ engine's per-request events) — a slower p99 decode
     # tick is an SLO regression even when training metrics hold
     "p99_decode_ms_per_token": "lower",
+    # live-plane detector verdicts (report ``alerts.fired``) — a healthy
+    # run fires zero, so unlike every other metric the comparable value
+    # may legitimately be 0 (extract_metrics accepts it); more alerts than
+    # the recorded baseline means the run's health envelope got worse
+    "alerts_fired": "lower",
 }
 
 BASELINE_NAME = "GATE_BASELINE.json"
@@ -113,6 +118,17 @@ def extract_metrics(doc: Dict) -> Dict[str, float]:
     v = doc.get("p99_decode_ms_per_token")
     if isinstance(v, (int, float)) and v == v and v > 0:
         out.setdefault("p99_decode_ms_per_token", float(v))
+    # live-plane alerts: nested under the report's "alerts" section, flat
+    # in bench baselines. Zero IS the healthy value, so (alone among the
+    # metrics) v == 0 still records
+    alerts = doc.get("alerts")
+    if isinstance(alerts, dict):
+        v = alerts.get("fired")
+        if isinstance(v, (int, float)) and v == v and v >= 0:
+            out["alerts_fired"] = float(v)
+    v = doc.get("alerts_fired")
+    if isinstance(v, (int, float)) and v == v and v >= 0:
+        out.setdefault("alerts_fired", float(v))
     return out
 
 

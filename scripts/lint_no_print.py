@@ -49,6 +49,13 @@ SCRIPT_ALLOWED = {
 # - runlog.py: the manifest's ``created_unix`` provenance stamp
 MONO_ALLOWED = {"telemetry.py", "runlog.py"}
 
+# function-scoped allowances: files covered by the clock lint where ONE
+# named function may stamp wall clock. live.py's Prometheus exposition
+# formatter publishes ``live_scrape_unix_time`` (a wall-clock gauge by
+# definition); everything else in live.py/health.py — windows, detectors,
+# follower pacing — must be monotonic or clock-free.
+MONO_FUNC_ALLOWED = {"live.py": {"render_prometheus"}}
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGE = os.path.join(REPO, "network_distributed_pytorch_tpu")
 SCRIPTS = os.path.join(REPO, "scripts")
@@ -83,19 +90,29 @@ def print_calls(path: str, permit_stderr: bool = False):
             yield node.lineno
 
 
-def wallclock_calls(path: str):
+def wallclock_calls(path: str, allowed_funcs=frozenset()):
     """Line numbers of ``time.time()`` calls (the attribute form only —
     a ``from time import time`` alias would dodge this, and observe/
-    deliberately never imports it that way)."""
-    for node in ast.walk(_parse(path)):
+    deliberately never imports it that way). Calls lexically inside a
+    function named in ``allowed_funcs`` are sanctioned (the
+    ``MONO_FUNC_ALLOWED`` exposition-formatter carve-out)."""
+
+    def _walk(node, inside_allowed):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inside_allowed = inside_allowed or node.name in allowed_funcs
         if (
             isinstance(node, ast.Call)
             and isinstance(node.func, ast.Attribute)
             and node.func.attr == "time"
             and isinstance(node.func.value, ast.Name)
             and node.func.value.id == "time"
+            and not inside_allowed
         ):
             yield node.lineno
+        for child in ast.iter_child_nodes(node):
+            yield from _walk(child, inside_allowed)
+
+    yield from _walk(_parse(path), False)
 
 
 def lint_tree(root: str, allowed, permit_stderr: bool = False):
@@ -112,7 +129,8 @@ def lint_tree(root: str, allowed, permit_stderr: bool = False):
             # clock discipline applies to observe/ wherever the lint was
             # rooted (package walk or an explicit path argument)
             if "observe" in path.split(os.sep) and fname not in MONO_ALLOWED:
-                for lineno in wallclock_calls(path):
+                funcs = MONO_FUNC_ALLOWED.get(fname, frozenset())
+                for lineno in wallclock_calls(path, allowed_funcs=funcs):
                     violations.append(
                         f"{path}:{lineno} time.time() in observe/ "
                         "(use time.monotonic() for durations)"

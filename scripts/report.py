@@ -248,6 +248,39 @@ def render_policy_timeline(policies: List[Dict]) -> List[str]:
     return lines
 
 
+def render_alert_section(alerts: List[Dict]) -> List[str]:
+    """The live plane's alert feed: every detector verdict ordered by time,
+    with the measurement that fired it (empty run → no section)."""
+    if not alerts:
+        return []
+    ordered = sorted(
+        alerts, key=lambda a: (_event_time(a) is None, _event_time(a) or 0.0)
+    )
+    t0 = next(
+        (_event_time(a) for a in ordered if _event_time(a) is not None), None
+    )
+    lines = ["", "live alerts — streaming detector verdicts",
+             "-----------------------------------------"]
+    for a in ordered:
+        t = _event_time(a)
+        when = (
+            f"t+{t - t0:8.3f}s"
+            if t is not None and t0 is not None
+            else " " * 10 + "-"
+        )
+        who = f" rank {a['rank']}" if a.get("rank") is not None else ""
+        lines.append(
+            f"  {when}  {a.get('alert', '?'):<20} {a.get('severity', '?'):<8}"
+            f" value {a.get('value', 0):.4g} / threshold"
+            f" {a.get('threshold', 0):.4g}{who}"
+        )
+        if a.get("message"):
+            lines.append(f"      {a['message']}")
+    crit = sum(1 for a in ordered if a.get("severity") == "critical")
+    lines.append(f"  {len(ordered)} alert(s), {crit} critical")
+    return lines
+
+
 def data_drop_summary(events: List[Dict]) -> Dict[str, Dict]:
     """Per-label tally of typed data-drop events (samples an experiment
     silently lost to shape constraints — now counted, not just noted)."""
@@ -1032,6 +1065,11 @@ def run_report(
     sections.extend(render_mfu_section(mfu_records))
     comm_buckets = bucket_attribution(bandwidth, overlap)
     sections.extend(render_bucket_section(comm_buckets))
+    sections.extend(
+        render_alert_section(
+            [e for e in merged.events if e.get("event") == "alert"]
+        )
+    )
     # the span attribution section itself renders inside render_report
     # (shared with the single-file mode); here we only keep the summary
     # for the machine-readable report dict
@@ -1056,6 +1094,11 @@ def run_report(
     failures = [e for e in merged.events if e.get("event") == "failure"]
     deaths = _death_counts(failures)
     policies = [e for e in merged.events if e.get("event") == "policy"]
+    alert_events = [e for e in merged.events if e.get("event") == "alert"]
+    alerts_by_kind: Dict[str, int] = {}
+    for a in alert_events:
+        k = str(a.get("alert", "?"))
+        alerts_by_kind[k] = alerts_by_kind.get(k, 0) + 1
     report = {
         "schema": 1,
         "run_dir": os.path.abspath(run_dir),
@@ -1107,6 +1150,16 @@ def run_report(
                 if policies else None
             ),
         },
+        # the live plane's verdicts (always present, even when zero fired,
+        # so the gate can extract alerts_fired from every run)
+        "alerts": {
+            "fired": len(alert_events),
+            "by_kind": alerts_by_kind,
+            "criticals": sum(
+                1 for a in alert_events if a.get("severity") == "critical"
+            ),
+            "records": alert_events,
+        },
         "data_drops": data_drop_summary(merged.events),
         # the gate's recovery scalar: wall seconds from the first injected
         # comm fault to the first clean step (lower = faster heal)
@@ -1116,6 +1169,129 @@ def run_report(
         "slo": slo_summary_from_events(merged.events),
     }
     return text, report
+
+
+def _label_value(label_str: str, key: str) -> str:
+    """Pull one label's value out of a rendered ``{k="v",...}`` string
+    (the registry snapshot's key format)."""
+    marker = f'{key}="'
+    i = label_str.find(marker)
+    if i < 0:
+        return label_str
+    j = label_str.find('"', i + len(marker))
+    return label_str[i + len(marker):j] if j > 0 else label_str
+
+
+def render_watch_frame(agg, run_dir: str = "") -> str:
+    """One dashboard frame off a ``LiveAggregator``: step rate, per-fabric
+    utilization, the alert feed, and the serving SLO tiles."""
+    from network_distributed_pytorch_tpu.observe.live import read_port_file
+
+    reg = agg.registry
+    snap = reg.snapshot()
+    lines: List[str] = []
+    header = f"live: {run_dir or agg.run_dir}"
+    port = read_port_file(agg.run_dir)
+    if port:
+        header += f"   /metrics on 127.0.0.1:{port}"
+    lines.append(header)
+    lines.append("=" * len(header))
+
+    steps = sum(
+        v for v in snap.get("live_steps_total", {}).values()
+        if isinstance(v, (int, float))
+    )
+    rate = reg.get_gauge("live_step_rate_per_s")
+    p50 = reg.get_gauge("live_step_time_p50_seconds")
+    p99 = reg.get_gauge("live_step_time_p99_seconds")
+    lines.append(
+        "  steps   "
+        f"{int(steps):>8}   "
+        + (f"rate {rate:6.2f}/s   " if rate is not None else "rate      -   ")
+        + (f"p50 {p50 * 1e3:8.1f} ms   " if p50 is not None else "p50        -   ")
+        + (f"p99 {p99 * 1e3:8.1f} ms" if p99 is not None else "p99        -")
+    )
+    bps = reg.get_gauge("live_comm_bytes_per_s")
+    if bps is not None:
+        utils = [
+            f"{_label_value(lbl, 'fabric')} {100 * v:5.1f}%"
+            for lbl, v in sorted(
+                snap.get("live_fabric_utilization", {}).items()
+            )
+            if isinstance(v, (int, float))
+        ]
+        lines.append(
+            f"  comm    {_fmt_rate(bps):>10}   util " + "  ".join(utils)
+        )
+    gn = snap.get("live_grad_norm", {})
+    if gn:
+        tiles = "   ".join(
+            f"rank {_label_value(lbl, 'rank')}: {v:.4g}"
+            for lbl, v in sorted(gn.items())
+            if isinstance(v, (int, float))
+        )
+        lines.append(f"  grad ‖g‖ {tiles}")
+
+    served = snap.get("live_serving_requests_total", {})
+    if served:
+        states = "  ".join(
+            f"{_label_value(lbl, 'state')}={int(v)}"
+            for lbl, v in sorted(served.items())
+            if isinstance(v, (int, float))
+        )
+        row = f"  serving {states}"
+        sp99 = reg.get_gauge("live_serving_p99_total_seconds")
+        if sp99 is not None:
+            row += f"   p99 total {sp99 * 1e3:.0f} ms"
+        tok = reg.get_histogram("live_serving_decode_ms_per_token")
+        if tok is not None and len(tok):
+            row += f"   decode {tok.percentile(50):.1f} ms/token"
+        lines.append(row)
+
+    lines.append("")
+    lines.append(f"  alerts fired: {len(agg.alerts)}")
+    for a in agg.alerts[-8:]:
+        lines.append(
+            f"    {a.alert:<20} {a.severity:<8} value {a.value:.4g}"
+            + (f"  rank {a.rank}" if a.rank is not None else "")
+        )
+    torn = reg.get_gauge("live_torn_lines_total")
+    if torn:
+        lines.append(f"  torn shard lines: {int(torn)}")
+    return "\n".join(lines) + "\n"
+
+
+def watch_run(
+    run_dir: str,
+    interval: float = 1.0,
+    iterations: int = 0,
+    out=None,
+) -> int:
+    """``--watch``: poll the run directory's shards through a
+    ``LiveAggregator`` and redraw the dashboard in place (ANSI
+    clear-and-home on a tty, plain append otherwise). ``iterations=0``
+    runs until the user interrupts; a positive bound exists for tests."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from network_distributed_pytorch_tpu.observe.live import LiveAggregator
+
+    out = out or sys.stdout
+    agg = LiveAggregator(run_dir)
+    n = 0
+    try:
+        while True:
+            n += 1
+            agg.poll()
+            frame = render_watch_frame(agg, run_dir)
+            if out.isatty():
+                out.write("\x1b[H\x1b[2J")
+            out.write(frame)
+            out.flush()
+            if iterations and n >= iterations:
+                return 0
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
 
 
 def main(argv=None) -> int:
@@ -1147,9 +1323,33 @@ def main(argv=None) -> int:
         help="emit the aggregated per-kind event counts (or the run-dir"
              " report dict) as JSON instead of text",
     )
+    parser.add_argument(
+        "--watch", action="store_true",
+        help="run-dir mode: live terminal dashboard — tail the run's shards"
+             " through the streaming aggregator and refresh step rate,"
+             " per-fabric utilization, the alert feed, and the serving SLO"
+             " tiles in place (Ctrl-C to stop)",
+    )
+    parser.add_argument(
+        "--interval", type=float, default=1.0,
+        help="--watch: seconds between dashboard refreshes",
+    )
+    parser.add_argument(
+        "--watch-iterations", type=int, default=0,
+        help="--watch: stop after this many refreshes (0 = until"
+             " interrupted; a bound exists for tests/CI)",
+    )
     args = parser.parse_args(argv)
     if not args.logs and not args.run_dir:
         parser.error("need JSONL file(s) or --run-dir")
+    if args.watch:
+        if not args.run_dir:
+            parser.error("--watch requires --run-dir")
+        return watch_run(
+            args.run_dir,
+            interval=args.interval,
+            iterations=args.watch_iterations,
+        )
 
     if args.run_dir:
         text, report = run_report(

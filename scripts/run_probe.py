@@ -36,6 +36,9 @@ import json
 import os
 import shutil
 import sys
+import threading
+import time
+import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -334,6 +337,186 @@ def main(argv=None) -> int:
         f"# run_probe: serving fail-over ok ({len(records)} request(s)"
         f" completed, {requeues} requeue(s) survived a mid-decode rank"
         f" death) at {serve_dir}; report -> {serve_json}\n"
+    )
+
+    # --- phase 4: the live telemetry plane round-trip --------------------
+    # a supervised 2-rank run with the health sampler on and a chaos
+    # grad_spike on rank 0: the supervisor's aggregator must detect the
+    # spike from the streaming shards, serve it on /metrics (scraped
+    # MID-RUN on the ephemeral advertised port), log the AlertEvent in its
+    # own shard, and feed it back through alerts.jsonl so the workers'
+    # FallbackController descends with an ``alert:`` trigger — all before
+    # the run ends. Post-hoc, the live gauges must agree with the merged
+    # report's numbers to 5%.
+    from network_distributed_pytorch_tpu.observe.live import (
+        LiveAggregator,
+        read_port_file,
+    )
+    from network_distributed_pytorch_tpu.resilience.chaos import (
+        ChaosPlan,
+        FaultSpec,
+    )
+
+    live_dir = run_dir + "_live"
+    shutil.rmtree(live_dir, ignore_errors=True)
+    os.makedirs(live_dir, exist_ok=True)
+    live_steps = 40
+    # slow the toy steps slightly: the spike must be detected, appended to
+    # alerts.jsonl, and read back by the workers while steps remain
+    live_step_s = max(args.step_seconds, 0.03)
+    spike_step = 8  # >= 3 baseline health samples first (EWMA warmup guard)
+    plan_path = os.path.join(live_dir, "chaos_plan.json")
+    ChaosPlan(
+        [FaultSpec(kind="grad_spike", step=spike_step, rank=0)]
+    ).save(plan_path)
+
+    def live_argv_for_rank(rank, world_size, incarnation):
+        return [
+            sys.executable, worker,
+            "--rank", str(rank),
+            "--world", str(world_size),
+            "--steps", str(live_steps),
+            "--state-dir", os.path.join(live_dir, "state"),
+            "--result-dir", os.path.join(live_dir, "results"),
+            "--step-seconds", str(live_step_s),
+            "--health-every", "1",
+            "--chaos-plan", plan_path,
+        ]
+
+    live_telemetry = telemetry_for_run(
+        event_log=os.path.join(live_dir, SUPERVISOR_LOG), stdout=False
+    )
+    live_supervisor = Supervisor(
+        argv_for_rank=live_argv_for_rank,
+        world_size=args.world,
+        config=SupervisorConfig(
+            max_restarts=1, backoff_base_s=0.05, poll_interval_s=0.05,
+            metrics_port=0,
+        ),
+        telemetry=live_telemetry,
+        run_dir=live_dir,
+    )
+
+    scrape = {}
+
+    def _scrape_mid_run():
+        # wait for the supervisor to advertise the ephemeral port, then
+        # scrape until the exposition carries real step counters
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            port = read_port_file(live_dir)
+            if port is not None:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=2.0
+                    ) as resp:
+                        body = resp.read().decode("utf-8", "replace")
+                        scrape["status"] = resp.status
+                        scrape["body"] = body
+                    if "live_steps_total" in body:
+                        return
+                except OSError:
+                    pass
+            time.sleep(0.05)
+
+    scraper = threading.Thread(target=_scrape_mid_run, daemon=True)
+    scraper.start()
+    live_result = live_supervisor.run()
+    scraper.join(timeout=20.0)
+    live_telemetry.close()
+
+    problems = []
+    if not live_result.success:
+        problems.append(f"live run failed: {live_result}")
+    if scrape.get("status") != 200:
+        problems.append(
+            f"mid-run /metrics scrape failed (status {scrape.get('status')!r})"
+        )
+    elif "live_steps_total" not in scrape.get("body", ""):
+        problems.append("mid-run /metrics scrape carried no step counters")
+
+    live_json = os.path.join(
+        os.path.dirname(args.json_out) or ".", "live_report.json"
+    )
+    rc = report.main(["--run-dir", live_dir, "--json-out", live_json])
+    if rc != 0:
+        return rc
+    with open(live_json) as f:
+        live_report = json.load(f)
+
+    alerts = live_report.get("alerts") or {}
+    if not alerts.get("fired"):
+        problems.append("no AlertEvent reached the merged run report")
+    elif not (alerts.get("by_kind") or {}).get("grad_spike"):
+        problems.append(
+            f"grad_spike never fired (alerts: {alerts.get('by_kind')})"
+        )
+
+    # the supervisor must have logged the alert in its OWN shard
+    sup_alerts = 0
+    try:
+        with open(os.path.join(live_dir, SUPERVISOR_LOG)) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("event") == "alert":
+                    sup_alerts += 1
+    except OSError:
+        pass
+    if not sup_alerts:
+        problems.append("no alert record in the supervisor's own shard")
+
+    # ...and the feedback leg: a worker-side FallbackController descend
+    # whose trigger names the alert (the mid-epoch nudge, not a boundary
+    # verdict) must appear in the merged policy records
+    nudges = [
+        p for p in (live_report.get("policy") or {}).get("decisions", [])
+        if str(p.get("trigger", "")).startswith("alert:")
+    ]
+    if not nudges:
+        problems.append(
+            "no alert-triggered PolicyEvent — the alerts.jsonl feedback"
+            " leg never reached a worker's controller"
+        )
+
+    # the acceptance bar: live gauges vs the post-hoc report, within 5%
+    agg = LiveAggregator(live_dir)
+    agg.poll()
+    live_p50 = agg.registry.get_gauge("live_step_time_p50_seconds")
+    rep_p50 = live_report.get("step_p50_s")
+    if not (
+        isinstance(live_p50, (int, float)) and isinstance(rep_p50, (int, float))
+        and rep_p50 > 0 and abs(live_p50 - rep_p50) / rep_p50 <= 0.05
+    ):
+        problems.append(
+            f"live step-time gauge {live_p50!r} disagrees with report"
+            f" step_p50_s {rep_p50!r} by more than 5%"
+        )
+    live_bw = agg.registry.get_gauge("live_comm_bytes_per_s")
+    rep_bw = (
+        ((live_report.get("bandwidth") or {}).get("total") or {})
+        .get("achieved_bytes_per_s")
+    )
+    if not (
+        isinstance(live_bw, (int, float)) and isinstance(rep_bw, (int, float))
+        and rep_bw > 0 and abs(live_bw - rep_bw) / rep_bw <= 0.05
+    ):
+        problems.append(
+            f"live bytes/s gauge {live_bw!r} disagrees with report"
+            f" achieved_bytes_per_s {rep_bw!r} by more than 5%"
+        )
+
+    if problems:
+        for prob in problems:
+            sys.stderr.write(f"# run_probe: FAIL: {prob}\n")
+        return 1
+    sys.stderr.write(
+        f"# run_probe: live plane ok ({alerts.get('fired')} alert(s),"
+        f" {len(nudges)} controller nudge(s), mid-run /metrics scrape on"
+        f" port {read_port_file(live_dir)}) at {live_dir};"
+        f" report -> {live_json}\n"
     )
     return 0
 

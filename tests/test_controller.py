@@ -193,3 +193,77 @@ def test_deadline_model_scales_with_payload_and_fabric():
     assert budget > derive_collective_deadline(
         payload, 8, "100GbE", slack=2.0, floor_s=0.05
     )
+
+
+# ---- mid-epoch alert nudges (the live plane's entry point) -----------------
+
+
+def test_nudge_critical_descends_immediately():
+    c = FallbackController(descend_after=3)  # boundary would need 3 epochs
+    d = c.nudge("grad_spike", epoch=2, severity="critical")
+    assert d is not None and d.action == "descend"
+    assert d.trigger == "alert:grad_spike:critical"
+    assert d.epoch == 2
+    assert c.index == 1
+    assert c.nudged_epoch == 2
+
+
+def test_nudge_comm_shaped_warn_descends_immediately():
+    for alert in ("bandwidth_collapse", "step_time_drift"):
+        c = FallbackController(descend_after=3)
+        d = c.nudge(alert, epoch=0, severity="warn")
+        assert d is not None and d.trigger == f"alert:{alert}:warn"
+
+
+def test_nudge_other_warn_precharges_streak():
+    c = FallbackController(descend_after=2)
+    # a non-comm warn returns no decision but pre-charges the streak:
+    # the next degraded boundary epoch descends one epoch sooner
+    assert c.nudge("grad_spike", epoch=0, severity="warn") is None
+    assert c.index == 0
+    d = c.observe(_health(epoch=0, degraded=1))
+    assert d is not None and d.action == "descend"
+
+
+def test_nudge_at_most_one_descend_per_epoch():
+    c = FallbackController()
+    assert c.nudge("grad_spike", epoch=1, severity="critical") is not None
+    # same epoch: the decision budget is spent (even for a comm alert)
+    assert c.nudge("bandwidth_collapse", epoch=1, severity="warn") is None
+    assert c.index == 1
+    # a later epoch spends its own budget
+    assert c.nudge("grad_spike", epoch=2, severity="critical") is not None
+    assert c.index == 2
+
+
+def test_nudged_epoch_boundary_observe_is_noop():
+    c = FallbackController(descend_after=1)
+    assert c.nudge("grad_spike", epoch=3, severity="critical") is not None
+    # the SAME epoch's boundary verdict must not double-move on the same
+    # evidence, no matter how degraded the numbers look
+    assert c.observe(_health(epoch=3, expiries=5, degraded=9)) is None
+    assert c.index == 1
+    # the NEXT epoch's boundary owns its decision again
+    d = c.observe(_health(epoch=4, degraded=1))
+    assert d is not None and d.rung_index_after == 2
+
+
+def test_nudge_at_bottom_rung_holds():
+    c = FallbackController(start_index=len(DEFAULT_LADDER) - 1)
+    assert c.nudge("grad_spike", epoch=0, severity="critical") is None
+    assert c.index == len(DEFAULT_LADDER) - 1
+    # the budget was NOT spent by the refused move
+    assert c.nudged_epoch is None
+
+
+def test_nudge_descend_emits_policy_event_with_alert_trigger():
+    sink = MemorySink()
+    telemetry = Telemetry([sink])
+    c = FallbackController(telemetry=telemetry, rank=0)
+    d = c.nudge("bandwidth_collapse", epoch=0, severity="critical")
+    c.record(d, predicted_bytes_per_step=10.0, realized_bytes_per_step=100.0)
+    telemetry.close()
+    recs = [r for r in sink.records if r["event"] == "policy"]
+    assert len(recs) == 1
+    assert recs[0]["trigger"] == "alert:bandwidth_collapse:critical"
+    assert recs[0]["action"] == "descend"

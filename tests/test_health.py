@@ -1,0 +1,231 @@
+"""Streaming EWMA health detectors (jax-free, fast).
+
+Every detector is clock-free and O(1) per observation, so each behavior
+the live plane relies on is pinned exactly: EWMA warmup, spike severity
+bands (warn vs the NaN-precursor critical), baseline freezing (a spike
+or a drift must not poison the envelope it is judged against),
+sustain-before-fire, cooldown heartbeats, and the monitor's per-rank
+detector isolation.
+"""
+
+import math
+
+import pytest
+
+from network_distributed_pytorch_tpu.observe.health import (
+    BandwidthCollapseDetector,
+    DetectorConfig,
+    Ewma,
+    GradNormSpikeDetector,
+    HealthMonitor,
+    LossPlateauDetector,
+    SloBurnRateDetector,
+    StepTimeDriftDetector,
+)
+
+
+# ---------------------------------------------------------------------------
+# the primitive
+# ---------------------------------------------------------------------------
+
+
+def test_ewma_mean_and_std():
+    e = Ewma(alpha=0.5)
+    assert e.mean is None and e.std == 0.0
+    e.update(1.0)
+    assert e.mean == 1.0
+    assert e.std == 0.0  # a single sample has no spread
+    e.update(3.0)
+    assert e.mean == pytest.approx(2.0)
+    assert e.std > 0.0
+    for _ in range(50):
+        e.update(2.0)
+    assert e.mean == pytest.approx(2.0, rel=1e-3)
+    assert e.std == pytest.approx(0.0, abs=0.1)
+
+
+# ---------------------------------------------------------------------------
+# grad-norm spike
+# ---------------------------------------------------------------------------
+
+
+def test_grad_spike_needs_warmup():
+    det = GradNormSpikeDetector(DetectorConfig())
+    # fewer than 3 observations: even a huge value cannot fire (the EWMA
+    # has no envelope yet)
+    assert det.observe(1.0) is None
+    assert det.observe(1e6) is None
+
+
+def test_grad_spike_warn_and_critical_bands():
+    cfg = DetectorConfig(cooldown=0)
+    det = GradNormSpikeDetector(cfg)
+    for _ in range(10):
+        assert det.observe(1.0) is None
+    warn = det.observe(5.0)  # > 3x mean but < 50x mean
+    assert warn is not None and warn.severity == "warn"
+    critical = det.observe(100.0)  # > nan_factor x mean
+    assert critical is not None and critical.severity == "critical"
+    assert "NaN precursor" in critical.message
+
+
+def test_grad_spike_non_finite_is_critical():
+    det = GradNormSpikeDetector(DetectorConfig())
+    a = det.observe(float("nan"))
+    assert a is not None and a.severity == "critical"
+    assert a.value == float("inf")  # JSON-safe
+
+
+def test_grad_spike_does_not_poison_baseline():
+    det = GradNormSpikeDetector(DetectorConfig(cooldown=0))
+    for _ in range(10):
+        det.observe(1.0)
+    assert det.observe(1000.0) is not None
+    # the spike was NOT folded into the EWMA: normal values stay quiet and
+    # an identical second spike still fires
+    assert det.observe(1.0) is None
+    assert det.observe(1000.0) is not None
+
+
+def test_grad_spike_cooldown_silences_repeats():
+    det = GradNormSpikeDetector(DetectorConfig(cooldown=5))
+    for _ in range(10):
+        det.observe(1.0)
+    assert det.observe(1000.0) is not None
+    # within the cooldown window: sick but silent
+    assert det.observe(1000.0) is None
+    assert det.fired == 1
+
+
+# ---------------------------------------------------------------------------
+# loss plateau
+# ---------------------------------------------------------------------------
+
+
+def test_loss_plateau_quiet_while_improving():
+    cfg = DetectorConfig(plateau_sustain=3, plateau_min_obs=5)
+    det = LossPlateauDetector(cfg)
+    loss = 10.0
+    for _ in range(50):
+        assert det.observe(loss) is None
+        loss *= 0.9  # healthy steady improvement
+
+
+def test_loss_plateau_fires_on_flat_loss():
+    cfg = DetectorConfig(plateau_sustain=3, plateau_min_obs=5, cooldown=0)
+    det = LossPlateauDetector(cfg)
+    fired = [det.observe(1.0) for _ in range(40)]
+    alerts = [a for a in fired if a is not None]
+    assert alerts and alerts[0].alert == "loss_plateau"
+    assert alerts[0].severity == "warn"
+
+
+# ---------------------------------------------------------------------------
+# step-time drift
+# ---------------------------------------------------------------------------
+
+
+def test_step_time_drift_fires_and_freezes_baseline():
+    cfg = DetectorConfig(drift_sustain=3, drift_min_obs=5, cooldown=0)
+    det = StepTimeDriftDetector(cfg)
+    for _ in range(20):
+        assert det.observe(0.010) is None
+    fired = []
+    for _ in range(30):
+        a = det.observe(0.030)  # 3x the baseline
+        if a is not None:
+            fired.append(a)
+    assert fired and fired[0].alert == "step_time_drift"
+    # the baseline froze while drifted: it still reads ~10 ms, so the
+    # detector keeps firing (a heartbeat) instead of self-silencing
+    assert len(fired) >= 2
+    assert det._slow.mean == pytest.approx(0.010, rel=0.05)
+
+
+def test_step_time_drift_ignores_nonpositive():
+    det = StepTimeDriftDetector(DetectorConfig())
+    for v in (0.0, -1.0, float("nan")):
+        assert det.observe(v) is None
+
+
+# ---------------------------------------------------------------------------
+# bandwidth collapse
+# ---------------------------------------------------------------------------
+
+
+def test_bandwidth_collapse_fires_after_sustain():
+    cfg = DetectorConfig(collapse_sustain=3, collapse_min_obs=5, cooldown=0)
+    det = BandwidthCollapseDetector(cfg)
+    for _ in range(10):
+        assert det.observe(100e6) is None
+    results = [det.observe(10e6) for _ in range(5)]  # 0.1x baseline
+    fired = [a for a in results if a is not None]
+    # sustain=3: the first two collapsed windows accumulate, the third fires
+    assert results[0] is None and results[1] is None
+    assert fired and fired[0].alert == "bandwidth_collapse"
+
+
+def test_bandwidth_collapse_sustain_resets_on_recovery():
+    cfg = DetectorConfig(collapse_sustain=3, collapse_min_obs=5, cooldown=0)
+    det = BandwidthCollapseDetector(cfg)
+    for _ in range(10):
+        det.observe(100e6)
+    assert det.observe(10e6) is None
+    assert det.observe(10e6) is None
+    assert det.observe(100e6) is None  # recovery resets the streak
+    assert det.observe(10e6) is None
+    assert det.observe(10e6) is None
+    assert det.fired == 0
+
+
+# ---------------------------------------------------------------------------
+# serving SLO burn
+# ---------------------------------------------------------------------------
+
+
+def test_slo_burn_fires_over_target():
+    cfg = DetectorConfig(slo_target_s=1.0, slo_sustain=2, cooldown=0)
+    det = SloBurnRateDetector(cfg)
+    assert det.observe(0.5) is None
+    assert det.observe(1.5) is None  # sustain=2: first breach accumulates
+    a = det.observe(1.5)
+    assert a is not None and a.alert == "slo_burn"
+    assert a.threshold == 1.0
+
+
+# ---------------------------------------------------------------------------
+# the monitor
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_per_rank_grad_detectors_are_isolated():
+    mon = HealthMonitor(DetectorConfig(cooldown=0))
+    # rank 0 learns a 1.0 baseline; rank 1 a 100.0 baseline
+    for _ in range(10):
+        assert mon.observe_grad_norm(1.0, rank=0) == []
+        assert mon.observe_grad_norm(100.0, rank=1) == []
+    # 100.0 is a spike for rank 0 but baseline for rank 1
+    fired = mon.observe_grad_norm(100.0, rank=0, step=7)
+    assert len(fired) == 1
+    assert fired[0].rank == 0 and fired[0].step == 7
+    assert mon.observe_grad_norm(100.0, rank=1) == []
+
+
+def test_monitor_collects_and_counts_by_kind():
+    mon = HealthMonitor(DetectorConfig(slo_target_s=1.0, slo_sustain=1,
+                                       cooldown=0))
+    mon.observe_serving_p99(2.0)
+    mon.observe_serving_p99(3.0)
+    assert len(mon.alerts) == 2
+    assert mon.fired_by_kind() == {"slo_burn": 2}
+
+
+def test_monitor_alert_records_are_json_safe():
+    mon = HealthMonitor(DetectorConfig())
+    mon.observe_grad_norm(float("inf"), rank=0)
+    for a in mon.alerts:
+        rec = a.record()
+        assert rec["event"] == "alert"
+        assert not any(
+            isinstance(v, float) and math.isnan(v) for v in rec.values()
+        )

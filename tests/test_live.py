@@ -1,0 +1,450 @@
+"""Live telemetry plane units (jax-free, fast).
+
+Pins the streaming half of the observability stack: the metric registry
+and its Prometheus text exposition, the event->metric derivation shared
+by the in-process sink and the aggregator, resumable shard tailing (torn
+tail mid-line, undecodable lines, restart markers, persisted offsets —
+no event duplicated or dropped), the supervisor-side aggregator's gauge
+math against the same analytics the post-hoc report uses, the /metrics
+HTTP endpoint, and the alerts.jsonl feedback channel.
+"""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from network_distributed_pytorch_tpu.observe import (
+    CollectiveEvent,
+    MemorySink,
+    StepEvent,
+    Telemetry,
+    TrainHealthEvent,
+    analytics,
+    runlog,
+)
+from network_distributed_pytorch_tpu.observe.health import DetectorConfig
+from network_distributed_pytorch_tpu.observe.live import (
+    AlertFeed,
+    LiveAggregator,
+    MetricRegistry,
+    MetricSink,
+    MetricsHTTPServer,
+    ShardFollower,
+    append_alert,
+    ingest_record,
+    read_port_file,
+)
+
+
+# ---------------------------------------------------------------------------
+# registry + exposition
+# ---------------------------------------------------------------------------
+
+
+def test_registry_counter_gauge_histogram():
+    reg = MetricRegistry()
+    reg.counter("c_total", rank="0")
+    reg.counter("c_total", 2.0, rank="0")
+    reg.counter("c_total", rank="1")
+    reg.gauge("g", 1.5)
+    reg.gauge("g", 2.5)  # last write wins
+    for v in (1.0, 2.0, 3.0, 4.0):
+        reg.observe("h_seconds", v)
+    assert reg.get_counter("c_total", rank="0") == 3.0
+    assert reg.get_counter("c_total", rank="1") == 1.0
+    assert reg.get_counter("c_total", rank="9") == 0.0
+    assert reg.get_gauge("g") == 2.5
+    assert reg.get_gauge("missing") is None
+    h = reg.get_histogram("h_seconds")
+    assert h.count == 4 and h.total == 10.0
+    # analytics.percentile is nearest-rank, like the report's
+    assert h.percentile(50) == pytest.approx(3.0)
+
+
+def test_registry_histogram_window_rolls():
+    reg = MetricRegistry()
+    for v in range(10):
+        reg.observe("h", float(v), window=4)
+    h = reg.get_histogram("h")
+    # cumulative count/sum, but percentiles over the last 4 only (6..9)
+    assert h.count == 10
+    assert h.percentile(50) == pytest.approx(8.0)
+    assert h.percentile(0) == pytest.approx(6.0)
+
+
+def test_registry_snapshot_shape():
+    reg = MetricRegistry()
+    reg.counter("live_steps_total", rank="0")
+    reg.gauge("live_loss", 0.5, rank="0")
+    reg.observe("live_step_time_seconds", 0.01, rank="0")
+    snap = reg.snapshot()
+    assert snap["live_steps_total"]['{rank="0"}'] == 1.0
+    assert snap["live_loss"]['{rank="0"}'] == 0.5
+    hist = snap["live_step_time_seconds"]['{rank="0"}']
+    assert hist["count"] == 1 and hist["p50"] == pytest.approx(0.01)
+
+
+def test_prometheus_exposition_format():
+    reg = MetricRegistry()
+    reg.counter("x_total", help="things", rank="0")
+    reg.gauge("y", float("inf"))
+    reg.observe("z_seconds", 0.25)
+    text = reg.render_prometheus()
+    assert "# HELP x_total things" in text
+    assert "# TYPE x_total counter" in text
+    assert 'x_total{rank="0"} 1.0' in text
+    assert "y +Inf" in text
+    assert "# TYPE z_seconds summary" in text
+    assert 'z_seconds{quantile="0.5"} 0.25' in text
+    assert "z_seconds_count 1" in text
+    assert "z_seconds_sum 0.25" in text
+    # scrape freshness: the module's one sanctioned wall-clock read
+    assert "live_scrape_unix_time" in text
+
+
+# ---------------------------------------------------------------------------
+# event -> metric derivation
+# ---------------------------------------------------------------------------
+
+
+def test_ingest_step_and_collective_and_health():
+    reg = MetricRegistry()
+    ingest_record(
+        reg, {"event": "step", "step_time_s": 0.02, "loss": 0.7}, rank=1
+    )
+    ingest_record(
+        reg, {"event": "step", "step_time_s": 0.04, "loss": 0.6,
+              "valid": False}, rank=1
+    )
+    ingest_record(
+        reg,
+        {"event": "collective", "tag": "grads", "payload_bytes": 1024},
+    )
+    ingest_record(
+        reg,
+        {"event": "train_health", "grad_norm": 2.0, "ef_memory_norm": 0.5,
+         "powersgd_rel_error": 0.1, "rank": 0},
+    )
+    assert reg.get_counter("live_steps_total", rank="1") == 2.0
+    # the invalid step counts but its time is not observed
+    assert reg.get_histogram("live_step_time_seconds", rank="1").count == 1
+    assert reg.get_gauge("live_loss", rank="1") == 0.6
+    assert reg.get_counter("live_comm_bytes_total", tag="grads") == 1024.0
+    assert reg.get_gauge("live_grad_norm", rank="0") == 2.0
+    assert reg.get_gauge("live_ef_memory_norm", rank="0") == 0.5
+    assert reg.get_gauge("live_powersgd_rel_error", rank="0") == 0.1
+
+
+def test_ingest_serving_request_split():
+    reg = MetricRegistry()
+    ingest_record(
+        reg,
+        {"event": "request", "state": "finished", "total_s": 1.0,
+         "queue_s": 0.2, "decode_s": 0.5, "tokens_generated": 10},
+    )
+    ingest_record(reg, {"event": "request", "state": "failed"})
+    assert reg.get_counter("live_serving_requests_total", state="finished") == 1
+    assert reg.get_counter("live_serving_requests_total", state="failed") == 1
+    assert reg.get_histogram("live_serving_total_seconds").count == 1
+    ms = reg.get_histogram("live_serving_decode_ms_per_token")
+    assert ms.percentile(50) == pytest.approx(50.0)
+
+
+def test_metric_sink_rides_telemetry():
+    sink = MetricSink()
+    telemetry = Telemetry([sink])
+    # StepEvent carries no rank; the in-process sink labels it "?"
+    telemetry.emit(
+        StepEvent(step=0, epoch=0, loss=1.0, step_time_s=0.01,
+                  bits_cumulative=0)
+    )
+    telemetry.emit(
+        CollectiveEvent(label="l", tag="t", layer="r", op="all-reduce",
+                        axis="data", dtype="float32", payload_bytes=64)
+    )
+    telemetry.close()
+    assert sink.registry.get_counter("live_steps_total", rank="?") == 1.0
+    assert sink.registry.get_counter("live_comm_bytes_total", tag="t") == 64.0
+
+
+# ---------------------------------------------------------------------------
+# resumable shard tailing
+# ---------------------------------------------------------------------------
+
+
+def _writeln(path, obj, newline=True):
+    with open(path, "a") as f:
+        f.write(json.dumps(obj) + ("\n" if newline else ""))
+
+
+def test_follower_torn_tail_not_consumed(tmp_path):
+    shard = str(tmp_path / "events_rank0.jsonl")
+    _writeln(shard, {"event": "step", "step": 0})
+    _writeln(shard, {"event": "step", "step": 1}, newline=False)  # torn tail
+    f = ShardFollower(shard)
+    first = f.poll()
+    assert [e["step"] for e in first] == [0]
+    assert f.torn == 0  # a half-written tail is pending, not torn
+    # the writer finishes the line and appends one more
+    with open(shard, "a") as fh:
+        fh.write("\n")
+    _writeln(shard, {"event": "step", "step": 2})
+    second = f.poll()
+    assert [e["step"] for e in second] == [1, 2]  # no dup, no drop
+    assert f.poll() == []
+
+
+def test_follower_counts_undecodable_complete_lines(tmp_path):
+    shard = str(tmp_path / "events_rank0.jsonl")
+    _writeln(shard, {"event": "step", "step": 0})
+    with open(shard, "a") as fh:
+        fh.write("{this is not json}\n")
+    _writeln(shard, {"event": "step", "step": 1})
+    f = ShardFollower(shard)
+    assert [e["step"] for e in f.poll()] == [0, 1]
+    assert f.torn == 1
+
+
+def test_follower_resumes_from_persisted_offset(tmp_path):
+    shard = str(tmp_path / "events_rank0.jsonl")
+    for i in range(3):
+        _writeln(shard, {"event": "step", "step": i})
+    f = ShardFollower(shard)
+    assert len(f.poll()) == 3
+    saved = f.offset
+    for i in range(3, 6):
+        _writeln(shard, {"event": "step", "step": i})
+    resumed = ShardFollower(shard, offset=saved)
+    assert [e["step"] for e in resumed.poll()] == [3, 4, 5]
+
+
+def test_follower_missing_file_is_quiet(tmp_path):
+    f = ShardFollower(str(tmp_path / "absent.jsonl"))
+    assert f.poll() == []
+    assert f.offset == 0
+
+
+# ---------------------------------------------------------------------------
+# the aggregator
+# ---------------------------------------------------------------------------
+
+
+def _marker(rank, incarnation, ts, ts_mono):
+    return {
+        "event": "marker", "kind": "run_start", "run_id": "runL",
+        "rank": rank, "world_size": 2, "incarnation": incarnation,
+        "ts": ts, "ts_mono": ts_mono,
+    }
+
+
+def _step(rank, step, dt, ts, ts_mono, loss=None):
+    rec = {
+        "event": "step", "step": step, "epoch": 0, "step_time_s": dt,
+        "rank": rank, "ts": ts, "ts_mono": ts_mono,
+    }
+    if loss is not None:
+        rec["loss"] = loss
+    return rec
+
+
+def _toy_run(tmp_path, times_by_rank, payload=1 << 20):
+    """A two-rank run dir with a manifest, markers, one wire-ledger
+    collective per rank (deduped by the aggregator), and steady steps."""
+    run_dir = str(tmp_path)
+    m = runlog.new_manifest("runL", world_size=2)
+    for r in (0, 1):
+        m.record_spawn(rank=r, incarnation=0, world_size=2,
+                       spawned_unix=100.0)
+    m.save(run_dir)
+    for r, times in times_by_rank.items():
+        shard = os.path.join(run_dir, runlog.shard_name(r))
+        _writeln(shard, _marker(r, 0, 100.5, 50.0))
+        _writeln(shard, {
+            "event": "collective", "label": "toy", "tag": "toy.grads",
+            "layer": "reducer", "op": "all-reduce", "axis": "data",
+            "dtype": "float32", "payload_bytes": payload, "rank": r,
+            "ts": 100.5, "ts_mono": 50.0,
+        })
+        t = 101.0
+        mono = 51.0
+        for i, dt in enumerate(times):
+            t += dt
+            mono += dt
+            _writeln(shard, _step(r, i, dt, t, mono))
+    return run_dir
+
+
+def test_aggregator_gauges_match_report_statistics(tmp_path):
+    # first timed step per incarnation pays compile and must be dropped
+    times = {0: [0.5, 0.01, 0.02, 0.03], 1: [0.5, 0.02, 0.02, 0.04]}
+    run_dir = _toy_run(tmp_path, times)
+    agg = LiveAggregator(run_dir)
+    agg.poll()
+    expected_p50 = analytics.percentile(
+        [analytics.percentile(times[0][1:], 50),
+         analytics.percentile(times[1][1:], 50)], 50,
+    )
+    assert agg.step_p50_s() == pytest.approx(expected_p50)
+    assert agg.registry.get_gauge(
+        "live_step_time_p50_seconds"
+    ) == pytest.approx(expected_p50)
+    # bytes/s: same effective_bandwidth call the report makes, over the
+    # deduped ledger (two ranks emitted the same collective once)
+    bw = agg.bandwidth()
+    expected = analytics.effective_bandwidth(
+        expected_p50,
+        [{"label": "toy", "tag": "toy.grads", "op": "all-reduce",
+          "dtype": "float32", "payload_bytes": 1 << 20}],
+        2,
+    )
+    assert bw["total"]["achieved_bytes_per_s"] == pytest.approx(
+        expected["total"]["achieved_bytes_per_s"]
+    )
+    assert agg.registry.get_gauge("live_comm_bytes_per_s") == pytest.approx(
+        expected["total"]["achieved_bytes_per_s"]
+    )
+    assert agg.registry.get_counter("live_steps_total", rank="0") == 4.0
+
+
+def test_aggregator_restart_marker_drops_new_first_step(tmp_path):
+    run_dir = _toy_run(tmp_path, {0: [0.5, 0.01, 0.01], 1: [0.5, 0.01, 0.01]})
+    agg = LiveAggregator(run_dir)
+    agg.poll()
+    # rank 1 restarts: new incarnation marker, then its own compile-paying
+    # first step (slow) and steady steps — the slow step must NOT land in
+    # the steady-state stats
+    shard = os.path.join(run_dir, runlog.shard_name(1))
+    _writeln(shard, _marker(1, 1, 110.0, 10.0))
+    _writeln(shard, _step(1, 3, 0.9, 110.9, 10.9))
+    _writeln(shard, _step(1, 4, 0.01, 110.91, 10.91))
+    agg.poll()
+    assert 0.9 not in agg._steady[1]
+    assert agg._steady[1].count(0.01) >= 2
+
+
+def test_aggregator_offsets_roundtrip_no_double_count(tmp_path):
+    run_dir = _toy_run(tmp_path, {0: [0.5, 0.01], 1: [0.5, 0.01]})
+    agg = LiveAggregator(run_dir)
+    agg.poll()
+    offsets = os.path.join(run_dir, "offsets.json")
+    agg.save_offsets(offsets)
+
+    shard = os.path.join(run_dir, runlog.shard_name(0))
+    _writeln(shard, _step(0, 2, 0.02, 102.0, 52.0))
+    follower = LiveAggregator(run_dir)
+    follower.load_offsets(offsets)
+    follower.poll()
+    # the resumed aggregator sees ONLY the new step
+    assert follower.registry.get_counter("live_steps_total", rank="0") == 1.0
+    assert follower.registry.get_counter("live_steps_total", rank="1") == 0.0
+
+
+def test_aggregator_fires_grad_spike_alert(tmp_path):
+    run_dir = _toy_run(tmp_path, {0: [0.5, 0.01], 1: [0.5, 0.01]})
+    shard = os.path.join(run_dir, runlog.shard_name(0))
+    t = 103.0
+    for i in range(4):
+        _writeln(shard, {
+            "event": "train_health", "step": i, "grad_norm": 1.0,
+            "rank": 0, "ts": t + i, "ts_mono": 53.0 + i,
+        })
+    _writeln(shard, {
+        "event": "train_health", "step": 4, "grad_norm": 1000.0,
+        "rank": 0, "ts": t + 4, "ts_mono": 57.0,
+    })
+    agg = LiveAggregator(run_dir)
+    fired = agg.poll()
+    spikes = [a for a in fired if a.alert == "grad_spike"]
+    assert len(spikes) == 1
+    assert spikes[0].severity == "critical"
+    assert spikes[0].rank == 0
+    assert agg.registry.get_counter(
+        "live_alerts_fired_total", alert="grad_spike", severity="critical"
+    ) == 1.0
+    # idle polls fire nothing new
+    assert agg.poll() == []
+
+
+def test_aggregator_counts_torn_lines(tmp_path):
+    run_dir = _toy_run(tmp_path, {0: [0.5, 0.01], 1: [0.5, 0.01]})
+    shard = os.path.join(run_dir, runlog.shard_name(0))
+    with open(shard, "a") as fh:
+        fh.write("not json at all\n")
+    _writeln(shard, _step(0, 2, 0.02, 102.0, 52.0))
+    agg = LiveAggregator(run_dir)
+    agg.poll()
+    assert agg.registry.get_gauge("live_torn_lines_total") == 1.0
+
+
+def test_aggregator_detector_config_threading(tmp_path):
+    run_dir = _toy_run(tmp_path, {0: [0.5, 0.01], 1: [0.5, 0.01]})
+    cfg = DetectorConfig(spike_sigma=2.0, nan_factor=5.0)
+    agg = LiveAggregator(run_dir, detector_config=cfg)
+    assert agg.monitor.config.nan_factor == 5.0
+
+
+# ---------------------------------------------------------------------------
+# /metrics exposition server
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_http_server_scrape_and_port_file(tmp_path):
+    reg = MetricRegistry()
+    reg.counter("live_steps_total", 7.0, rank="0")
+    server = MetricsHTTPServer(reg, port=0).start()
+    try:
+        assert server.port > 0
+        server.write_port_file(str(tmp_path))
+        assert read_port_file(str(tmp_path)) == server.port
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5.0) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+        assert 'live_steps_total{rank="0"} 7.0' in body
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5.0) as resp:
+            assert resp.status == 200
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope", timeout=5.0)
+    finally:
+        server.close()
+
+
+def test_read_port_file_absent(tmp_path):
+    assert read_port_file(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# the alerts.jsonl feedback channel
+# ---------------------------------------------------------------------------
+
+
+def test_alert_feed_roundtrip(tmp_path):
+    run_dir = str(tmp_path)
+    feed = AlertFeed(run_dir)
+    assert feed.poll() == []  # channel not created yet
+    append_alert(run_dir, {"event": "alert", "alert": "grad_spike",
+                           "severity": "critical"})
+    append_alert(run_dir, {"event": "marker", "kind": "noise"})
+    got = feed.poll()
+    assert len(got) == 1 and got[0]["alert"] == "grad_spike"
+    # incremental: nothing new, nothing returned
+    assert feed.poll() == []
+    append_alert(run_dir, {"event": "alert", "alert": "slo_burn",
+                           "severity": "warn"})
+    assert [r["alert"] for r in feed.poll()] == ["slo_burn"]
+
+
+def test_memory_sink_records_train_health_event():
+    sink = MemorySink()
+    telemetry = Telemetry([sink])
+    telemetry.emit(TrainHealthEvent(step=3, epoch=1, grad_norm=1.5,
+                                    ef_memory_norm=0.2,
+                                    powersgd_rel_error=0.05, rank=0))
+    telemetry.close()
+    recs = [r for r in sink.records if r["event"] == "train_health"]
+    assert len(recs) == 1
+    assert recs[0]["grad_norm"] == 1.5
+    assert recs[0]["powersgd_rel_error"] == 0.05

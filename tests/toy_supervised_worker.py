@@ -40,6 +40,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from network_distributed_pytorch_tpu.resilience.chaos import (  # noqa: E402
+    HEALTH_FAULTS,
     PREEMPT_EXIT_CODE,
     PROCESS_FAULTS,
     ChaosPlan,
@@ -49,11 +50,14 @@ from network_distributed_pytorch_tpu.observe import (  # noqa: E402
     CompileEvent,
     FailureEvent,
     StepEvent,
+    TrainHealthEvent,
     recording,
     span,
     telemetry_for_run,
 )
+from network_distributed_pytorch_tpu.observe.live import AlertFeed  # noqa: E402
 from network_distributed_pytorch_tpu.observe.runlog import (  # noqa: E402
+    ENV_RUN_DIR,
     shard_event_log_from_env,
 )
 from network_distributed_pytorch_tpu.resilience.supervisor import (  # noqa: E402
@@ -79,6 +83,10 @@ FLAP_SLOWDOWN = 5.0
 EPOCH_LEN = 4
 # the toy compressed rung's ledger: rank-1 toy compression of the payload
 TOY_COMPRESSED_BYTES = TOY_PAYLOAD_BYTES // 8
+# --health-every: the synthetic grad norm baseline — near-constant, so the
+# live plane's EWMA spike detector has an almost-zero-variance envelope and
+# a chaos ``grad_spike`` (factor 1000 by default) is unambiguously critical
+TOY_GRAD_NORM = 1.0
 
 
 def _load_state(path):
@@ -126,6 +134,15 @@ def main() -> int:
              " (FLAP_LEN steps at FLAP_SLOWDOWN x step time) and drive a"
              " real FallbackController from measured pseudo-epoch health —"
              " the comm-layer PolicyEvent round-trip, jax-free",
+    )
+    p.add_argument(
+        "--health-every", type=int, default=0, metavar="N",
+        help="emit a synthetic TrainHealthEvent every N steps (0 = never);"
+             " a chaos grad_spike fault multiplies the reading by its"
+             " factor payload, and under a supervisor run dir the worker"
+             " also tails alerts.jsonl each step and feeds every alert to"
+             " a real FallbackController.nudge — the live plane's"
+             " detector -> supervisor -> worker round-trip, jax-free",
     )
     args = p.parse_args()
 
@@ -178,8 +195,14 @@ def main() -> int:
         )
 
     flap = args.comm_flap
+    run_dir = os.environ.get(ENV_RUN_DIR)
+    # the alert feed tails the supervisor's alerts.jsonl; only meaningful
+    # under a supervised run dir and with the health sampler on
+    alert_feed = (
+        AlertFeed(run_dir) if args.health_every > 0 and run_dir else None
+    )
     controller = None
-    if flap is not None:
+    if flap is not None or alert_feed is not None:
         from network_distributed_pytorch_tpu.resilience.controller import (
             EpochHealth,
             FallbackController,
@@ -277,6 +300,44 @@ def main() -> int:
                         bits_cumulative=8 * TOY_PAYLOAD_BYTES * (i + 1),
                     )
                 )
+            if (
+                args.health_every > 0
+                and telemetry is not None
+                and i % args.health_every == 0
+            ):
+                # synthetic health sample: a flat grad-norm baseline the
+                # spike detector can learn in 3 observations; the chaos
+                # grad_spike fault multiplies the reading at its step
+                grad_norm = TOY_GRAD_NORM
+                spec = plan.pop(HEALTH_FAULTS, i, args.rank, incarnation)
+                if spec is not None:
+                    grad_norm *= float(spec.payload.get("factor", 1000.0))
+                telemetry.emit(
+                    TrainHealthEvent(
+                        step=i, epoch=i // EPOCH_LEN, grad_norm=grad_norm,
+                        ef_memory_norm=0.0, powersgd_rel_error=0.0,
+                        loss=1.0 / (i + 1), rank=args.rank, label="toy",
+                    )
+                )
+            if alert_feed is not None and controller is not None:
+                # the return leg of the live plane: detector alerts the
+                # supervisor appended to alerts.jsonl nudge the controller
+                # mid-pseudo-epoch, exactly like adaptive_train_loop
+                for rec in alert_feed.poll():
+                    decision = controller.nudge(
+                        rec.get("alert", ""), pseudo_epoch,
+                        severity=rec.get("severity", "warn"),
+                    )
+                    if decision is not None:
+                        controller.record(
+                            decision,
+                            predicted_bytes_per_step=_rung_bytes(
+                                decision.rung_index_after
+                            ),
+                            realized_bytes_per_step=_rung_bytes(
+                                decision.rung_index_before
+                            ),
+                        )
             if controller is not None:
                 epoch_times.append(step_time)
                 if in_flap:
