@@ -1551,6 +1551,17 @@ def _record_gate_baseline(out: dict, status: dict) -> None:
             rec["alerts_fired"] = float(fired)
     except (OSError, ValueError):
         pass
+    # disaster-recovery MTTR from the newest game-day report (run_probe
+    # phase 5 — the plain probe report has no replans): rides along so
+    # gate.py's lower-is-better recovery_time_s metric has a recorded
+    # reference for the quorum-replan game day
+    try:
+        with open(os.path.join(HERE, "artifacts", "gameday_report.json")) as f:
+            mttr = json.load(f).get("recovery_time_s")
+        if isinstance(mttr, (int, float)) and mttr > 0:
+            rec["recovery_time_s"] = float(mttr)
+    except (OSError, ValueError):
+        pass
     path = os.path.join(HERE, "artifacts", "GATE_BASELINE.json")
     try:
         os.makedirs(os.path.join(HERE, "artifacts"), exist_ok=True)

@@ -840,7 +840,8 @@ def resilient_train_loop(
 
         reshard_note["old"] = saved_topo or {}
         return reshard_from_checkpoint(
-            path, init_state, saved_topology=saved_topo
+            path, init_state, saved_topology=saved_topo,
+            mesh_axes=(topology or {}).get("mesh_axes"),
         )
 
     resumed = restore_latest(
@@ -878,13 +879,19 @@ def resilient_train_loop(
             new_bits = new.get("bits_per_step")
             if new_bits is None:
                 new_bits = getattr(step, "bits_per_step", None)
+            mesh = ""
+            if old.get("mesh_axes") or new.get("mesh_axes"):
+                mesh = (
+                    f" (mesh {old.get('mesh_axes')} ->"
+                    f" {new.get('mesh_axes')})"
+                )
             telemetry.emit(
                 FailureEvent(
                     kind="resharded", label=run_name, rank=rank,
                     step=resumed_epoch, incarnation=incarnation,
                     message=f"world {old.get('world_size')} ->"
-                            f" {new.get('world_size')}: EF memories folded"
-                            f" by summation, per-worker stats merged,"
+                            f" {new.get('world_size')}{mesh}: EF memories"
+                            f" folded by summation, per-worker stats merged,"
                             f" partitions re-split from the fixed"
                             f" permutation",
                 )
@@ -933,11 +940,41 @@ def resilient_train_loop(
         out["epoch_cursor"] = cursor
         return out
 
+    def _commit_save(st, epoch: int, cursor: Optional[Dict] = None) -> None:
+        # small in-place retry budget for a transient write refusal, then
+        # the typed fail-fast: emit the detection event and exit with the
+        # sentinel code the supervisor converts into an immediate run
+        # failure (restarting into a read-only checkpoint root is a
+        # restart storm, not recovery)
+        import time as _time
+
+        from ..resilience.guards import CheckpointUnwritableError
+
+        last = None
+        for attempt in range(2):
+            try:
+                save_checkpoint(
+                    checkpoint_dir, st, step=epoch, keep_last=keep_last,
+                    topology=_topo(cursor),
+                )
+                return
+            except CheckpointUnwritableError as e:
+                last = e
+                _time.sleep(0.05 * (attempt + 1))
+        from ..resilience.chaos import CKPT_UNWRITABLE_EXIT_CODE
+
+        if telemetry is not None:
+            telemetry.emit(
+                FailureEvent(
+                    kind="checkpoint_unwritable", label=run_name, rank=rank,
+                    step=epoch, incarnation=incarnation,
+                    message=f"save retry budget exhausted: {last}",
+                )
+            )
+        raise SystemExit(CKPT_UNWRITABLE_EXIT_CODE) from last
+
     def _save(epoch: int, st) -> None:
-        save_checkpoint(
-            checkpoint_dir, st, step=epoch, keep_last=keep_last,
-            topology=_topo(),
-        )
+        _commit_save(st, epoch)
         if chaos_plan is not None:
             from ..resilience.chaos import apply_checkpoint_fault
 
@@ -950,10 +987,7 @@ def resilient_train_loop(
         if preemption_guard is None or not preemption_guard.requested:
             return False
         done = steps_done + (resume_skip if epoch == start_epoch else 0)
-        save_checkpoint(
-            checkpoint_dir, st, step=epoch, keep_last=keep_last,
-            topology=_topo(cursor={"epoch": epoch, "batches_done": done}),
-        )
+        _commit_save(st, epoch, cursor={"epoch": epoch, "batches_done": done})
         preemption_guard.checkpoint_saved = True
         if telemetry is not None:
             telemetry.emit(

@@ -297,7 +297,22 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--min-world-size", type=int, default=1,
-        help="supervise: smallest world a degraded restart may shrink to",
+        help="supervise: smallest world a degraded restart may shrink to"
+             " (the quorum planner's --min-world floor)",
+    )
+    p.add_argument(
+        "--mesh-shape", type=str, default=None,
+        help="supervise: the world's mesh shape as DATAxFSDPxTENSOR (e.g."
+             " 2x1x2; product must equal --num-processes). Degraded"
+             " restarts then go through the quorum planner — trade TP"
+             " degree for DP first — instead of only shrinking the data"
+             " axis; workers read the shape from RESILIENCE_MESH",
+    )
+    p.add_argument(
+        "--correlation-window", type=float, default=2.0,
+        help="supervise: hard deaths of >= 2 distinct ranks within this"
+             " many seconds are classified as one correlated incident"
+             " (zone outage) and replanned as a whole",
     )
     p.add_argument(
         "--no-degraded", action="store_true",
@@ -403,6 +418,8 @@ _SUPERVISOR_FLAGS = {
     "--heartbeat-timeout": True,
     "--term-grace": True,
     "--min-world-size": True,
+    "--mesh-shape": True,
+    "--correlation-window": True,
     "--no-degraded": False,
     "--worker-log-dir": True,
     "--metrics-port": True,
@@ -411,6 +428,25 @@ _SUPERVISOR_FLAGS = {
     "--process-id": True,
     "--num-processes": True,
 }
+
+
+def parse_mesh_shape(spec: str) -> dict:
+    """``DATAxFSDPxTENSOR`` (or the two-axis shorthand ``DATAxTENSOR``)
+    into a mesh-axes dict for :class:`SupervisorConfig`."""
+    try:
+        degrees = [int(p) for p in spec.lower().replace("×", "x").split("x")]
+    except ValueError:
+        degrees = []
+    if len(degrees) == 2:
+        data, fsdp, tensor = degrees[0], 1, degrees[1]
+    elif len(degrees) == 3:
+        data, fsdp, tensor = degrees
+    else:
+        raise ValueError(
+            f"--mesh-shape must look like DATAxFSDPxTENSOR (e.g. 2x1x2) or"
+            f" DATAxTENSOR (e.g. 2x2), got {spec!r}"
+        )
+    return {"data": data, "fsdp": fsdp, "tensor": tensor}
 
 
 def worker_argv_base(argv) -> list:
@@ -467,6 +503,11 @@ def _supervise(args, argv) -> dict:
                 seed=args.seed,
                 metrics_port=args.metrics_port,
                 alert_restart_after=args.alert_restart_after,
+                mesh_axes=(
+                    parse_mesh_shape(args.mesh_shape)
+                    if args.mesh_shape else None
+                ),
+                correlation_window_s=args.correlation_window,
             ),
             telemetry=telemetry,
             log_dir=args.worker_log_dir,
@@ -488,6 +529,7 @@ def _supervise(args, argv) -> dict:
         "total_restarts": result.total_restarts,
         "degraded": result.degraded,
         "reason": result.reason,
+        "final_mesh": result.final_mesh,
     }
     if args.run_dir:
         summary["run_dir"] = args.run_dir
@@ -505,6 +547,8 @@ def main(argv=None) -> dict:
         raise ValueError("--metrics-port requires --supervise and --run-dir")
     if args.alert_restart_after and not args.supervise:
         raise ValueError("--alert-restart-after requires --supervise")
+    if args.mesh_shape and not args.supervise:
+        raise ValueError("--mesh-shape requires --supervise")
     if args.supervise:
         return _supervise(args, argv if argv is not None else sys.argv[1:])
     if args.run_dir:

@@ -69,6 +69,7 @@ from .events import (  # noqa: F401
     PolicyEvent,
     RawEvent,
     RequestEvent,
+    ReshapeEvent,
     SpanEvent,
     StepEvent,
     StragglerEvent,

@@ -22,7 +22,7 @@ from __future__ import annotations
 import dataclasses
 import json
 from dataclasses import dataclass, field
-from typing import ClassVar, Dict, Optional, Tuple
+from typing import ClassVar, Dict, List, Optional, Tuple
 
 SCHEMA_VERSION = 1
 
@@ -191,6 +191,35 @@ class FailureEvent(Event):
     rank: Optional[int] = None
     step: Optional[int] = None
     incarnation: Optional[int] = None
+
+    def banner(self) -> str:
+        rec = {k: v for k, v in self.record().items() if v is not None}
+        return json.dumps(rec, default=str)
+
+
+@dataclass
+class ReshapeEvent(Event):
+    """The supervisor's quorum restart planner changed the world's mesh
+    shape: deaths inside the correlation window were classified
+    (``correlated`` — a zone outage — vs an independent single-rank death),
+    the largest viable mesh was computed from the survivors against the
+    min-world floor, and the run restarted at ``new_mesh``. One typed
+    event per replan, carrying both shapes, so the report's recovery
+    timeline (and its MTTR metric) can anchor detection → replan →
+    first-step-after without parsing free-text messages. ``kind`` mirrors
+    the FailureEvent field so the shared failure timeline can render it
+    in-line."""
+
+    KIND: ClassVar[str] = "reshape"
+
+    old_world: int
+    new_world: int
+    old_mesh: Optional[Dict[str, int]] = None
+    new_mesh: Optional[Dict[str, int]] = None
+    dead_ranks: Optional[List[int]] = None
+    correlated: bool = False
+    kind: str = "quorum_replan"
+    reason: str = ""
 
     def banner(self) -> str:
         rec = {k: v for k, v in self.record().items() if v is not None}

@@ -29,11 +29,18 @@ operates them:
   epoch verdicts and back up, with hysteresis, when the fabric recovers —
   every move a typed ``PolicyEvent``.
 - :mod:`resilience.reshard`    — what makes the degraded restart lossless:
-  deterministic state resharding from a topology-tagged checkpoint at
-  world W to any W' ≤ W (EF memories fold by summation preserving the
-  unsent-error sum bit-for-bit, per-worker stats merge, partitions
-  re-split from the fixed permutation, global batch preserved via
-  accumulation rescale).
+  deterministic state resharding from a topology-tagged checkpoint across
+  MESH shapes, not just world sizes (EF memories fold by summation — or
+  zero-pad on a widening data axis — preserving the unsent-error sum
+  bit-for-bit, TP-sharded params merge/re-split by pure byte movement,
+  per-worker stats merge, partitions re-split from the fixed permutation,
+  global batch preserved via accumulation rescale).
+
+Disaster-recovery extensions (PR 11): correlated chaos faults
+(``zone_outage``, ``host_flap``, ``ckpt_unwritable``), the supervisor's
+quorum restart planner (``plan_mesh`` — classify deaths in a window as
+correlated vs independent, restart the survivors at the largest viable
+mesh), and the typed ``CheckpointUnwritableError`` fail-fast path.
 
 The whole package is jax-free at import time (the supervisor parent
 process never initializes a backend; workers do — reshard/guards import
@@ -41,8 +48,11 @@ jax lazily inside the functions that touch pytrees).
 """
 
 from .chaos import (  # noqa: F401
+    CHAOS_EXIT_CODE,
     CHECKPOINT_FAULTS,
+    CKPT_UNWRITABLE_EXIT_CODE,
     COMM_FAULTS,
+    CORRELATED_FAULTS,
     FAULT_KINDS,
     INJECTION_SITES,
     LOADER_FAULTS,
@@ -57,6 +67,8 @@ from .chaos import (  # noqa: F401
     apply_checkpoint_fault,
     chaos_batches,
     check_fault_registry,
+    make_checkpoint_unwritable,
+    restore_checkpoint_writable,
 )
 from .controller import (  # noqa: F401
     DEFAULT_LADDER,
@@ -66,6 +78,7 @@ from .controller import (  # noqa: F401
     Rung,
 )
 from .guards import (  # noqa: F401
+    CheckpointUnwritableError,
     CollectiveWatchdog,
     CommDeadlineError,
     CommDeadlineGuard,
@@ -77,15 +90,25 @@ from .guards import (  # noqa: F401
     guarded_batches,
 )
 from .reshard import (  # noqa: F401
+    MESH_AXES,
     derive_rank_key,
     fold_groups,
     fold_memories,
     make_topology,
     memory_total,
     merge_model_state,
+    merge_tp_leaf,
+    mesh_world,
+    normalize_mesh_axes,
     rescale_accum_steps,
     reshard_from_checkpoint,
+    reshard_mesh_state,
+    reshard_tp_params,
     reshard_train_state,
+    split_tp_leaf,
+    topology_mesh,
+    widen_memories,
+    widen_model_state,
     widen_template,
 )
 from .supervisor import (  # noqa: F401
@@ -93,4 +116,6 @@ from .supervisor import (  # noqa: F401
     SupervisorConfig,
     SupervisorResult,
     incarnation_from_env,
+    mesh_from_env,
+    plan_mesh,
 )

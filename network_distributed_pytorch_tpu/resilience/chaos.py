@@ -63,13 +63,19 @@ import numpy as np
 
 LOADER_FAULTS = ("loader_bad_batch", "loader_short_batch")
 STEP_FAULTS = ("step_transient", "step_nan")
-CHECKPOINT_FAULTS = ("ckpt_torn", "ckpt_bitflip")
+CHECKPOINT_FAULTS = ("ckpt_torn", "ckpt_bitflip", "ckpt_unwritable")
 PROCESS_FAULTS = ("proc_exit", "proc_kill", "proc_hang", "proc_preempt")
+# correlated faults: the production failure modes single-rank chaos can't
+# express. ``zone_outage`` SIGKILLs every rank in ``payload["ranks"]`` in
+# the same tick (each process pops its own plan instance, so one spec with
+# rank=None fires on every zone member); ``host_flap`` re-kills the same
+# rank each life until ``payload["flaps"]`` restarts have burned.
+CORRELATED_FAULTS = ("zone_outage", "host_flap")
 COMM_FAULTS = ("comm_throttle", "comm_stall", "comm_flap")
 HEALTH_FAULTS = ("grad_spike",)
 FAULT_KINDS = (
     LOADER_FAULTS + STEP_FAULTS + CHECKPOINT_FAULTS + PROCESS_FAULTS
-    + COMM_FAULTS + HEALTH_FAULTS
+    + CORRELATED_FAULTS + COMM_FAULTS + HEALTH_FAULTS
 )
 
 # The registry the satellite asks for: every fault kind names the ONE
@@ -84,10 +90,13 @@ INJECTION_SITES: Dict[str, str] = {
     "step_nan": "step",                 # ChaosStep
     "ckpt_torn": "checkpoint",          # apply_checkpoint_fault
     "ckpt_bitflip": "checkpoint",       # apply_checkpoint_fault
+    "ckpt_unwritable": "checkpoint",    # apply_checkpoint_fault
     "proc_exit": "process",             # ChaosStep (process-level branch)
     "proc_kill": "process",             # ChaosStep (process-level branch)
     "proc_hang": "process",             # ChaosStep (process-level branch)
     "proc_preempt": "process",          # ChaosStep (process-level branch)
+    "zone_outage": "process",           # ChaosStep (process-level branch)
+    "host_flap": "process",             # ChaosStep (process-level branch)
     "comm_throttle": "comm-hook",       # CommFaultInjector fence hook
     "comm_stall": "comm-hook",          # CommFaultInjector fence hook
     "comm_flap": "comm-hook",           # CommFaultInjector fence hook
@@ -121,6 +130,11 @@ CHAOS_EXIT_CODE = 43
 # checkpoint (EX_TEMPFAIL: restartable). The supervisor classifies it — and
 # a bare SIGTERM death — as a GRACEFUL death; anything else is hard.
 PREEMPT_EXIT_CODE = 75
+# exit code of a worker whose checkpoint directory rejected writes past the
+# save retry budget (CheckpointUnwritableError). The supervisor treats it as
+# a HARD death and fails the run fast — restarting into the same unwritable
+# directory is a restart storm, not recovery.
+CKPT_UNWRITABLE_EXIT_CODE = 44
 
 
 class ChaosTransientError(RuntimeError):
@@ -134,7 +148,10 @@ class FaultSpec:
     it triggers (for checkpoint faults: the epoch of the save); ``rank``
     None matches any rank; ``incarnation`` None matches any restart
     generation (default 0: fire only in a worker's first life). ``payload``
-    carries kind-specific knobs (``hang_seconds``, ``exit_code``)."""
+    carries kind-specific knobs (``hang_seconds``, ``exit_code``;
+    ``ranks`` restricts a correlated fault to a zone — when present it
+    overrides ``rank``; ``flaps`` caps how many lives a ``host_flap``
+    kills)."""
 
     kind: str
     step: int
@@ -147,13 +164,41 @@ class FaultSpec:
             raise ValueError(
                 f"unknown fault kind {self.kind!r} (known: {FAULT_KINDS})"
             )
+        if isinstance(self.step, bool) or not isinstance(self.step, int):
+            raise ValueError(f"step must be an int, got {self.step!r}")
+        if self.rank is not None and (
+            isinstance(self.rank, bool) or not isinstance(self.rank, int)
+        ):
+            raise ValueError(f"rank must be an int or None, got {self.rank!r}")
+        if self.incarnation is not None and (
+            isinstance(self.incarnation, bool)
+            or not isinstance(self.incarnation, int)
+        ):
+            raise ValueError(
+                f"incarnation must be an int or None, got {self.incarnation!r}"
+            )
+        if not isinstance(self.payload, dict):
+            raise ValueError(f"payload must be a dict, got {self.payload!r}")
+        ranks = self.payload.get("ranks")
+        if ranks is not None:
+            if not isinstance(ranks, (list, tuple)) or not ranks or not all(
+                isinstance(r, int) and not isinstance(r, bool) for r in ranks
+            ):
+                raise ValueError(
+                    f"payload['ranks'] must be a non-empty list of ints,"
+                    f" got {ranks!r}"
+                )
 
     def matches(self, step: int, rank: int, incarnation: int) -> bool:
-        return (
-            self.step == step
-            and (self.rank is None or self.rank == rank)
-            and (self.incarnation is None or self.incarnation == incarnation)
-        )
+        if self.step != step:
+            return False
+        ranks = self.payload.get("ranks")
+        if ranks is not None:
+            if rank not in ranks:
+                return False
+        elif self.rank is not None and self.rank != rank:
+            return False
+        return self.incarnation is None or self.incarnation == incarnation
 
 
 class ChaosPlan:
@@ -173,10 +218,21 @@ class ChaosPlan:
 
     @classmethod
     def from_json(cls, obj: Dict) -> "ChaosPlan":
-        return cls(
-            faults=[FaultSpec(**f) for f in obj.get("faults", ())],
-            seed=obj.get("seed", 0),
-        )
+        """Build a plan from its JSON form, validating every entry at load
+        time: an unknown kind, a stray field, or a malformed value raises
+        ``ValueError`` naming the offending entry index — not a crash hours
+        later at injection time."""
+        faults = []
+        for i, f in enumerate(obj.get("faults", ())):
+            if not isinstance(f, dict):
+                raise ValueError(
+                    f"chaos plan fault[{i}] must be an object, got {f!r}"
+                )
+            try:
+                faults.append(FaultSpec(**f))
+            except (TypeError, ValueError) as e:
+                raise ValueError(f"chaos plan fault[{i}] invalid: {e}") from e
+        return cls(faults=faults, seed=obj.get("seed", 0))
 
     def save(self, path: str) -> str:
         with open(path, "w") as f:
@@ -254,7 +310,8 @@ class ChaosStep:
         i = self._step_index
         self._step_index += 1
         spec = self._plan.pop(
-            STEP_FAULTS + PROCESS_FAULTS, i, self._rank, self._incarnation
+            STEP_FAULTS + PROCESS_FAULTS + CORRELATED_FAULTS,
+            i, self._rank, self._incarnation,
         )
         if spec is not None:
             _emit_injected(
@@ -262,8 +319,16 @@ class ChaosStep:
             )
             if spec.kind == "proc_exit":
                 os._exit(int(spec.payload.get("exit_code", CHAOS_EXIT_CODE)))
-            if spec.kind == "proc_kill":
+            if spec.kind in ("proc_kill", "zone_outage"):
+                # zone_outage: one spec with payload["ranks"] fires on every
+                # zone member in the same tick (each process pops its own
+                # plan copy) — the correlated burst the quorum planner sees
                 os.kill(os.getpid(), signal.SIGKILL)
+            if spec.kind == "host_flap":
+                # re-kill the same rank each life until the flap budget is
+                # spent; a later incarnation finally survives the step
+                if self._incarnation < int(spec.payload.get("flaps", 2)):
+                    os.kill(os.getpid(), signal.SIGKILL)
             if spec.kind == "proc_hang":
                 # stops beating AND never returns within the deadline — the
                 # exact shape of a peer dead mid-collective
@@ -462,14 +527,20 @@ def apply_checkpoint_fault(
     checkpoint fault to it. ``ckpt_torn`` recreates the on-disk state of a
     crash mid-save (commit marker gone, payload truncated); ``ckpt_bitflip``
     flips one byte of the largest payload file while leaving the commit
-    marker intact — only the checksum manifest can catch it. Returns the
-    fault kind applied, if any."""
+    marker intact — only the checksum manifest can catch it;
+    ``ckpt_unwritable`` revokes write permission on the checkpoint root so
+    the NEXT commit fails mid-write — the restart-storm scenario the
+    fail-fast path exists for. Returns the fault kind applied, if any."""
     spec = plan.pop(CHECKPOINT_FAULTS, epoch, rank, incarnation)
     if spec is None:
         return None
-    path = os.path.join(os.path.abspath(checkpoint_root), f"step_{epoch}")
+    root = os.path.abspath(checkpoint_root)
+    path = os.path.join(root, f"step_{epoch}")
     if spec.kind == "ckpt_torn":
         tear_checkpoint(path)
+    elif spec.kind == "ckpt_unwritable":
+        make_checkpoint_unwritable(root)
+        path = root
     else:
         bitflip_checkpoint(path, seed=plan.seed)
     _emit_injected(telemetry, spec, epoch, rank, incarnation, detail=path)
@@ -517,3 +588,19 @@ def bitflip_checkpoint(path: str, seed: int = 0) -> None:
         byte = f.read(1)
         f.seek(offset)
         f.write(bytes([byte[0] ^ 0xFF]))
+
+
+def make_checkpoint_unwritable(root: str) -> None:
+    """Revoke write+search-create permission on the checkpoint root
+    (``r-x`` for the owner): existing checkpoints stay readable, but the
+    next commit's staging mkdir fails with ``EACCES`` — the exact shape of
+    a filer going read-only mid-run. Caveat: processes running as root
+    bypass permission bits, so tests exercising the fail-fast path under
+    root should break writability structurally (e.g. occupy the staging
+    path with a file) instead."""
+    os.chmod(root, 0o500)
+
+
+def restore_checkpoint_writable(root: str) -> None:
+    """Undo :func:`make_checkpoint_unwritable` (test cleanup)."""
+    os.chmod(root, 0o700)

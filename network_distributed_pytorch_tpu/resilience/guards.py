@@ -70,6 +70,16 @@ class CommEscalationError(Exception):
     propagate past them to the worker's top level."""
 
 
+class CheckpointUnwritableError(OSError):
+    """The checkpoint directory rejected writes past the save retry budget
+    (filer read-only, permissions revoked, path shadowed). Restarting the
+    worker cannot fix it — every restart would die at the same commit —
+    so the worker exits with ``CKPT_UNWRITABLE_EXIT_CODE`` and the
+    supervisor fails the run fast instead of burning its restart budget
+    into a storm. An ``OSError`` subclass (it IS an I/O failure) but NOT a
+    ``RuntimeError``, so no transient-retry wrapper can swallow it."""
+
+
 def derive_collective_deadline(
     payload_bytes: int,
     n_workers: int,

@@ -1,4 +1,4 @@
-"""Deterministic state resharding: resume a W-rank checkpoint at W' ≤ W.
+"""Deterministic state resharding: resume a checkpoint at a different mesh.
 
 PR 2's supervisor shrinks the world when a rank is permanently gone, but its
 restart was lossy by its own admission: per-rank sharded state — the PowerSGD
@@ -25,6 +25,14 @@ module makes a world change a *resharding* instead of a reset:
   so per-device microbatches do not balloon.
 - **Per-rank RNG keys re-derive** via ``fold_in(key, rank)`` then
   ``fold_in(·, incarnation)`` — no stored per-rank key material needed.
+- **Mesh shapes reshard, not just world sizes** (PR 11). The topology
+  record carries the full ``data × fsdp × tensor`` axis tuple plus the
+  shard axis of every TP-sharded param, so a 2×4 TP×DP checkpoint can boot
+  a 1×4: TP params merge/re-split by pure byte movement (exact), EF
+  memories fold or zero-pad along the data axis (bit-for-bit either way),
+  and fsdp — a layout axis over checkpoint-unsharded params — changes
+  degree for free. A widening data axis pads zero memory rows: x + 0.0 is
+  exact in fp32, so :func:`memory_total` is conserved in both directions.
 
 The topology that makes any of this decidable at restore time is recorded
 in the checkpoint itself (``utils.checkpoint`` writes a ``_TOPOLOGY.json``
@@ -39,11 +47,63 @@ jax is imported lazily inside the functions that touch pytrees.
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-TOPOLOGY_VERSION = 1
+TOPOLOGY_VERSION = 2
+
+#: Mesh axis order, outermost first. ``data`` is the replication axis the
+#: EF-memory fold runs over; ``fsdp`` is a pure parameter *layout* axis
+#: (checkpoints store params unsharded, so its degree can change freely);
+#: ``tensor`` shards the math itself and needs real split/merge movement.
+MESH_AXES: Tuple[str, ...] = ("data", "fsdp", "tensor")
+
+
+# -- mesh geometry ------------------------------------------------------------
+
+def normalize_mesh_axes(
+    axes: Optional[Dict[str, int]], world_size: Optional[int] = None
+) -> Dict[str, int]:
+    """Canonical ``{"data": D, "fsdp": F, "tensor": T}`` dict. ``None`` (the
+    pre-mesh default) means all-data: ``{world_size, 1, 1}``. Unknown axis
+    names, non-positive degrees, or a product that disagrees with
+    ``world_size`` all raise — a topology record that lies about its own
+    shape is worse than none at all."""
+    if axes is None:
+        if world_size is None:
+            raise ValueError("normalize_mesh_axes needs axes or a world size")
+        return {"data": int(world_size), "fsdp": 1, "tensor": 1}
+    unknown = set(axes) - set(MESH_AXES)
+    if unknown:
+        raise ValueError(
+            f"unknown mesh axes {sorted(unknown)} — expected a subset of {MESH_AXES}"
+        )
+    out = {name: int(axes.get(name, 1)) for name in MESH_AXES}
+    for name, degree in out.items():
+        if degree < 1:
+            raise ValueError(f"mesh axis {name!r} must be >= 1, got {degree}")
+    if world_size is not None and mesh_world(out) != int(world_size):
+        raise ValueError(
+            f"mesh axes {out} have world {mesh_world(out)}, expected {world_size}"
+        )
+    return out
+
+
+def mesh_world(axes: Dict[str, int]) -> int:
+    """Total rank count of a (possibly partial) mesh-axes dict."""
+    world = 1
+    for name in MESH_AXES:
+        world *= int(axes.get(name, 1))
+    return world
+
+
+def topology_mesh(topology: Dict[str, Any]) -> Dict[str, int]:
+    """The mesh a topology record describes. Records written before
+    TOPOLOGY_VERSION 2 carry no ``mesh_axes`` key and mean all-data."""
+    return normalize_mesh_axes(
+        topology.get("mesh_axes"), world_size=topology.get("world_size")
+    )
 
 
 # -- rank folding geometry ----------------------------------------------------
@@ -83,6 +143,53 @@ def fold_memories(memories: Any, new_world: int) -> Any:
         return np.concatenate([head[None], arr[old_world - new_world + 1:]], axis=0)
 
     return jax.tree_util.tree_map(_fold, memories)
+
+
+def widen_memories(memories: Any, new_world: int) -> Any:
+    """Widen the leading per-rank axis of every EF-memory leaf from W rows
+    to ``new_world >= W`` rows by appending zero rows. New ranks start with
+    no accumulated error, and because ``x + 0.0 == x`` exactly for every
+    finite fp32 ``x``, the sequential rank-order sum (:func:`memory_total`)
+    is unchanged bit-for-bit — widening is as lossless as the fold."""
+    import jax
+
+    def _widen(leaf):
+        arr = np.asarray(jax.device_get(leaf))
+        old_world = arr.shape[0]
+        if old_world == new_world:
+            return arr
+        if new_world < old_world:
+            raise ValueError(
+                f"widen_memories only widens ({old_world} -> {new_world});"
+                f" use fold_memories to shrink"
+            )
+        pad = np.zeros((new_world - old_world,) + arr.shape[1:], arr.dtype)
+        return np.concatenate([arr, pad], axis=0)
+
+    return jax.tree_util.tree_map(_widen, memories)
+
+
+def widen_model_state(model_state: Any, new_world: int) -> Any:
+    """Widen per-worker model state (BN running stats) to ``new_world``
+    rows: new ranks adopt rank 0's statistics. Approximate by construction
+    — like the merge, it self-heals with momentum within a few steps."""
+    import jax
+
+    def _widen(leaf):
+        arr = np.asarray(jax.device_get(leaf))
+        old_world = arr.shape[0]
+        if old_world == new_world:
+            return arr
+        if new_world < old_world:
+            raise ValueError(
+                f"widen_model_state only widens ({old_world} -> {new_world})"
+            )
+        pad = np.repeat(arr[:1], new_world - old_world, axis=0)
+        return np.concatenate([arr, pad], axis=0)
+
+    if model_state is None:
+        return None
+    return jax.tree_util.tree_map(_widen, model_state)
 
 
 def memory_total(memories: Any) -> Any:
@@ -172,6 +279,80 @@ def rescale_accum_steps(
     return old_accum
 
 
+# -- tensor-parallel parameter movement ---------------------------------------
+#
+# TP-sharded leaves are stored in checkpoints as a stack with a leading
+# shard axis: shape ``(T,) + shard_shape`` where ``shard_shape[axis]`` is
+# ``full_dim / T`` for the leaf's recorded shard axis. ``tp_param_axes`` in
+# the topology record maps a "/"-joined leaf path to that axis (an index
+# into the UNSTACKED shard shape). Merge-then-split via np.concatenate /
+# np.split moves bytes without arithmetic, so a TP reshape is exact.
+
+def _path_str(path: Sequence[Any]) -> str:
+    """"/"-joined pytree key path matching ``tp_param_axes`` keys."""
+    parts = []
+    for entry in path:
+        for attr in ("key", "idx", "name"):
+            if hasattr(entry, attr):
+                parts.append(str(getattr(entry, attr)))
+                break
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+def merge_tp_leaf(stacked: Any, axis: int) -> np.ndarray:
+    """Concatenate a ``(T,) + shard_shape`` stack back into the full array
+    along the shard axis. Pure byte movement — exact."""
+    import jax
+
+    arr = np.asarray(jax.device_get(stacked))
+    if arr.ndim < 2:
+        raise ValueError(
+            f"TP leaf must have a leading shard axis, got shape {arr.shape}"
+        )
+    return np.concatenate([arr[i] for i in range(arr.shape[0])], axis=axis)
+
+
+def split_tp_leaf(full: Any, tp: int, axis: int) -> np.ndarray:
+    """Split a full array into a ``(tp,) + shard_shape`` stack along the
+    shard axis. The sharded dimension must divide evenly — a mesh whose TP
+    degree does not divide the parameter is not a viable restart shape."""
+    import jax
+
+    arr = np.asarray(jax.device_get(full))
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    if arr.shape[axis] % tp:
+        raise ValueError(
+            f"dim {arr.shape[axis]} on axis {axis} does not divide over"
+            f" tp={tp}"
+        )
+    return np.stack(np.split(arr, tp, axis=axis), axis=0)
+
+
+def reshard_tp_params(
+    params: Any, old_tp: int, new_tp: int, tp_param_axes: Dict[str, int]
+) -> Any:
+    """Re-split every ``tp_param_axes``-listed leaf from ``old_tp`` shards
+    to ``new_tp`` shards (merge to full, split back). Leaves not listed are
+    replicated and pass through untouched. A no-op when the degrees match."""
+    import jax
+
+    if old_tp == new_tp or not tp_param_axes:
+        return params
+
+    def _move(path, leaf):
+        key = _path_str(path)
+        if key not in tp_param_axes:
+            return leaf
+        axis = int(tp_param_axes[key])
+        full = merge_tp_leaf(leaf, axis)
+        return split_tp_leaf(full, new_tp, axis)
+
+    return jax.tree_util.tree_map_with_path(_move, params)
+
+
 # -- per-rank RNG lineage -----------------------------------------------------
 
 def derive_rank_key(key: Any, rank: int, incarnation: int = 0):
@@ -198,16 +379,29 @@ def make_topology(
     rng_seed: Optional[int] = None,
     incarnation: int = 0,
     epoch_cursor: Optional[Dict[str, int]] = None,
+    mesh_axes: Optional[Dict[str, int]] = None,
+    tp_param_axes: Optional[Dict[str, int]] = None,
 ) -> Dict[str, Any]:
     """The topology record a checkpoint is tagged with (written as the
     ``_TOPOLOGY.json`` protocol file by ``utils.checkpoint``): everything a
     restore at a different world size needs to decide whether and how to
     reshard. ``epoch_cursor`` (``{"epoch": e, "batches_done": n}``) is set
     by a preemption-grace mid-epoch save; ``None`` means the checkpoint sits
-    on an epoch boundary."""
+    on an epoch boundary. ``mesh_axes`` records the full
+    ``data × fsdp × tensor`` shape (``None`` = all-data, the pre-mesh
+    meaning); ``tp_param_axes`` maps "/"-joined param paths to the shard
+    axis of each TP-sharded leaf so a restore at a different TP degree
+    knows how to re-split."""
+    axes = normalize_mesh_axes(mesh_axes, world_size=world_size)
     return {
         "version": TOPOLOGY_VERSION,
         "world_size": int(world_size),
+        "mesh_axes": axes,
+        "tp_param_axes": (
+            {str(k): int(v) for k, v in tp_param_axes.items()}
+            if tp_param_axes
+            else {}
+        ),
         "global_batch": None if global_batch is None else int(global_batch),
         "accum_steps": int(accum_steps),
         "data_seed": None if data_seed is None else int(data_seed),
@@ -243,16 +437,25 @@ def reshard_train_state(
     new_world: int,
     samples_per_rank: Optional[Sequence[int]] = None,
 ) -> Any:
-    """Fold a restored W-rank ``TrainState`` down to ``new_world`` ranks:
-    memories fold by summation, per-worker model state merges by weighted
-    average, replicated leaves (params, momenta, reducer warm-start) pass
-    through untouched."""
+    """Move a restored W-rank ``TrainState`` to ``new_world`` ranks along
+    the data axis. Shrinking: memories fold by summation, per-worker model
+    state merges by weighted average. Widening: memories pad zero rows
+    (bit-exact, see :func:`widen_memories`), model state replicates rank 0.
+    Replicated leaves (params, momenta, reducer warm-start) pass through
+    untouched."""
     if not hasattr(state, "_fields") or not hasattr(state, "memories"):
         raise TypeError(
             f"reshard_train_state expects a TrainState, got {type(state).__name__}"
         )
     import jax
 
+    old_world = _template_world(state)
+    if new_world >= old_world:
+        memories = widen_memories(state.memories, new_world)
+        model_state = state.model_state
+        if model_state is not None and jax.tree_util.tree_leaves(model_state):
+            model_state = widen_model_state(model_state, new_world)
+        return state._replace(memories=memories, model_state=model_state)
     folded = fold_memories(state.memories, new_world)
     model_state = state.model_state
     if model_state is not None and jax.tree_util.tree_leaves(model_state):
@@ -262,24 +465,77 @@ def reshard_train_state(
     return state._replace(memories=folded, model_state=model_state)
 
 
-def widen_template(template: Any, old_world: int) -> Any:
-    """A restore template for the ORIGINAL world: every per-rank leaf of
-    ``template`` (built for the new, smaller world) gets its leading axis
-    re-widened to ``old_world`` so orbax can read the W-rank checkpoint
-    into it before the fold."""
+def reshard_mesh_state(
+    state: Any,
+    old_axes: Dict[str, int],
+    new_axes: Dict[str, int],
+    tp_param_axes: Optional[Dict[str, int]] = None,
+    samples_per_rank: Optional[Sequence[int]] = None,
+) -> Any:
+    """Move a restored ``TrainState`` from one mesh shape to another:
+    TP-sharded params re-split/merge along their recorded shard axes
+    (exact byte movement), EF memories and per-worker model state fold or
+    widen along the data axis, and fsdp — a pure layout axis over
+    checkpoint-unsharded params — changes degree with no data movement."""
+    old_axes = normalize_mesh_axes(old_axes)
+    new_axes = normalize_mesh_axes(new_axes)
+    params = reshard_tp_params(
+        state.params, old_axes["tensor"], new_axes["tensor"], tp_param_axes or {}
+    )
+    state = state._replace(params=params)
+    return reshard_train_state(
+        state, new_axes["data"], samples_per_rank=samples_per_rank
+    )
+
+
+def widen_template(
+    template: Any,
+    old_world: int,
+    tp_param_axes: Optional[Dict[str, int]] = None,
+    old_tp: Optional[int] = None,
+) -> Any:
+    """A restore template matching the CHECKPOINT's recorded shape: every
+    per-data-rank leaf of ``template`` (built for the new mesh) gets its
+    leading axis set to ``old_world`` (the recorded data degree), and each
+    ``tp_param_axes``-listed param leaf is reshaped to the recorded TP
+    degree's ``(old_tp,) + shard_shape`` stack, so orbax can read the
+    checkpoint into it before the mesh move. Works for widening AND
+    shrinking the leading axis — it just states the on-disk shape."""
     import jax
 
-    def _widen(leaf):
+    def _rerank(leaf):
         arr = np.asarray(jax.device_get(leaf))
         return np.zeros((old_world,) + arr.shape[1:], arr.dtype)
 
-    memories = jax.tree_util.tree_map(_widen, template.memories)
+    memories = jax.tree_util.tree_map(_rerank, template.memories)
     model_state = template.model_state
     if model_state is not None and jax.tree_util.tree_leaves(model_state):
-        model_state = jax.tree_util.tree_map(_widen, model_state)
-    return jax.device_get(template)._replace(
+        model_state = jax.tree_util.tree_map(_rerank, model_state)
+    wide = jax.device_get(template)._replace(
         memories=memories, model_state=model_state
     )
+    if tp_param_axes and old_tp is not None:
+
+        def _retp(path, leaf):
+            key = _path_str(path)
+            if key not in tp_param_axes:
+                return np.asarray(jax.device_get(leaf))
+            axis = int(tp_param_axes[key])
+            arr = np.asarray(jax.device_get(leaf))
+            shard = list(arr.shape[1:])
+            full_dim = shard[axis] * arr.shape[0]
+            if full_dim % old_tp:
+                raise ValueError(
+                    f"param {key!r} dim {full_dim} does not divide over"
+                    f" checkpoint tp={old_tp}"
+                )
+            shard[axis] = full_dim // old_tp
+            return np.zeros((old_tp,) + tuple(shard), arr.dtype)
+
+        wide = wide._replace(
+            params=jax.tree_util.tree_map_with_path(_retp, wide.params)
+        )
+    return wide
 
 
 def reshard_from_checkpoint(
@@ -287,11 +543,14 @@ def reshard_from_checkpoint(
     template: Any,
     saved_topology: Optional[Dict] = None,
     samples_per_rank: Optional[Sequence[int]] = None,
+    mesh_axes: Optional[Dict[str, int]] = None,
 ) -> Any:
     """The resharder ``restore_latest`` routes through on a topology
-    mismatch: restore the checkpoint at ``path`` into a template widened to
-    its RECORDED world size, then fold it down to the world ``template`` was
-    built for. Returns host arrays, like :func:`utils.checkpoint.restore_checkpoint`."""
+    mismatch: restore the checkpoint at ``path`` into a template shaped for
+    its RECORDED mesh, then move it to the mesh ``template`` was built for.
+    ``mesh_axes`` names the new mesh; ``None`` means all-data at the
+    template's world (the pre-mesh behavior, preserved bit-for-bit).
+    Returns host arrays, like :func:`utils.checkpoint.restore_checkpoint`."""
     from ..utils.checkpoint import read_topology, restore_checkpoint
 
     topo = saved_topology if saved_topology is not None else read_topology(path)
@@ -300,10 +559,30 @@ def reshard_from_checkpoint(
             f"checkpoint {path} carries no topology record — cannot reshard"
             f" (only topology-tagged checkpoints are world-size-elastic)"
         )
-    old_world = int(topo["world_size"])
-    new_world = _template_world(template)
-    wide = widen_template(template, old_world)
+    old_axes = topology_mesh(topo)
+    tp_param_axes = {
+        str(k): int(v) for k, v in (topo.get("tp_param_axes") or {}).items()
+    }
+    new_data = _template_world(template)
+    new_axes = normalize_mesh_axes(
+        mesh_axes if mesh_axes is not None else {"data": new_data}
+    )
+    if new_axes["data"] != new_data:
+        raise ValueError(
+            f"template has {new_data} per-rank rows but the requested mesh"
+            f" has data degree {new_axes['data']}"
+        )
+    wide = widen_template(
+        template,
+        old_axes["data"],
+        tp_param_axes=tp_param_axes,
+        old_tp=old_axes["tensor"],
+    )
     state = restore_checkpoint(path, wide)
-    return reshard_train_state(
-        state, new_world, samples_per_rank=samples_per_rank
+    return reshard_mesh_state(
+        state,
+        old_axes,
+        new_axes,
+        tp_param_axes=tp_param_axes,
+        samples_per_rank=samples_per_rank,
     )

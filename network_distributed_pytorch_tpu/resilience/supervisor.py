@@ -23,6 +23,17 @@ invariant error feedback depends on is preserved bit-for-bit — and
 per-worker stats merge), while replicated state (params, momenta) resumes
 directly.
 
+Mesh-shaped worlds (PR 11) go further: deaths are CLASSIFIED before they
+are handled. Hard deaths of multiple distinct ranks inside the
+correlation window are one correlated incident (a zone outage, not N
+coincidences), and the quorum restart planner (:func:`plan_mesh`) computes
+the largest viable mesh from the survivors against the ``min_world`` floor
+— trading TP degree for DP first — then restarts the whole world at the
+new shape with a typed ``ReshapeEvent``. A worker exiting with
+``CKPT_UNWRITABLE_EXIT_CODE`` (checkpoint dir rejected writes past the
+save retry budget) fails the run immediately: no restart can recover a
+read-only checkpoint root, and retrying into it is a restart storm.
+
 Shutdowns are graceful-first: every supervisor-initiated kill is SIGTERM,
 a ``term_grace_s`` window for the worker's ``PreemptionGuard`` to commit
 an emergency checkpoint, then SIGKILL only if the worker overstays. Worker
@@ -50,6 +61,9 @@ from typing import Any, Callable, Dict, List, Optional
 ENV_INCARNATION = "RESILIENCE_INCARNATION"
 ENV_RANK = "RESILIENCE_RANK"
 ENV_WORLD = "RESILIENCE_WORLD"
+# JSON mesh-axes dict ({"data": D, "fsdp": F, "tensor": T}), exported only
+# for mesh-shaped runs — a replanned worker reads its NEW shape from here
+ENV_MESH = "RESILIENCE_MESH"
 
 
 def incarnation_from_env(default: int = 0) -> int:
@@ -59,6 +73,63 @@ def incarnation_from_env(default: int = 0) -> int:
         return int(os.environ.get(ENV_INCARNATION, default))
     except ValueError:
         return default
+
+
+def mesh_from_env() -> Optional[Dict[str, int]]:
+    """The mesh shape this worker was launched at, or None for a pure-DP
+    world (workers then derive everything from ``--num-processes``)."""
+    raw = os.environ.get(ENV_MESH)
+    if not raw:
+        return None
+    try:
+        axes = json.loads(raw)
+    except ValueError:
+        return None
+    if not isinstance(axes, dict):
+        return None
+    return {str(k): int(v) for k, v in axes.items()}
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def plan_mesh(
+    mesh_axes: Dict[str, int], survivors: int, min_world: int = 1
+) -> Optional[Dict[str, int]]:
+    """The quorum restart planner's policy table: the largest viable mesh
+    that fits on ``survivors`` ranks, or None when no shape clears the
+    ``min_world`` floor.
+
+    Candidate shapes keep each model axis (tensor, fsdp) at a DIVISOR of
+    its old degree — sharded params re-split evenly, no axis is ever
+    fractionally covered — while the data axis is free (the reshard layer
+    folds or zero-pads EF memories either direction, bit-for-bit). Among
+    candidates the planner maximizes total world first, then trades TP
+    degree for DP (smallest tensor wins the tie, then smallest fsdp): data
+    parallelism degrades throughput linearly, while a starved model axis
+    changes the math's partitioning and recompiles more of the program."""
+    from .reshard import normalize_mesh_axes
+
+    axes = normalize_mesh_axes(mesh_axes)
+    if survivors < 1:
+        return None
+    best = None
+    best_key = None
+    for tensor in _divisors(axes["tensor"]):
+        for fsdp in _divisors(axes["fsdp"]):
+            model = tensor * fsdp
+            if model > survivors:
+                continue
+            data = survivors // model
+            world = model * data
+            key = (world, -tensor, -fsdp)
+            if best_key is None or key > best_key:
+                best_key = key
+                best = {"data": data, "fsdp": fsdp, "tensor": tensor}
+    if best is None or best_key[0] < max(1, min_world):
+        return None
+    return best
 
 
 @dataclass
@@ -88,6 +159,17 @@ class SupervisorConfig:
     # ride the normal kill -> poll -> backoff machinery and spend the
     # rank's ordinary restart budget.
     alert_restart_after: int = 0
+    # the mesh shape the world was launched at ({"data": D, "fsdp": F,
+    # "tensor": T}; None = pure DP). With a mesh, degraded restarts go
+    # through the quorum planner (:func:`plan_mesh`) instead of only
+    # shrinking the data axis, and workers get the shape via ENV_MESH.
+    mesh_axes: Optional[Dict[str, int]] = None
+    # hard deaths of >= correlated_threshold DISTINCT ranks within this
+    # window are classified as one correlated incident (zone outage): the
+    # planner replans the whole world at once instead of burning each
+    # rank's restart budget independently.
+    correlation_window_s: float = 2.0
+    correlated_threshold: int = 2
 
 
 @dataclass
@@ -98,6 +180,7 @@ class SupervisorResult:
     degraded: bool
     exit_codes: Dict[int, int] = field(default_factory=dict)
     reason: str = ""
+    final_mesh: Optional[Dict[str, int]] = None  # None for pure-DP runs
 
 
 @dataclass
@@ -139,6 +222,17 @@ class Supervisor:
         self.degraded = False
         self._incarnations: Dict[int, int] = {}  # next incarnation per rank
         self._rng = random.Random(self.config.seed)
+        # current mesh shape (validated against the world) — None = pure DP
+        self.mesh: Optional[Dict[str, int]] = None
+        if self.config.mesh_axes is not None:
+            from .reshard import normalize_mesh_axes
+
+            self.mesh = normalize_mesh_axes(
+                self.config.mesh_axes, world_size=world_size
+            )
+        # (monotonic time, rank) of recent HARD deaths — the correlated-vs-
+        # independent classifier's evidence window
+        self._death_log: List[tuple] = []
         # run-level observability (observe.runlog): with a run_dir the
         # supervisor maintains the run manifest — identity, shard layout,
         # and a parent-clock spawn record per (rank, incarnation), the
@@ -186,6 +280,8 @@ class Supervisor:
         env[ENV_INCARNATION] = str(incarnation)
         env[ENV_RANK] = str(rank)
         env[ENV_WORLD] = str(world_size)
+        if self.mesh is not None:
+            env[ENV_MESH] = json.dumps(self.mesh)
         if self._manifest is not None:
             from ..observe import runlog
 
@@ -348,6 +444,8 @@ class Supervisor:
             self._close_live_plane()
 
     def _run_loop(self) -> SupervisorResult:
+        from .chaos import CKPT_UNWRITABLE_EXIT_CODE
+
         cfg = self.config
         world = self.world_size
         started = time.monotonic()
@@ -362,25 +460,60 @@ class Supervisor:
             return SupervisorResult(
                 success=False, world_size=world,
                 total_restarts=self.total_restarts, degraded=self.degraded,
-                exit_codes=exit_codes, reason=reason,
+                exit_codes=exit_codes, reason=reason, final_mesh=self.mesh,
             )
 
-        def degrade(dead_rank: int) -> bool:
-            new_world = world - 1
-            if not cfg.allow_degraded or new_world < cfg.min_world_size:
-                return False
-            self._emit(
-                "degraded_restart", rank=dead_rank,
-                message=f"world {world} -> {new_world}",
+        def replan(dead_ranks: List[int], correlated: bool) -> Optional[int]:
+            """Quorum restart: compute the largest viable mesh from the
+            survivors, announce it (typed ReshapeEvent + the legacy
+            degraded_restart line the timeline renders), and shut the old
+            world down. Returns the new world size, or None when no shape
+            clears the min-world floor (the caller then fails the run)."""
+            dead = sorted(set(dead_ranks))
+            if not cfg.allow_degraded:
+                return None
+            old_mesh = self.mesh or {"data": world, "fsdp": 1, "tensor": 1}
+            new_mesh = plan_mesh(
+                old_mesh, world - len(dead), cfg.min_world_size
             )
+            if new_mesh is None:
+                return None
+            new_world = (
+                new_mesh["data"] * new_mesh["fsdp"] * new_mesh["tensor"]
+            )
+            label = "correlated" if correlated else "independent"
+            self._emit(
+                "degraded_restart", rank=dead[0],
+                message=(
+                    f"world {world} -> {new_world}"
+                    f" ({label} death of ranks {dead})"
+                ),
+            )
+            if self.telemetry is not None:
+                from ..observe import ReshapeEvent
+
+                self.telemetry.emit(
+                    ReshapeEvent(
+                        old_world=world, new_world=new_world,
+                        old_mesh=old_mesh, new_mesh=new_mesh,
+                        dead_ranks=dead, correlated=correlated,
+                        reason=(
+                            f"{label} death of {len(dead)} rank(s);"
+                            f" replanned against min_world="
+                            f"{cfg.min_world_size}"
+                        ),
+                    )
+                )
             for w in workers.values():
                 if not w.done:
                     how = self._kill(w)
                     self._emit(
                         "worker_term", rank=w.rank, incarnation=w.incarnation,
-                        message=f"{how} shutdown for world shrink",
+                        message=f"{how} shutdown for world reshape",
                     )
-            return True
+            if self.mesh is not None:
+                self.mesh = new_mesh
+            return new_world
 
         while True:
             if (
@@ -423,22 +556,57 @@ class Supervisor:
                     "worker_exit", rank=rank, incarnation=w.incarnation,
                     message=f"exit code {rc} ({self._death(rc)} death)",
                 )
+                if rc == CKPT_UNWRITABLE_EXIT_CODE:
+                    # typed fail-fast: restarting into the same read-only
+                    # checkpoint root is a restart storm, not recovery
+                    return fail(
+                        f"rank {rank} reports checkpoint dir unwritable"
+                        f" (exit {rc}); failing fast instead of a restart"
+                        f" storm"
+                    )
+                if self._death(rc) == "hard":
+                    self._death_log.append((time.monotonic(), rank))
                 if w.restarts >= cfg.max_restarts:
                     dead_rank = rank
                     break
                 restart_queue.append(rank)
 
+            # correlated-vs-independent classification: hard deaths of >= K
+            # DISTINCT ranks inside the window are one incident (a zone
+            # outage), replanned as a whole instead of restarted one by one
+            now = time.monotonic()
+            self._death_log = [
+                (t, r) for t, r in self._death_log
+                if now - t <= cfg.correlation_window_s
+            ]
+            burst = sorted({r for _, r in self._death_log})
+            if len(burst) >= max(2, cfg.correlated_threshold):
+                new_world = replan(burst, correlated=True)
+                if new_world is None:
+                    return fail(
+                        f"correlated death of ranks {burst}: no viable mesh"
+                        f" above min_world={cfg.min_world_size}"
+                    )
+                self.degraded = True
+                world = new_world
+                exit_codes = {}
+                self._death_log.clear()
+                workers = {r: self._spawn(r, world) for r in range(world)}
+                continue
+
             if dead_rank is not None:
-                if not degrade(dead_rank):
+                new_world = replan([dead_rank], correlated=False)
+                if new_world is None:
                     return fail(
                         f"rank {dead_rank} exceeded max_restarts="
                         f"{cfg.max_restarts}"
                     )
-                # shrunk world: renumber 0..W'-1, fresh restart budgets —
+                # reshaped world: renumber 0..W'-1, fresh restart budgets —
                 # workers recompute mesh/partition/ledger from the new size
                 self.degraded = True
-                world -= 1
+                world = new_world
                 exit_codes = {}
+                self._death_log.clear()
                 workers = {r: self._spawn(r, world) for r in range(world)}
                 continue
 
@@ -463,5 +631,6 @@ class Supervisor:
                     success=True, world_size=world,
                     total_restarts=self.total_restarts,
                     degraded=self.degraded, exit_codes=exit_codes,
+                    final_mesh=self.mesh,
                 )
             time.sleep(cfg.poll_interval_s)

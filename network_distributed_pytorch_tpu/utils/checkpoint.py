@@ -29,6 +29,7 @@ bit-flipped directory.
 
 from __future__ import annotations
 
+import errno
 import hashlib
 import json
 import os
@@ -154,15 +155,32 @@ def _template_world(template: Any) -> Optional[int]:
 
 
 def check_topology(path: str, template: Any) -> Optional[Dict[str, Any]]:
-    """Compare a checkpoint's recorded world size against the template's.
+    """Compare a checkpoint's recorded topology against the template's.
     Returns the topology record (None for untagged checkpoints); raises
-    :class:`TopologyMismatchError` on a cross-topology restore attempt."""
+    :class:`TopologyMismatchError` on a cross-topology restore attempt.
+
+    The template's per-rank row count is compared against the recorded
+    DATA-axis degree when the record carries ``mesh_axes`` (a 2×2 DP×TP
+    checkpoint has world 4 but only 2 memory rows — per-worker leaves are
+    per-DATA-rank, not per-device), and against the recorded world size
+    for pre-mesh records, where the two were the same number."""
     topo = read_topology(path)
     if topo is None:
         return None
     saved = topo.get("world_size")
     have = _template_world(template)
-    if saved is not None and have is not None and int(saved) != have:
+    axes = topo.get("mesh_axes")
+    data = axes.get("data") if isinstance(axes, dict) else None
+    if data is not None:
+        if have is not None and int(data) != have:
+            raise TopologyMismatchError(
+                f"topology mismatch: checkpoint {os.path.basename(path)} was"
+                f" written at world size {saved} on mesh {axes} (data degree"
+                f" {data}), template carries {have} per-rank rows — refusing"
+                f" the silent cross-mesh restore; reshard via"
+                f" resilience.reshard.reshard_from_checkpoint"
+            )
+    elif saved is not None and have is not None and int(saved) != have:
         raise TopologyMismatchError(
             f"topology mismatch: checkpoint {os.path.basename(path)} was"
             f" written at world size {saved}, template expects {have} —"
@@ -205,23 +223,44 @@ def save_checkpoint(
     the data write but BEFORE the manifest/marker/rename, leaving exactly
     the torn tmp directory a mid-save crash would — the chaos suite uses it
     to prove readers never resume from one.
+
+    A write refused by the directory itself (permissions revoked, filer
+    read-only, staging path shadowed by a stray file) raises the typed
+    :class:`resilience.guards.CheckpointUnwritableError` so callers can
+    fail fast instead of retrying into a restart storm.
     """
+    # lazy import: resilience.guards is jax-free, but importing it at module
+    # scope would couple utils <-> resilience import order
+    from ..resilience.guards import CheckpointUnwritableError
+
     root = os.path.abspath(path)
     final = os.path.join(root, f"step_{step}") if step is not None else root
     parent, name = os.path.dirname(final), os.path.basename(final)
     tmp = os.path.join(parent, f"{_TMP_PREFIX}{name}.{os.getpid()}")
-    if os.path.isdir(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(parent, exist_ok=True)
-    # ambient span: the epoch-boundary save is a classic hidden time sink
-    # (blocking device_get + disk), attributed here with zero plumbing
-    with span("checkpoint/save", step=step):
-        with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(tmp, jax.device_get(state))
-            # context exit waits for the async write — data is on disk here
-        if _abort_before_commit:
-            return tmp
-        _commit(tmp, final, step, topology=topology)
+    try:
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(parent, exist_ok=True)
+        # ambient span: the epoch-boundary save is a classic hidden time
+        # sink (blocking device_get + disk), attributed with zero plumbing
+        with span("checkpoint/save", step=step):
+            with ocp.StandardCheckpointer() as ckptr:
+                ckptr.save(tmp, jax.device_get(state))
+                # context exit waits for the async write — data is on disk
+            if _abort_before_commit:
+                return tmp
+            _commit(tmp, final, step, topology=topology)
+    except OSError as e:
+        if isinstance(e, CheckpointUnwritableError):
+            raise
+        if isinstance(e, PermissionError) or e.errno in (
+            errno.EACCES, errno.EPERM, errno.EROFS, errno.ENOTDIR,
+            errno.EISDIR, errno.EEXIST,
+        ):
+            raise CheckpointUnwritableError(
+                f"checkpoint root {root} unwritable at step {step}: {e}"
+            ) from e
+        raise
     if keep_last is not None and step is not None:
         gc_checkpoints(root, keep_last)
     return final

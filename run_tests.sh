@@ -52,9 +52,12 @@ set -e
 # roofline) and artifacts/toy_trace.json (Perfetto timeline, checked
 # well-formed with spans from every rank), then run the gate advisory
 # against the recorded baseline (bench.py's artifacts/GATE_BASELINE.json
-# or the newest BENCH_r*.json) — all inside run_probe. Advisory because
-# shared CI boxes have noisy step times; run gate.py without --advisory
-# on dedicated perf hardware to make it blocking.
+# or the newest BENCH_r*.json) — all inside run_probe. The probe's fifth
+# phase is the disaster game day: a correlated zone outage mid-epoch that
+# the supervisor must survive by replanning the mesh, with the measured
+# MTTR gated as recovery_time_s. Advisory because shared CI boxes have
+# noisy step times; run gate.py without --advisory on dedicated perf
+# hardware to make it blocking.
 python scripts/run_probe.py || true
 
 exit $rc

@@ -66,6 +66,10 @@ METRICS: Dict[str, str] = {
     # step (scripts/report.py recovery_latency_s) — slower healing is a
     # resilience regression
     "recovery_latency_s": "lower",
+    # disaster-recovery MTTR (report ``recovery_time_s``): mean wall
+    # seconds from a hard correlated death to the first step on the
+    # replanned mesh — a slower game-day recovery is a regression
+    "recovery_time_s": "lower",
     # serving tail latency (report ``slo.p99_decode_ms_per_token``, from
     # the serving/ engine's per-request events) — a slower p99 decode
     # tick is an SLO regression even when training metrics hold
@@ -89,6 +93,7 @@ def extract_metrics(doc: Dict) -> Dict[str, float]:
     out: Dict[str, float] = {}
     for name in (
         "step_p50_s", "flagship_imgs_per_sec", "value", "recovery_latency_s",
+        "recovery_time_s",
     ):
         v = doc.get(name)
         if isinstance(v, (int, float)) and v == v and v > 0:

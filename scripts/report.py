@@ -107,13 +107,13 @@ def _fmt_bytes(n: float) -> str:
 _DETECTION_KINDS = {
     "worker_exit", "worker_hang", "watchdog_timeout", "bad_batch_dropped",
     "audit_error", "stale_peer", "preempt_notice",
-    "comm_deadline", "comm_degraded",
+    "comm_deadline", "comm_degraded", "checkpoint_unwritable",
 }
 _RECOVERY_KINDS = {
     "retry", "checkpoint_fallback", "worker_restart", "resumed",
     "resharded", "preempt_checkpoint", "degraded_restart",
     "worker_complete", "run_complete",
-    "comm_fault_cleared", "comm_step_retry",
+    "comm_fault_cleared", "comm_step_retry", "quorum_replan",
 }
 # the comm-layer fault kinds (resilience.chaos.COMM_FAULTS) — the
 # recovery-latency clock starts at the first of these injected
@@ -421,6 +421,130 @@ def recovery_latency_s(events: List[Dict]) -> Optional[float]:
     return None
 
 
+def _mesh_str(mesh: Optional[Dict]) -> str:
+    if not isinstance(mesh, dict):
+        return "?"
+    return "x".join(
+        str(mesh.get(a, 1)) for a in ("data", "fsdp", "tensor")
+    )
+
+
+def recovery_incidents(events: List[Dict]) -> List[Dict]:
+    """The disaster-recovery timeline: one incident per supervisor mesh
+    replan (typed ``reshape`` event).  Each incident's clock starts at the
+    earliest HARD worker death since the previous replan (when the fault
+    actually landed) and stops at the first step event after the old
+    world is fully torn down (the replan's last ``worker_term`` shutdown
+    — a step before that could be a not-yet-killed old-generation worker,
+    not the survivors), so ``recovery_s`` measures the whole detect →
+    replan → respawn → reshard → step outage, not just the supervisor's
+    bookkeeping."""
+    reshapes = sorted(
+        (
+            (t, e) for e in events
+            if e.get("event") == "reshape"
+            and (t := _event_time(e)) is not None
+        ),
+        key=lambda p: p[0],
+    )
+    if not reshapes:
+        return []
+    deaths = sorted(
+        t for e in events
+        if e.get("event") == "failure" and e.get("kind") in _DEATH_KINDS
+        and "hard" in (e.get("message") or "")
+        and (t := _event_time(e)) is not None
+    )
+    steps = sorted(
+        t for e in events
+        if e.get("event") == "step" and (t := _event_time(e)) is not None
+    )
+    terms = sorted(
+        t for e in events
+        if e.get("event") == "failure" and e.get("kind") == "worker_term"
+        and "reshape" in (e.get("message") or "")
+        and (t := _event_time(e)) is not None
+    )
+    import bisect
+
+    incidents: List[Dict] = []
+    prev = float("-inf")
+    for n, (t_r, e) in enumerate(reshapes):
+        i = bisect.bisect_right(deaths, prev)
+        j = bisect.bisect_right(deaths, t_r)
+        start = deaths[i] if i < j else t_r
+        # the old world is down once this replan's last worker_term landed
+        # (bounded by the next replan, if any)
+        t_next = reshapes[n + 1][0] if n + 1 < len(reshapes) else float("inf")
+        lo = bisect.bisect_right(terms, t_r)
+        hi = bisect.bisect_right(terms, t_next)
+        t_down = terms[hi - 1] if hi > lo else t_r
+        k = bisect.bisect_right(steps, t_down)
+        end = steps[k] if k < len(steps) else None
+        incidents.append({
+            "ts": t_r,
+            "old_world": e.get("old_world"),
+            "new_world": e.get("new_world"),
+            "old_mesh": e.get("old_mesh"),
+            "new_mesh": e.get("new_mesh"),
+            "dead_ranks": e.get("dead_ranks"),
+            "correlated": bool(e.get("correlated")),
+            "reason": e.get("reason", "") or "",
+            "detect_s": t_r - start,
+            "recovery_s": (end - start) if end is not None else None,
+        })
+        prev = t_r
+    return incidents
+
+
+def mttr_s(incidents: List[Dict]) -> Optional[float]:
+    """Mean time to recovery over the incidents that actually healed
+    (produced a post-replan step).  None when there were no incidents or
+    none healed — the gate treats missing as worst-case."""
+    healed = [
+        i["recovery_s"] for i in incidents if i.get("recovery_s") is not None
+    ]
+    return sum(healed) / len(healed) if healed else None
+
+
+def render_recovery_section(incidents: List[Dict]) -> List[str]:
+    lines = [
+        "",
+        "disaster recovery — replan timeline",
+        "-----------------------------------",
+    ]
+    t0 = incidents[0]["ts"]
+    for n, inc in enumerate(incidents):
+        label = "correlated" if inc["correlated"] else "independent"
+        dead = ",".join(str(r) for r in (inc.get("dead_ranks") or []))
+        mesh = ""
+        if inc.get("old_mesh") or inc.get("new_mesh"):
+            mesh = (
+                f"  mesh {_mesh_str(inc.get('old_mesh'))}"
+                f" -> {_mesh_str(inc.get('new_mesh'))}"
+            )
+        lines.append(
+            f"  incident {n}: t+{inc['ts'] - t0:8.3f}s  {label} death of"
+            f" rank(s) [{dead}]  world {inc.get('old_world')} ->"
+            f" {inc.get('new_world')}{mesh}"
+        )
+        rec = (
+            f"{inc['recovery_s']:.3f}s"
+            if inc.get("recovery_s") is not None
+            else "never (no step after replan)"
+        )
+        lines.append(
+            f"    -> detected +{inc['detect_s']:.3f}s, recovered {rec}"
+        )
+    m = mttr_s(incidents)
+    if m is not None:
+        lines.append(
+            f"  MTTR: {m:.3f}s over {len(incidents)} incident(s)"
+            " (hard death -> first post-replan step)"
+        )
+    return lines
+
+
 def render_report(events: List[Dict], name: str = "", skipped_lines: int = 0) -> str:
     by_kind: Dict[str, List[Dict]] = {}
     for e in events:
@@ -554,9 +678,15 @@ def render_report(events: List[Dict], name: str = "", skipped_lines: int = 0) ->
     if spans:
         lines.extend(render_span_section(spans))
 
-    failures = by_kind.get("failure", [])
+    # reshape events ride the failure timeline (their ``kind`` is the
+    # supervisor's replan label, e.g. quorum_replan)
+    failures = by_kind.get("failure", []) + by_kind.get("reshape", [])
     if failures:
         lines.extend(render_failure_timeline(failures))
+
+    incidents = recovery_incidents(events)
+    if incidents:
+        lines.extend(render_recovery_section(incidents))
 
     policies = by_kind.get("policy", [])
     if policies:
@@ -1093,6 +1223,8 @@ def run_report(
 
     failures = [e for e in merged.events if e.get("event") == "failure"]
     deaths = _death_counts(failures)
+    incidents = recovery_incidents(merged.events)
+    mttr = mttr_s(incidents)
     policies = [e for e in merged.events if e.get("event") == "policy"]
     alert_events = [e for e in merged.events if e.get("event") == "alert"]
     alerts_by_kind: Dict[str, int] = {}
@@ -1133,6 +1265,7 @@ def run_report(
             "restarts": sum(
                 1 for f in failures if f.get("kind") == "worker_restart"
             ),
+            "reshapes": len(incidents),
         },
         "policy": {
             "decisions": policies,
@@ -1164,6 +1297,11 @@ def run_report(
         # the gate's recovery scalar: wall seconds from the first injected
         # comm fault to the first clean step (lower = faster heal)
         "recovery_latency_s": recovery_latency_s(merged.events),
+        # disaster-recovery incidents: one per supervisor mesh replan,
+        # clocked hard-death -> first post-replan step; the gate's MTTR
+        # scalar (lower = faster game-day recovery)
+        "recovery": {"incidents": incidents, "mttr_s": mttr},
+        "recovery_time_s": mttr,
         # per-request serving SLOs (None when the run served nothing);
         # the gate's serving scalar is slo.p99_decode_ms_per_token
         "slo": slo_summary_from_events(merged.events),

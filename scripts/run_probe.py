@@ -17,6 +17,14 @@ into ``artifacts/toy_run_flap/`` and asserts the degraded-fabric
 round-trip in the merged report: a ``descend`` AND an ``ascend``
 PolicyEvent, and a finite comm-fault recovery latency.
 
+A fifth phase is the disaster GAME-DAY: a 4-rank run on a declared
+2(data) x 2(tensor) mesh takes a correlated ``zone_outage`` (ranks 2-3
+SIGKILLed at the same step); the supervisor must classify the burst as
+one incident, replan the largest viable survivor mesh (2x1x1 — tensor
+traded for data), resume from checkpoints, and the merged report must
+carry the replan incident with a finite MTTR (``recovery_time_s``) the
+gate reads in advisory mode.
+
 A third phase supervises a 2-rank spool-SERVING fleet
 (``tests/toy_serving_worker.py`` over the real ``serving/`` request
 lifecycle + FileSpool) into ``artifacts/toy_run_serve/``: rank 1 kills
@@ -517,6 +525,138 @@ def main(argv=None) -> int:
         f" {len(nudges)} controller nudge(s), mid-run /metrics scrape on"
         f" port {read_port_file(live_dir)}) at {live_dir};"
         f" report -> {live_json}\n"
+    )
+
+    # --- phase 5: the disaster game-day ----------------------------------
+    # a 4-rank run on a declared 2(data) x 2(tensor) mesh takes a
+    # correlated zone_outage mid-epoch (ranks 2-3 SIGKILLed at the same
+    # step); the supervisor must classify the burst as ONE incident, plan
+    # the largest viable mesh from the 2 survivors (2x1x1 — tensor traded
+    # for data, per the policy table), shut the old world down with a
+    # typed ReshapeEvent, and resume to completion. The merged report must
+    # carry the replan incident with a finite MTTR (``recovery_time_s``),
+    # which the gate then reads in advisory mode.
+    game_dir = run_dir + "_gameday"
+    shutil.rmtree(game_dir, ignore_errors=True)
+    os.makedirs(game_dir, exist_ok=True)
+    game_world = 4
+    game_steps = 10
+    outage_step = 4
+    game_step_s = max(args.step_seconds, 0.02)
+    game_plan = os.path.join(game_dir, "chaos_plan.json")
+    ChaosPlan([
+        FaultSpec(
+            kind="zone_outage", step=outage_step,
+            payload={"ranks": [2, 3]},
+        )
+    ]).save(game_plan)
+
+    def game_argv_for_rank(rank, world_size, incarnation):
+        return [
+            sys.executable, worker,
+            "--rank", str(rank),
+            "--world", str(world_size),
+            "--steps", str(game_steps),
+            "--state-dir", os.path.join(game_dir, "state"),
+            "--result-dir", os.path.join(game_dir, "results"),
+            "--step-seconds", str(game_step_s),
+            "--chaos-plan", game_plan,
+        ]
+
+    game_telemetry = telemetry_for_run(
+        event_log=os.path.join(game_dir, SUPERVISOR_LOG), stdout=False
+    )
+    game_result = Supervisor(
+        argv_for_rank=game_argv_for_rank,
+        world_size=game_world,
+        config=SupervisorConfig(
+            max_restarts=2, backoff_base_s=0.05, poll_interval_s=0.05,
+            term_grace_s=0.5, allow_degraded=True, min_world_size=2,
+            mesh_axes={"data": 2, "tensor": 2},
+            # generous window: both zone deaths land within one or two
+            # polls, but a loaded CI box must not split the incident
+            correlation_window_s=5.0,
+        ),
+        telemetry=game_telemetry,
+        run_dir=game_dir,
+    ).run()
+    game_telemetry.close()
+
+    problems = []
+    if not game_result.success:
+        problems.append(f"game-day run failed: {game_result}")
+    if not game_result.degraded:
+        problems.append("game-day run never degraded — the zone outage"
+                        " did not land")
+    if game_result.world_size != 2:
+        problems.append(
+            f"survivor world is {game_result.world_size}, expected 2"
+        )
+    want_mesh = {"data": 2, "fsdp": 1, "tensor": 1}
+    if game_result.final_mesh != want_mesh:
+        problems.append(
+            f"final mesh {game_result.final_mesh}, expected {want_mesh}"
+            " (the planner must trade tensor for data)"
+        )
+    # the survivors must have finished the full run from their checkpoints
+    for rank in range(2):
+        try:
+            with open(
+                os.path.join(game_dir, "results", f"rank{rank}.json")
+            ) as f:
+                res = json.load(f)
+            if res.get("step") != game_steps:
+                problems.append(
+                    f"survivor rank {rank} finished at step"
+                    f" {res.get('step')}, expected {game_steps}"
+                )
+        except (OSError, ValueError) as exc:
+            problems.append(f"survivor rank {rank} left no result: {exc}")
+
+    game_json = os.path.join(
+        os.path.dirname(args.json_out) or ".", "gameday_report.json"
+    )
+    rc = report.main(["--run-dir", game_dir, "--json-out", game_json])
+    if rc != 0:
+        return rc
+    with open(game_json) as f:
+        game_report = json.load(f)
+    recovery = game_report.get("recovery") or {}
+    incidents = recovery.get("incidents") or []
+    mttr = game_report.get("recovery_time_s")
+    if not incidents:
+        problems.append("no replan incident in the merged report")
+    else:
+        inc = incidents[0]
+        if not inc.get("correlated"):
+            problems.append(
+                f"incident not classified correlated: {inc!r}"
+            )
+        if sorted(inc.get("dead_ranks") or []) != [2, 3]:
+            problems.append(
+                f"incident dead_ranks {inc.get('dead_ranks')!r},"
+                " expected [2, 3]"
+            )
+    if not isinstance(mttr, (int, float)) or not mttr > 0:
+        problems.append(f"recovery_time_s not finite-positive: {mttr!r}")
+    if game_report.get("failures", {}).get("hard", 0) < 2:
+        problems.append(
+            f"expected >= 2 hard deaths in the ledger, got"
+            f" {game_report.get('failures')!r}"
+        )
+    if problems:
+        for prob in problems:
+            sys.stderr.write(f"# run_probe: FAIL: {prob}\n")
+        return 1
+
+    # advisory gate over the game-day report: proves recovery_time_s is
+    # extractable and compared lower-is-better
+    gate.main(["--report", game_json, "--advisory", "--root", REPO])
+    sys.stderr.write(
+        f"# run_probe: game-day ok (zone outage of ranks [2, 3] replanned"
+        f" {game_world} -> {game_result.world_size} on mesh"
+        f" {game_result.final_mesh}, MTTR {mttr:.3f}s) at {game_dir};"
+        f" report -> {game_json}\n"
     )
     return 0
 

@@ -30,12 +30,14 @@ from network_distributed_pytorch_tpu.parallel.trainer import (
 )
 from network_distributed_pytorch_tpu.resilience import (
     COMM_FAULTS,
+    CORRELATED_FAULTS,
     FAULT_KINDS,
     INJECTION_SITES,
     PROCESS_FAULTS,
     ChaosPlan,
     ChaosStep,
     ChaosTransientError,
+    CheckpointUnwritableError,
     CollectiveWatchdog,
     CommDeadlineGuard,
     CommEscalationError,
@@ -52,6 +54,8 @@ from network_distributed_pytorch_tpu.resilience import (
 )
 from network_distributed_pytorch_tpu.resilience.chaos import (
     bitflip_checkpoint,
+    make_checkpoint_unwritable,
+    restore_checkpoint_writable,
     tear_checkpoint,
 )
 from network_distributed_pytorch_tpu.utils import cross_entropy_loss
@@ -327,6 +331,58 @@ def test_fault_spec_rejects_unknown_kind():
         FaultSpec(kind="meteor_strike", step=0)
 
 
+def test_chaos_plan_load_time_validation(tmp_path):
+    """Satellite: a malformed plan refuses at LOAD time, naming the
+    offending entry index — not a crash hours later at injection time."""
+    with pytest.raises(ValueError, match=r"fault\[1\] must be an object"):
+        ChaosPlan.from_json(
+            {"faults": [{"kind": "proc_kill", "step": 0}, "zap"]}
+        )
+    with pytest.raises(
+        ValueError, match=r"fault\[0\] invalid: unknown fault kind"
+    ):
+        ChaosPlan.from_json({"faults": [{"kind": "meteor", "step": 0}]})
+    with pytest.raises(ValueError, match=r"fault\[0\] invalid"):
+        ChaosPlan.from_json(
+            {"faults": [{"kind": "proc_kill", "step": 0, "at_rank": 1}]}
+        )
+    with pytest.raises(
+        ValueError, match=r"fault\[2\] invalid: step must be an int"
+    ):
+        ChaosPlan.from_json({"faults": [
+            {"kind": "proc_kill", "step": 0},
+            {"kind": "step_nan", "step": 1},
+            {"kind": "proc_exit", "step": "soon"},
+        ]})
+    with pytest.raises(
+        ValueError, match=r"fault\[0\] invalid: payload\['ranks'\]"
+    ):
+        ChaosPlan.from_json({"faults": [
+            {"kind": "zone_outage", "step": 0, "payload": {"ranks": []}}
+        ]})
+    # ChaosPlan.load routes files through the same validation
+    path = tmp_path / "bad_plan.json"
+    path.write_text(json.dumps({"faults": [{"kind": "meteor", "step": 0}]}))
+    with pytest.raises(ValueError, match=r"fault\[0\]"):
+        ChaosPlan.load(str(path))
+
+
+def test_correlated_faults_registered_and_zone_matching():
+    assert set(CORRELATED_FAULTS) == {"zone_outage", "host_flap"}
+    for kind in CORRELATED_FAULTS:
+        assert INJECTION_SITES[kind] == "process"
+    assert INJECTION_SITES["ckpt_unwritable"] == "checkpoint"
+    # payload["ranks"] overrides the rank field: every zone member matches
+    spec = FaultSpec(kind="zone_outage", step=3, payload={"ranks": [2, 3]})
+    assert spec.matches(3, 2, 0) and spec.matches(3, 3, 0)
+    assert not spec.matches(3, 0, 0)
+    assert not spec.matches(2, 2, 0)  # wrong step
+    # host_flap matches every incarnation; the worker's flaps cap decides
+    # which lives actually die
+    flap = FaultSpec(kind="host_flap", step=1, rank=0, incarnation=None)
+    assert flap.matches(1, 0, 0) and flap.matches(1, 0, 5)
+
+
 def test_chaos_step_transient_and_nan(devices):
     calls = []
 
@@ -446,6 +502,31 @@ def test_commit_protocol_artifacts(devices, tmp_path):
     assert ok, reason
     # no leftover tmp dirs
     assert not [n for n in os.listdir(root) if n.startswith("_tmp.")]
+
+
+def test_save_checkpoint_unwritable_raises_typed(devices, tmp_path):
+    """Satellite: a persistently unwritable checkpoint root raises the
+    TYPED ``CheckpointUnwritableError`` from ``save_checkpoint`` — the
+    fail-fast signal the supervisor turns into a hard stop instead of a
+    restart storm. The blocker here is a parent path that is a file
+    (errno ENOTDIR), which fails even for root — chmod tricks do not."""
+    blocker = tmp_path / "ckroot"
+    blocker.write_text("not a directory")
+    with pytest.raises(CheckpointUnwritableError, match="unwritable"):
+        save_checkpoint(str(blocker / "ck"), _tree(1.0), step=0)
+    # OSError so orbax/IO handlers see it, NOT RuntimeError so the
+    # transient-retry wrappers (GuardedStep) can never swallow it
+    assert issubclass(CheckpointUnwritableError, OSError)
+    assert not issubclass(CheckpointUnwritableError, RuntimeError)
+
+
+def test_make_checkpoint_unwritable_roundtrip(tmp_path):
+    root = tmp_path / "ck"
+    root.mkdir()
+    make_checkpoint_unwritable(str(root))
+    assert (os.stat(root).st_mode & 0o777) == 0o500
+    restore_checkpoint_writable(str(root))
+    assert (os.stat(root).st_mode & 0o777) == 0o700
 
 
 def test_abort_before_commit_leaves_only_tmp(devices, tmp_path):

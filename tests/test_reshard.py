@@ -53,8 +53,18 @@ from network_distributed_pytorch_tpu.resilience.reshard import (
     make_topology,
     memory_total,
     merge_model_state,
+    merge_tp_leaf,
+    mesh_world,
+    normalize_mesh_axes,
     rescale_accum_steps,
     reshard_from_checkpoint,
+    reshard_tp_params,
+    reshard_train_state,
+    split_tp_leaf,
+    topology_mesh,
+    widen_memories,
+    widen_model_state,
+    widen_template,
 )
 from network_distributed_pytorch_tpu.utils import cross_entropy_loss
 from network_distributed_pytorch_tpu.utils.checkpoint import (
@@ -129,6 +139,80 @@ def test_fold_memories_identity_at_same_world():
     mem = {"m": np.arange(12, dtype=np.float32).reshape(4, 3)}
     out = fold_memories(mem, 4)
     np.testing.assert_array_equal(out["m"], mem["m"])
+
+
+# ---------------------------------------------------------------------------
+# widening: zero-pad rows, bit-exact by x + 0.0 == x
+# ---------------------------------------------------------------------------
+
+def test_widen_memories_zero_pad_bit_for_bit():
+    """Widening appends zero EF rows; since x + 0.0 is exact for every
+    finite fp32 x, the sequential rank-order sum keeps IDENTICAL BYTES —
+    including the non-divisible pairs the fold geometry never sees."""
+    rng = np.random.RandomState(3)
+    for old, new in [(3, 5), (4, 6), (1, 4), (2, 2)]:
+        mem = {
+            "w": (50.0 * rng.randn(old, 5, 3)).astype(np.float32),
+            "b": {"k": rng.randn(old, 9).astype(np.float32)},
+        }
+        before = _bytes_of(memory_total(mem))
+        wide = widen_memories(mem, new)
+        for leaf in jax.tree_util.tree_leaves(wide):
+            arr = np.asarray(leaf)
+            assert arr.shape[0] == new
+            assert not arr[old:].any()  # new ranks start with zero error
+        assert _bytes_of(memory_total(wide)) == before
+    with pytest.raises(ValueError, match="only widens"):
+        widen_memories({"m": np.zeros((4, 2), np.float32)}, 3)
+
+
+def test_widen_model_state_replicates_rank0():
+    arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out = widen_model_state({"mean": arr}, 4)["mean"]
+    assert out.shape == (4, 3)
+    np.testing.assert_array_equal(out[2], arr[0])
+    np.testing.assert_array_equal(out[3], arr[0])
+    assert widen_model_state(None, 4) is None
+    with pytest.raises(ValueError, match="only widens"):
+        widen_model_state({"m": np.zeros((4, 2), np.float32)}, 2)
+
+
+def test_reshard_train_state_widens_non_divisible():
+    """new_world > old_world routes through the widen path — including
+    non-divisible pairs (3 -> 5, 4 -> 6) — with params untouched and the
+    EF sum conserved bit-for-bit."""
+    for old, new in [(3, 5), (4, 6)]:
+        st = _mini(old)
+        before = _bytes_of(memory_total(st.memories))
+        out = reshard_train_state(st, new)
+        for leaf in jax.tree_util.tree_leaves(out.memories):
+            assert np.asarray(leaf).shape[0] == new
+        assert _bytes_of(memory_total(out.memories)) == before
+        assert _bytes_of(out.params) == _bytes_of(st.params)
+        # ...and a later shrink folds the padded rows back losslessly
+        back = reshard_train_state(out, old)
+        assert _bytes_of(memory_total(back.memories)) == before
+
+
+def test_widen_template_states_on_disk_shape():
+    t = _mini(3)
+    wide = widen_template(t, 5)
+    for leaf in jax.tree_util.tree_leaves(wide.memories):
+        arr = np.asarray(leaf)
+        assert arr.shape[0] == 5 and not arr.any()
+    # shrink direction too: the template just states the checkpoint shape
+    narrow = widen_template(t, 2)
+    for leaf in jax.tree_util.tree_leaves(narrow.memories):
+        assert np.asarray(leaf).shape[0] == 2
+
+
+def test_derive_rank_key_for_widened_ranks(devices):
+    """New ranks born in a widening re-derive their PRNG keys from the
+    same base-key lineage — distinct from every surviving rank's, and
+    reproducible."""
+    keys = {r: np.asarray(derive_rank_key(0, r, 1)).tobytes() for r in range(6)}
+    assert len(set(keys.values())) == 6
+    assert np.asarray(derive_rank_key(0, 5, 1)).tobytes() == keys[5]
 
 
 # ---------------------------------------------------------------------------
@@ -269,6 +353,186 @@ def test_reshard_from_checkpoint_requires_topology(devices, tmp_path):
     final = save_checkpoint(root, _mini(4), step=0)  # untagged
     with pytest.raises(ValueError, match="no topology record"):
         reshard_from_checkpoint(final, _mini(3))
+
+
+# ---------------------------------------------------------------------------
+# mesh geometry + TP shard movement
+# ---------------------------------------------------------------------------
+
+def test_normalize_mesh_axes_and_world():
+    assert normalize_mesh_axes(None, 4) == {"data": 4, "fsdp": 1, "tensor": 1}
+    axes = normalize_mesh_axes({"data": 2, "tensor": 2})
+    assert axes == {"data": 2, "fsdp": 1, "tensor": 2}
+    assert mesh_world(axes) == 4
+    assert mesh_world({"data": 3}) == 3
+    with pytest.raises(ValueError, match="unknown mesh axes"):
+        normalize_mesh_axes({"data": 2, "pipeline": 2})
+    with pytest.raises(ValueError, match=">= 1"):
+        normalize_mesh_axes({"data": 0})
+    with pytest.raises(ValueError, match="expected 8"):
+        normalize_mesh_axes({"data": 2, "tensor": 2}, world_size=8)
+    with pytest.raises(ValueError, match="axes or a world size"):
+        normalize_mesh_axes(None)
+    # pre-mesh topology records (no mesh_axes key) mean all-data
+    assert topology_mesh({"world_size": 3}) == {
+        "data": 3, "fsdp": 1, "tensor": 1
+    }
+
+
+def test_tp_leaf_split_merge_roundtrip_exact():
+    rng = np.random.RandomState(11)
+    full = rng.randn(6, 8).astype(np.float32)
+    stacked = split_tp_leaf(full, 4, 1)
+    assert stacked.shape == (4, 6, 2)
+    assert merge_tp_leaf(stacked, 1).tobytes() == full.tobytes()
+    # axis 0 too
+    assert merge_tp_leaf(split_tp_leaf(full, 3, 0), 0).tobytes() == full.tobytes()
+    with pytest.raises(ValueError, match="does not divide"):
+        split_tp_leaf(full, 5, 1)
+    with pytest.raises(ValueError, match=">= 1"):
+        split_tp_leaf(full, 0, 1)
+    with pytest.raises(ValueError, match="leading shard axis"):
+        merge_tp_leaf(np.zeros(4, np.float32), 0)
+
+
+def test_reshard_tp_params_moves_listed_leaves_only():
+    rng = np.random.RandomState(12)
+    full = rng.randn(6, 8).astype(np.float32)
+    b = rng.randn(8).astype(np.float32)
+    params = {"w": split_tp_leaf(full, 2, 1), "b": b}
+    merged = reshard_tp_params(params, 2, 1, {"w": 1})
+    assert merged["w"].shape == (1, 6, 8)
+    assert merged["w"][0].tobytes() == full.tobytes()
+    assert merged["b"].tobytes() == b.tobytes()  # unlisted: replicated
+    # round-trip back to 2 shards is pure byte movement
+    again = reshard_tp_params(merged, 1, 2, {"w": 1})
+    assert _bytes_of(again) == _bytes_of(params)
+    # equal degrees / empty table: identity
+    assert reshard_tp_params(params, 2, 2, {"w": 1}) is params
+    assert reshard_tp_params(params, 2, 1, {}) is params
+
+
+def test_make_topology_records_mesh():
+    topo = make_topology(
+        4, mesh_axes={"data": 2, "tensor": 2}, tp_param_axes={"w": 1}
+    )
+    assert topo["mesh_axes"] == {"data": 2, "fsdp": 1, "tensor": 2}
+    assert topo["tp_param_axes"] == {"w": 1}
+    assert topology_mesh(topo) == {"data": 2, "fsdp": 1, "tensor": 2}
+    # default: all-data, empty TP table — the pre-mesh meaning, recorded
+    assert make_topology(4)["mesh_axes"] == {"data": 4, "fsdp": 1, "tensor": 1}
+    assert make_topology(4)["tp_param_axes"] == {}
+    with pytest.raises(ValueError, match="expected 4"):
+        make_topology(4, mesh_axes={"data": 3})
+
+
+class MeshState(NamedTuple):
+    params: Any
+    memories: Any
+    model_state: Any
+
+
+def _mesh_state(data: int, tp: int, seed: int = 0) -> MeshState:
+    """A TrainState-like mini on a data x tp mesh: ``w`` is TP-stacked
+    ``(tp,) + shard_shape`` (full dim 8 on axis 1), memories per-DATA-rank."""
+    rng = np.random.RandomState(seed)
+    full = rng.randn(6, 8).astype(np.float32)
+    return MeshState(
+        params={"w": split_tp_leaf(full, tp, 1), "b": rng.randn(8).astype(np.float32)},
+        memories={"m": rng.randn(data, 6, 8).astype(np.float32)},
+        model_state=None,
+    )
+
+
+def test_mesh_checkpoint_trades_tensor_for_data(devices, tmp_path):
+    """Tentpole e2e: a 2(data) x 2(tensor) checkpoint boots a 2x1 mesh —
+    TP shards merge by byte movement, the data axis is untouched."""
+    root = str(tmp_path / "ck")
+    st = _mesh_state(2, 2)
+    topo = make_topology(
+        4, mesh_axes={"data": 2, "tensor": 2}, tp_param_axes={"w": 1}
+    )
+    final = save_checkpoint(root, st, step=0, topology=topo)
+    template = _mesh_state(2, 1, seed=9)
+    out = reshard_from_checkpoint(
+        final, template, mesh_axes={"data": 2, "tensor": 1}
+    )
+    full = merge_tp_leaf(st.params["w"], 1)
+    assert out.params["w"].shape == (1, 6, 8)
+    assert out.params["w"][0].tobytes() == full.tobytes()
+    assert np.asarray(out.params["b"]).tobytes() == st.params["b"].tobytes()
+    assert _bytes_of(out.memories) == _bytes_of(st.memories)
+
+
+def test_mesh_checkpoint_folds_data_keeps_tensor(devices, tmp_path):
+    """2(data) x 2(tensor) -> 1x2: the EF fold runs along the data axis
+    (sum conserved bit-for-bit) while the TP stack passes through."""
+    root = str(tmp_path / "ck")
+    st = _mesh_state(2, 2)
+    topo = make_topology(
+        4, mesh_axes={"data": 2, "tensor": 2}, tp_param_axes={"w": 1}
+    )
+    final = save_checkpoint(root, st, step=0, topology=topo)
+    template = _mesh_state(1, 2, seed=9)
+    out = reshard_from_checkpoint(
+        final, template, mesh_axes={"data": 1, "tensor": 2}
+    )
+    assert out.params["w"].shape == (2, 6, 4)
+    assert _bytes_of(out.params["w"]) == _bytes_of(st.params["w"])
+    assert np.asarray(out.memories["m"]).shape[0] == 1
+    assert _bytes_of(memory_total(out.memories)) == _bytes_of(
+        memory_total(st.memories)
+    )
+
+
+def test_mesh_checkpoint_full_collapse_2x2_to_1x1(devices, tmp_path):
+    root = str(tmp_path / "ck")
+    st = _mesh_state(2, 2)
+    topo = make_topology(
+        4, mesh_axes={"data": 2, "tensor": 2}, tp_param_axes={"w": 1}
+    )
+    final = save_checkpoint(root, st, step=0, topology=topo)
+    out = reshard_from_checkpoint(
+        final, _mesh_state(1, 1, seed=9),
+        mesh_axes={"data": 1, "tensor": 1},
+    )
+    assert out.params["w"].shape == (1, 6, 8)
+    assert out.params["w"][0].tobytes() == merge_tp_leaf(
+        st.params["w"], 1
+    ).tobytes()
+    assert _bytes_of(memory_total(out.memories)) == _bytes_of(
+        memory_total(st.memories)
+    )
+
+
+def test_check_topology_mesh_data_axis_mismatch(devices, tmp_path):
+    """A mesh-tagged checkpoint compares the template rows against the
+    recorded DATA degree: the same-mesh restore passes, a different data
+    degree refuses loudly."""
+    root = str(tmp_path / "ck")
+    st = _mesh_state(2, 2)
+    topo = make_topology(
+        4, mesh_axes={"data": 2, "tensor": 2}, tp_param_axes={"w": 1}
+    )
+    final = save_checkpoint(root, st, step=0, topology=topo)
+    # same mesh: restores fine despite world_size (4) != memory rows (2)
+    back = restore_checkpoint(final, _mesh_state(2, 2, seed=9))
+    assert _bytes_of(back.memories) == _bytes_of(st.memories)
+    with pytest.raises(TopologyMismatchError, match="data degree 2"):
+        restore_checkpoint(final, _mesh_state(3, 2, seed=9))
+
+
+def test_reshard_from_checkpoint_rejects_mesh_template_conflict(
+    devices, tmp_path
+):
+    root = str(tmp_path / "ck")
+    final = save_checkpoint(
+        root, _mini(4), step=0, topology=make_topology(4)
+    )
+    with pytest.raises(ValueError, match="per-rank rows"):
+        reshard_from_checkpoint(
+            final, _mini(3), mesh_axes={"data": 2, "tensor": 1}
+        )
 
 
 # ---------------------------------------------------------------------------

@@ -20,12 +20,16 @@ import pytest
 from network_distributed_pytorch_tpu.launch import worker_argv_base
 from network_distributed_pytorch_tpu.observe import MemorySink, Telemetry
 from network_distributed_pytorch_tpu.resilience import (
+    CKPT_UNWRITABLE_EXIT_CODE,
     PREEMPT_EXIT_CODE,
     ChaosPlan,
     FaultSpec,
     Supervisor,
     SupervisorConfig,
+    mesh_from_env,
+    plan_mesh,
 )
+from network_distributed_pytorch_tpu.resilience.supervisor import ENV_MESH
 
 TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
 TOY = os.path.join(TESTS_DIR, "toy_supervised_worker.py")
@@ -234,6 +238,132 @@ def test_toy_graceful_vs_hard_death_classification(tmp_path):
         f"exit code {PREEMPT_EXIT_CODE} (graceful death)" in m for m in msgs
     )
     assert any("exit code -9 (hard death)" in m for m in msgs)
+
+
+def test_plan_mesh_policy_table():
+    """The quorum planner maximizes world, then trades TENSOR for DATA
+    (smallest tensor wins the tie, then smallest fsdp), keeps model axes
+    at divisors of their old degree, and returns None below the floor."""
+    old = {"data": 2, "fsdp": 1, "tensor": 2}
+    assert plan_mesh(old, 2) == {"data": 2, "fsdp": 1, "tensor": 1}
+    assert plan_mesh(old, 3) == {"data": 3, "fsdp": 1, "tensor": 1}
+    assert plan_mesh(old, 4) == {"data": 4, "fsdp": 1, "tensor": 1}
+    assert plan_mesh(old, 1) == {"data": 1, "fsdp": 1, "tensor": 1}
+    assert plan_mesh(old, 1, min_world=2) is None
+    assert plan_mesh(old, 0) is None
+    assert plan_mesh({"data": 2, "fsdp": 2, "tensor": 2}, 4) == {
+        "data": 4, "fsdp": 1, "tensor": 1
+    }
+    # a pure-DP mesh just shrinks/grows along data
+    assert plan_mesh({"data": 4}, 3) == {"data": 3, "fsdp": 1, "tensor": 1}
+
+
+def test_mesh_from_env_roundtrip(monkeypatch):
+    monkeypatch.delenv(ENV_MESH, raising=False)
+    assert mesh_from_env() is None
+    monkeypatch.setenv(ENV_MESH, json.dumps({"data": 2, "tensor": 2}))
+    assert mesh_from_env() == {"data": 2, "tensor": 2}
+    monkeypatch.setenv(ENV_MESH, "not json")
+    assert mesh_from_env() is None
+
+
+def test_toy_quorum_replan_on_zone_outage(tmp_path):
+    """Tentpole: a correlated 2-rank zone outage on a 2(data) x 2(tensor)
+    world is ONE incident — the supervisor replans the survivors to the
+    largest viable mesh (2x1x1, tensor traded for data), emits a typed
+    ReshapeEvent, and the run completes degraded instead of burning both
+    ranks' restart budgets independently."""
+    plan_path = str(tmp_path / "plan.json")
+    ChaosPlan(
+        [FaultSpec(kind="zone_outage", step=2, payload={"ranks": [2, 3]})]
+    ).save(plan_path)
+    telemetry, sink = _telemetry()
+    result = Supervisor(
+        _toy_argv(tmp_path, steps=6, plan_path=plan_path),
+        world_size=4,
+        config=SupervisorConfig(
+            max_restarts=2, backoff_base_s=0.01, poll_interval_s=0.02,
+            allow_degraded=True, min_world_size=2, term_grace_s=0.5,
+            mesh_axes={"data": 2, "tensor": 2}, correlation_window_s=5.0,
+            deadline_s=60.0,
+        ),
+        telemetry=telemetry,
+    ).run()
+    assert result.success, result.reason
+    assert result.degraded
+    assert result.world_size == 2
+    assert result.final_mesh == {"data": 2, "fsdp": 1, "tensor": 1}
+    reshapes = [r for r in sink.records if r.get("event") == "reshape"]
+    assert len(reshapes) == 1
+    assert reshapes[0]["correlated"] is True
+    assert reshapes[0]["dead_ranks"] == [2, 3]
+    assert reshapes[0]["old_mesh"] == {"data": 2, "fsdp": 1, "tensor": 2}
+    assert reshapes[0]["new_mesh"] == {"data": 2, "fsdp": 1, "tensor": 1}
+    degraded = [
+        r.get("message", "") for r in sink.records
+        if r.get("kind") == "degraded_restart"
+    ]
+    assert any("correlated death of ranks [2, 3]" in m for m in degraded)
+    # the survivors finished the run on the replanned world
+    for rank in (0, 1):
+        res = _result(tmp_path, rank)
+        assert res["step"] == 6
+        assert res["world"] == 2
+
+
+def test_toy_host_flap_stays_independent(tmp_path):
+    """A single flapping host (hard death in each of its first two lives)
+    burns its own restart budget — same-rank deaths inside the window are
+    NOT a correlated incident, so no replan happens."""
+    plan_path = str(tmp_path / "plan.json")
+    ChaosPlan(
+        [FaultSpec(kind="host_flap", step=1, rank=1, incarnation=None,
+                   payload={"flaps": 2})]
+    ).save(plan_path)
+    telemetry, sink = _telemetry()
+    result = Supervisor(
+        _toy_argv(tmp_path, steps=4, plan_path=plan_path),
+        world_size=2,
+        config=SupervisorConfig(
+            max_restarts=3, backoff_base_s=0.01, poll_interval_s=0.02,
+            mesh_axes={"data": 2}, correlation_window_s=5.0, deadline_s=60.0,
+        ),
+        telemetry=telemetry,
+    ).run()
+    assert result.success, result.reason
+    assert not result.degraded
+    assert result.world_size == 2
+    assert result.total_restarts == 2  # the flap's two hard deaths
+    assert result.final_mesh == {"data": 2, "fsdp": 1, "tensor": 1}
+    assert not [r for r in sink.records if r.get("event") == "reshape"]
+    r1 = _result(tmp_path, 1)
+    assert r1["step"] == 4 and r1["incarnation"] == 2  # third life finished
+
+
+def test_toy_ckpt_unwritable_fails_fast(tmp_path):
+    """Satellite: a worker that exits with the CKPT_UNWRITABLE sentinel
+    (its state path is persistently unwritable — here the atomic-write tmp
+    path is occupied by a directory, which defeats even root) stops the
+    run IMMEDIATELY: no restart storm against a broken checkpoint dir."""
+    state_dir = tmp_path / "state"
+    state_dir.mkdir()
+    (state_dir / "rank0.json.tmp").mkdir()  # open(tmp, "w") -> EISDIR
+    telemetry, sink = _telemetry()
+    result = Supervisor(
+        _toy_argv(tmp_path, steps=4),
+        world_size=1,
+        config=SupervisorConfig(
+            max_restarts=3, backoff_base_s=0.01, poll_interval_s=0.02,
+            deadline_s=60.0,
+        ),
+        telemetry=telemetry,
+    ).run()
+    assert not result.success
+    assert result.total_restarts == 0  # fail-fast, not a restart storm
+    assert "unwritable" in result.reason
+    assert result.exit_codes.get(0) == CKPT_UNWRITABLE_EXIT_CODE
+    kinds = _kinds(sink)
+    assert "run_failed" in kinds and "worker_restart" not in kinds
 
 
 def test_worker_argv_base_strips_supervisor_flags():

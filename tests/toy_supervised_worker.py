@@ -6,7 +6,10 @@ Simulates a rank of a deterministic "training" run without importing jax
 contribution, so a degraded-world restart visibly changes the accounting —
 checkpoints the accumulator atomically every step, beats a heartbeat file,
 and obeys a ``resilience.chaos.ChaosPlan`` for process-level faults
-(exit / SIGKILL / hang). On completion writes a result JSON per rank.
+(exit / SIGKILL / hang) and correlated faults (``zone_outage`` kills every
+zone member, ``host_flap`` dies hard on its first incarnations). On
+completion writes a result JSON per rank. A persistently unwritable state
+path exits ``CKPT_UNWRITABLE_EXIT_CODE`` (fail-fast, no restart storm).
 
 With ``--graceful-term`` the worker installs the PreemptionGuard-style
 SIGTERM contract: persist state, then exit ``PREEMPT_EXIT_CODE`` so the
@@ -40,6 +43,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from network_distributed_pytorch_tpu.resilience.chaos import (  # noqa: E402
+    CKPT_UNWRITABLE_EXIT_CODE,
+    CORRELATED_FAULTS,
     HEALTH_FAULTS,
     PREEMPT_EXIT_CODE,
     PROCESS_FAULTS,
@@ -98,10 +103,23 @@ def _load_state(path):
 
 
 def _save_state(path, state):
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(state, f)
-    os.replace(tmp, path)
+    # the toy fail-fast contract, mirroring experiments/common.py's
+    # _commit_save: a persistently unwritable state path exits with the
+    # CKPT_UNWRITABLE sentinel after a short retry budget, so the
+    # supervisor fails the run fast instead of feeding a restart storm
+    last = None
+    for attempt in range(2):
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            os.replace(tmp, path)
+            return
+        except OSError as e:
+            last = e
+            time.sleep(0.02 * (attempt + 1))
+    sys.stderr.write(f"toy worker: state unwritable after retries: {last}\n")
+    os._exit(CKPT_UNWRITABLE_EXIT_CODE)
 
 
 def _beat(directory, rank, incarnation, step):
@@ -242,12 +260,22 @@ def main() -> int:
             i = state["step"]
             if args.heartbeat_dir:
                 _beat(args.heartbeat_dir, args.rank, incarnation, i)
-            spec = plan.pop(PROCESS_FAULTS, i, args.rank, incarnation)
+            spec = plan.pop(
+                PROCESS_FAULTS + CORRELATED_FAULTS, i, args.rank, incarnation
+            )
             if spec is not None:
                 if spec.kind == "proc_exit":
                     os._exit(int(spec.payload.get("exit_code", 43)))
-                if spec.kind == "proc_kill":
+                if spec.kind in ("proc_kill", "zone_outage"):
+                    # zone_outage: every rank in payload["ranks"] loads its
+                    # own plan copy, so one spec kills the whole zone
                     os.kill(os.getpid(), signal.SIGKILL)
+                if spec.kind == "host_flap":
+                    # a flapping host dies hard on each of its first
+                    # ``flaps`` incarnations, then stays up — the
+                    # independent-death path that burns restart budget
+                    if incarnation < int(spec.payload.get("flaps", 2)):
+                        os.kill(os.getpid(), signal.SIGKILL)
                 if spec.kind == "proc_hang":
                     time.sleep(float(spec.payload.get("hang_seconds", 3600.0)))
                 if spec.kind == "proc_preempt":
