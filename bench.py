@@ -88,6 +88,11 @@ BASELINE_REPS = int(os.environ.get("BENCH_BASELINE_REPS", "8"))
 # the "small" tier's shallow ResNet-18 carries proportionally less MXU
 # work per byte. Override per-run with BENCH_MFU_TARGET.
 MFU_TARGETS = {"small": 0.002, "full": 0.005}
+# absolute ceiling for the data-plane span share at the flagship tier: the
+# loader must cost under 5% of the overlapped step loop (ISSUE PR 12
+# acceptance). gate.py reads the recorded value as a lower-is-better
+# metric AND this target as an absolute bound, mirroring mfu_target.
+DATA_LOAD_SHARE_TARGET = 0.05
 MARKER = "@BENCH@ "
 
 
@@ -116,11 +121,13 @@ PHASE_BUDGET_S = {
     "gpt": int(os.environ.get("BENCH_GPT_BUDGET_S", "420")),
     "fp32arm": int(os.environ.get("BENCH_FP32ARM_BUDGET_S", "240")),
     "overlap": int(os.environ.get("BENCH_OVERLAP_BUDGET_S", "240")),
+    "loader": int(os.environ.get("BENCH_LOADER_BUDGET_S", "150")),
 }
 # priority order under the global deadline: the headline pair first, then
 # the GPT MFU row (verdict item), then the decomposition arm, then the
-# AOT-only overlap evidence
-PHASES = ("probe", "flagship", "baseline", "gpt", "fp32arm", "overlap")
+# AOT-only overlap evidence, then the loader-isolation arm (host-only —
+# cheap, but it must never displace a device measurement)
+PHASES = ("probe", "flagship", "baseline", "gpt", "fp32arm", "overlap", "loader")
 # extra wait on a child's FIRST event only: process start + jax import +
 # the backend-init watchdog (BENCH_INIT_TIMEOUT_S, default 240 s) all
 # precede it. Without this, a respawned child that hangs at init would be
@@ -904,6 +911,111 @@ def _phase_overlap() -> dict:
     return {"overlap": summary}
 
 
+def _phase_loader() -> dict:
+    """Loader-isolation arm: host-side batch assembly throughput with the
+    training step taken out of the loop, so a data-plane regression can't
+    hide behind (or be blamed on) compute. Three numbers:
+
+    - ``loader_python_samples_per_s``: the literal per-batch numpy
+      assemble (gather + u8→f32 normalize), the pre-native hot path.
+    - ``loader_samples_per_s``: ``NativeBatchLoader`` on the same dataset,
+      order, and batch size — the fused multithreaded C++ pipeline
+      (acceptance: ≥ 2× the Python arm where the native lib builds;
+      falls back to the Python number, labeled, where it can't).
+    - ``data_load_share``: fraction of a short overlapped train loop
+      (double-buffered ``device_prefetch`` + a jitted reduction step)
+      spent BLOCKED on data — the metric the flagship tier gates below
+      5%. Measured here on a synthetic step, so it bounds the loader's
+      own overhead, not any one model's arithmetic intensity."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from network_distributed_pytorch_tpu.data import device_prefetch
+    from network_distributed_pytorch_tpu.native import NativeBatchLoader
+    from network_distributed_pytorch_tpu.native.build import native_available
+
+    small = _small_preset()
+    n = 4096 if small else 16384
+    batch = 64 if small else 256
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 256, size=(n, 32, 32, 3)).astype(np.uint8)
+    y = rng.randint(0, 10, size=(n,)).astype(np.int32)
+    loader = NativeBatchLoader(x, y, batch, seed=0)
+    order = loader._order(0)
+
+    def python_pass() -> int:
+        cnt = 0
+        for start in range(0, len(order), batch):
+            sel = order[start : start + batch]
+            _bx = ((x[sel].astype(np.float32) / 255.0) - 0.5) / 0.5
+            _by = y[sel]
+            cnt += len(sel)
+        return cnt
+
+    python_pass()  # warm caches so both arms measure steady state
+    t0 = time.perf_counter()
+    n_py = python_pass()
+    py_rate = n_py / (time.perf_counter() - t0)
+
+    out = {
+        "loader_python_samples_per_s": round(py_rate, 1),
+        "loader_native": bool(native_available()),
+        "loader_dataset_n": n,
+        "loader_batch": batch,
+    }
+    if out["loader_native"]:
+        for _ in loader.epoch(0):  # warmup pass (thread spawn, faults)
+            pass
+        t0 = time.perf_counter()
+        cnt = 0
+        for bx, _by in loader.epoch(0):
+            cnt += len(bx)
+        native_rate = cnt / (time.perf_counter() - t0)
+        out["loader_samples_per_s"] = round(native_rate, 1)
+        out["loader_native_speedup"] = round(native_rate / py_rate, 2)
+        out["loader_consumer_wait_s"] = round(
+            loader.last_stats["consumer_wait_s"], 4
+        )
+    else:
+        # the gate metric still exists on the fallback tier — it compares
+        # like-for-like against a fallback-tier baseline (same contract as
+        # the CPU smoke flagship)
+        out["loader_samples_per_s"] = round(py_rate, 1)
+
+    # the overlapped loop's step must carry REAL arithmetic — against a
+    # trivial reduction nothing can hide and every loop reads ~100%
+    # data-bound; two dense layers give the prefetcher a flagship-like
+    # compute window to stage under
+    feat = int(np.prod(x.shape[1:]))
+    w1 = jnp.asarray(rng.randn(feat, 512).astype(np.float32) * 0.01)
+    w2 = jnp.asarray(rng.randn(512, feat).astype(np.float32) * 0.01)
+
+    @jax.jit
+    def step(a, b, w1, w2):
+        h = jnp.tanh(a.reshape(a.shape[0], -1) @ w1)
+        return jnp.sum((h @ w2) ** 2) + jnp.sum(b)
+
+    it = device_prefetch(loader.epoch(1), depth=2, label="bench_loader")
+    wait_s = 0.0
+    t_loop = time.perf_counter()
+    steps = 0
+    while True:
+        t1 = time.perf_counter()
+        try:
+            bx, by = next(it)
+        except StopIteration:
+            break
+        wait_s += time.perf_counter() - t1
+        step(bx, by, w1, w2).block_until_ready()
+        steps += 1
+    total = time.perf_counter() - t_loop
+    if steps and total > 0:
+        out["data_load_share"] = round(wait_s / total, 4)
+        out["data_load_share_target"] = DATA_LOAD_SHARE_TARGET
+    return out
+
+
 _PHASE_FNS = {
     "probe": _phase_probe,
     "flagship": _phase_flagship,
@@ -911,6 +1023,7 @@ _PHASE_FNS = {
     "gpt": _phase_gpt,
     "fp32arm": _phase_fp32arm,
     "overlap": _phase_overlap,
+    "loader": _phase_loader,
 }
 
 
@@ -1551,6 +1664,18 @@ def _record_gate_baseline(out: dict, status: dict) -> None:
             rec["alerts_fired"] = float(fired)
     except (OSError, ValueError):
         pass
+    # loader-isolation arm (PR 12): native assembly samples/s is a
+    # higher-is-better gate metric, data_load_share a lower-is-better one
+    # with an absolute ceiling (DATA_LOAD_SHARE_TARGET), mirroring the
+    # mfu/mfu_target pair. Only recorded when the loader phase ran ok —
+    # a skipped phase must not erase the previous baseline's fields.
+    if str(status.get("loader", "")).startswith("ok"):
+        for key in ("loader_samples_per_s", "data_load_share"):
+            v = out.get(key)
+            if isinstance(v, (int, float)) and v >= 0:
+                rec[key] = float(v)
+        if "data_load_share" in rec:
+            rec["data_load_share_target"] = DATA_LOAD_SHARE_TARGET
     # disaster-recovery MTTR from the newest game-day report (run_probe
     # phase 5 — the plain probe report has no replans): rides along so
     # gate.py's lower-is-better recovery_time_s metric has a recorded

@@ -66,33 +66,128 @@ def steps_per_epoch(n: int, batch_size: int, drop_last: bool = True) -> int:
     return n // batch_size if drop_last else -(-n // batch_size)
 
 
-def device_prefetch(batches, sharding=None, depth: int = 2):
-    """Asynchronously stage up to ``depth`` upcoming batches on device.
+def _slots_match(host, leaves) -> bool:
+    if len(host) != len(leaves):
+        return False
+    for buf, a in zip(host, leaves):
+        if isinstance(a, np.ndarray) != isinstance(buf, np.ndarray):
+            return False
+        if isinstance(a, np.ndarray) and (
+            buf.shape != a.shape or buf.dtype != a.dtype
+        ):
+            return False
+    return True
 
+
+def device_prefetch(batches, sharding=None, depth: int = 2, label: str = "train"):
+    """Double-buffered host→device staging, up to ``depth`` batches ahead.
+
+    Each incoming batch is copied into one of ``depth + 1`` PREALLOCATED
+    host staging buffers (``np.copyto`` into stable, page-warm allocations
+    — the host-runtime analogue of pinned staging memory: no per-batch
+    malloc, no allocator churn under the transfer engine), then
     ``jax.device_put`` dispatches the host→device copy without blocking, so
     staging batch N+1 (and N+2) while the jitted step runs batch N overlaps
     the transfer with compute — the input-pipeline overlap torch DataLoader
-    gets from pinned-memory prefetch, done the JAX way. ``sharding`` should
-    be the step's batch sharding (e.g. ``mesh_lib.data_sharding(mesh)``) so
-    the copy lands directly in the right layout; None = default device
+    gets from pinned-memory prefetch, done the JAX way. A staging slot is
+    only rewritten after ``jax.block_until_ready`` on the device array it
+    last fed, so an in-flight transfer can never read a torn buffer.
+
+    ``depth`` is overridable per run via the ``NDP_PREFETCH_DEPTH`` env var
+    (0 = stage-and-yield, no lookahead). ``sharding`` should be the step's
+    batch sharding (e.g. ``mesh_lib.data_sharding(mesh)``) so the copy
+    lands directly in the right layout; None = default device
     (single-process path).
+
+    On exhaustion emits one :class:`observe.events.LoaderEvent` through the
+    ambient recorder — batch/sample counts, end-to-end samples/s, and the
+    time spent *blocked on the upstream producer* (``wait_s``: the number
+    that says whether decode/assemble, not staging, is the bottleneck).
     """
+    import os
+    import time
     from collections import deque
 
     import jax
 
-    def stage(batch):
+    from ..observe.events import LoaderEvent
+    from ..observe.spans import ambient
+
+    env_depth = os.environ.get("NDP_PREFETCH_DEPTH")
+    if env_depth:
+        try:
+            depth = int(env_depth)
+        except ValueError:
+            pass
+    depth = max(int(depth), 0)
+    n_slots = depth + 1
+    slots = [None] * n_slots  # each live slot: [host_leaves, device_batch]
+
+    def stage(batch, slot_i):
         # dispatch only — the copy itself overlaps compute; a long span
         # here means device_put is blocking (e.g. committed-layout reshard)
         with span("data_load/stage"):
-            return jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, sharding), batch
+            leaves, treedef = jax.tree_util.tree_flatten(batch)
+            slot = slots[slot_i]
+            if slot is not None:
+                # the ring guarantee: the slot's previous transfer must have
+                # landed before its host buffers are rewritten (a no-op wait
+                # depth+1 batches later — the step consumed it long ago)
+                jax.block_until_ready(slot[1])
+            if slot is None or not _slots_match(slot[0], leaves):
+                host = [
+                    np.array(a, copy=True) if isinstance(a, np.ndarray) else a
+                    for a in leaves
+                ]
+            else:
+                host = slot[0]
+                for j, a in enumerate(leaves):
+                    if isinstance(host[j], np.ndarray):
+                        np.copyto(host[j], a)
+                    else:
+                        host[j] = a
+            device = jax.tree_util.tree_unflatten(
+                treedef, [jax.device_put(b, sharding) for b in host]
             )
+            slots[slot_i] = [host, device]
+            return device, leaves
 
     queue = deque()
-    for batch in batches:
-        queue.append(stage(batch))
+    it = iter(batches)
+    slot_i = 0
+    n_batches = 0
+    n_samples = 0
+    wait_s = 0.0
+    t_start = time.monotonic()
+    while True:
+        t0 = time.monotonic()
+        try:
+            batch = next(it)
+        except StopIteration:
+            break
+        wait_s += time.monotonic() - t0
+        device, leaves = stage(batch, slot_i)
+        slot_i = (slot_i + 1) % n_slots
+        queue.append(device)
+        n_batches += 1
+        for a in leaves:
+            if isinstance(a, np.ndarray):
+                n_samples += len(a)
+                break
         if len(queue) > depth:
             yield queue.popleft()
     while queue:
         yield queue.popleft()
+    recorder = ambient()
+    if recorder is not None and n_batches:
+        elapsed = max(time.monotonic() - t_start, 1e-9)
+        recorder.emit(
+            LoaderEvent(
+                label=label,
+                batches=n_batches,
+                samples=n_samples,
+                samples_per_s=n_samples / elapsed,
+                prefetch_depth=depth,
+                wait_s=wait_s,
+            )
+        )

@@ -38,6 +38,31 @@ def global_batch_from_local(local_batch: Any, mesh: Mesh, axis_name: str = DATA_
     return jax.tree_util.tree_map(one, local_batch)
 
 
+def merge_tokenized_shards(
+    shards, mesh: Mesh = None, axis_name: str = DATA_AXIS
+):
+    """Per-rank ``WordPieceTokenizer.encode_shard`` outputs (contiguous row
+    blocks, in rank order) → one full-corpus dict of arrays. Shards are
+    contiguous by construction (``wordpiece.shard_rows``), so plain
+    concatenation in rank order restores the exact full-corpus row order —
+    asserted against a monolithic encode in ``tests/test_wordpiece.py``.
+
+    Pass ``mesh`` to go straight to global data-sharded jax.Arrays via
+    :func:`global_batch_from_local` (single-process: the concatenated host
+    arrays are placed whole; on a pod each host instead feeds its OWN
+    shard directly to ``global_batch_from_local`` and never materializes
+    the full corpus — this helper is the single-process/test path)."""
+    if not shards:
+        raise ValueError("no shards to merge")
+    merged = {
+        k: np.concatenate([np.asarray(s[k]) for s in shards], axis=0)
+        for k in shards[0]
+    }
+    if mesh is not None:
+        return global_batch_from_local(merged, mesh, axis_name)
+    return merged
+
+
 def global_state_from_host(state: Any, specs: Any, mesh: Mesh):
     """Place a host-computed pytree (e.g. a freshly-initialized TrainState,
     identical on every process) as GLOBAL jax.Arrays sharded per ``specs``

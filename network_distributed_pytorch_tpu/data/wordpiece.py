@@ -17,12 +17,19 @@ sequence in the batch, which would recompile per length on TPU).
 
 from __future__ import annotations
 
+import collections
+import hashlib
+import os
+import tempfile
 import unicodedata
-from typing import Dict, List, Sequence
+from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
 _MAX_WORD_CHARS = 100  # words longer than this become [UNK] (BERT behavior)
+
+# BERT convention: [PAD] id 0, then the other specials ahead of real tokens
+VOCAB_SPECIALS = ("[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]")
 
 
 def _is_whitespace(ch: str) -> bool:
@@ -57,6 +64,67 @@ def _is_cjk(cp: int) -> bool:
         or 0xF900 <= cp <= 0xFAFF
         or 0x2F800 <= cp <= 0x2FA1F
     )
+
+
+def _clean_text(text: str) -> str:
+    out = []
+    for ch in text:
+        cp = ord(ch)
+        if cp == 0 or cp == 0xFFFD or _is_control(ch):
+            continue
+        out.append(" " if _is_whitespace(ch) else ch)
+    return "".join(out)
+
+
+def _space_cjk_text(text: str) -> str:
+    out = []
+    for ch in text:
+        if _is_cjk(ord(ch)):
+            out += [" ", ch, " "]
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _strip_accent_marks(word: str) -> str:
+    return "".join(
+        ch
+        for ch in unicodedata.normalize("NFD", word)
+        if unicodedata.category(ch) != "Mn"
+    )
+
+
+def _split_punct_word(word: str) -> List[str]:
+    pieces: List[List[str]] = []
+    new_word = True
+    for ch in word:
+        if _is_punctuation(ch):
+            pieces.append([ch])
+            new_word = True
+        else:
+            if new_word:
+                pieces.append([])
+                new_word = False
+            pieces[-1].append(ch)
+    return ["".join(p) for p in pieces]
+
+
+def basic_tokenize(
+    text: str, lower_case: bool = True, strip_accents: bool = True
+) -> List[str]:
+    """The BERT "basic tokenizer" as a free function — shared by the
+    encoder (via :meth:`WordPieceTokenizer.basic_tokenize`) and by
+    :func:`build_vocab`, which must normalize the corpus IDENTICALLY to
+    the tokenizer that will later consume its vocab."""
+    text = _space_cjk_text(_clean_text(text))
+    words: List[str] = []
+    for word in text.split():
+        if lower_case:
+            word = word.lower()
+        if strip_accents:
+            word = _strip_accent_marks(word)
+        words += _split_punct_word(word)
+    return [w for w in words if w]
 
 
 def load_vocab(vocab_file: str) -> Dict[str, int]:
@@ -110,54 +178,19 @@ class WordPieceTokenizer:
     # ---- text normalization (the BERT "basic tokenizer") -----------------
 
     def _clean(self, text: str) -> str:
-        out = []
-        for ch in text:
-            cp = ord(ch)
-            if cp == 0 or cp == 0xFFFD or _is_control(ch):
-                continue
-            out.append(" " if _is_whitespace(ch) else ch)
-        return "".join(out)
+        return _clean_text(text)
 
     def _space_cjk(self, text: str) -> str:
-        out = []
-        for ch in text:
-            if _is_cjk(ord(ch)):
-                out += [" ", ch, " "]
-            else:
-                out.append(ch)
-        return "".join(out)
+        return _space_cjk_text(text)
 
     def _strip_accents(self, word: str) -> str:
-        return "".join(
-            ch
-            for ch in unicodedata.normalize("NFD", word)
-            if unicodedata.category(ch) != "Mn"
-        )
+        return _strip_accent_marks(word)
 
     def _split_punct(self, word: str) -> List[str]:
-        pieces: List[List[str]] = []
-        new_word = True
-        for ch in word:
-            if _is_punctuation(ch):
-                pieces.append([ch])
-                new_word = True
-            else:
-                if new_word:
-                    pieces.append([])
-                    new_word = False
-                pieces[-1].append(ch)
-        return ["".join(p) for p in pieces]
+        return _split_punct_word(word)
 
     def basic_tokenize(self, text: str) -> List[str]:
-        text = self._space_cjk(self._clean(text))
-        words: List[str] = []
-        for word in text.split():
-            if self.lower_case:
-                word = word.lower()
-            if self.strip_accents:
-                word = self._strip_accents(word)
-            words += self._split_punct(word)
-        return [w for w in words if w]
+        return basic_tokenize(text, self.lower_case, self.strip_accents)
 
     # ---- WordPiece (greedy longest-match) --------------------------------
 
@@ -232,6 +265,20 @@ class WordPieceTokenizer:
             mask[rows] = src["attention_mask"]
         return {"input_ids": ids, "attention_mask": mask}
 
+    def encode_shard(
+        self, texts: Sequence[str], world_size: int, rank: int
+    ) -> dict:
+        """Encode only this rank's contiguous shard of ``texts`` (see
+        :func:`shard_rows`): each rank pays ``1/world_size`` of the
+        tokenization cost instead of every rank re-encoding the full
+        corpus. Because shards are contiguous row blocks in rank order,
+        single-process callers reassemble with
+        ``data.multihost.merge_tokenized_shards`` and pod callers feed the
+        shard straight to ``global_batch_from_local`` — the rank-order
+        concatenation IS the full-corpus row order."""
+        start, stop = shard_rows(len(texts), world_size, rank)
+        return self(list(texts[start:stop]))
+
     def python_encode(self, words_per_text: Sequence[List[str]]) -> dict:
         """The reference Python matcher (also the native-parity oracle)."""
         ids = np.full((len(words_per_text), self.max_len), self.pad_id, dtype=np.int32)
@@ -267,3 +314,104 @@ class WordPieceTokenizer:
                 ]
                 self._native = NativeWordPiece.build(ordered)
         return self._native
+
+
+# ---- corpus sharding + vocab building/caching -----------------------------
+
+
+def shard_rows(n: int, world_size: int, rank: int) -> Tuple[int, int]:
+    """Contiguous balanced row range ``[start, stop)`` for ``rank`` of
+    ``world_size``: shard sizes differ by at most one and the rank-order
+    concatenation of all shards is exactly ``range(n)``."""
+    if world_size < 1:
+        raise ValueError(f"world_size must be >= 1, got {world_size}")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} outside [0, {world_size})")
+    return rank * n // world_size, (rank + 1) * n // world_size
+
+
+def build_vocab(
+    texts: Sequence[str],
+    max_size: int = 8192,
+    lower_case: bool = True,
+    strip_accents: bool = True,
+) -> List[str]:
+    """Deterministic corpus-driven ``vocab.txt`` contents (token per line,
+    id = line number): the five BERT specials, every character seen in the
+    normalized corpus plus its ``##`` continuation form (so any word made
+    of seen characters always tokenizes instead of collapsing to [UNK]),
+    then whole words by descending frequency (ties alphabetical) up to
+    ``max_size``. Normalization is the SAME :func:`basic_tokenize` the
+    encoder applies — a vocab built under different flags would silently
+    mis-tokenize."""
+    counts: collections.Counter = collections.Counter()
+    chars = set()
+    for t in texts:
+        for w in basic_tokenize(t, lower_case, strip_accents):
+            counts[w] += 1
+            chars.update(w)
+    tokens: List[str] = list(VOCAB_SPECIALS)
+    seen = set(tokens)
+    for ch in sorted(chars):
+        for tok in (ch, "##" + ch):
+            if tok not in seen:
+                tokens.append(tok)
+                seen.add(tok)
+    for w, _ in sorted(counts.items(), key=lambda kv: (-kv[1], kv[0])):
+        if len(tokens) >= max_size:
+            break
+        if w not in seen:
+            tokens.append(w)
+            seen.add(w)
+    # specials + character coverage are never truncated, even past max_size
+    return tokens
+
+
+def corpus_fingerprint(
+    texts: Sequence[str],
+    max_size: int = 8192,
+    lower_case: bool = True,
+    strip_accents: bool = True,
+) -> str:
+    """Content hash of (corpus, build params) — the vocab cache key."""
+    h = hashlib.sha256()
+    h.update(
+        f"ndp-wordpiece-vocab:1:{max_size}:{int(lower_case)}:"
+        f"{int(strip_accents)}".encode()
+    )
+    for t in texts:
+        b = t.encode("utf-8")
+        h.update(len(b).to_bytes(8, "little"))
+        h.update(b)
+    return h.hexdigest()[:16]
+
+
+def cached_vocab_file(
+    texts: Sequence[str],
+    cache_dir: str,
+    max_size: int = 8192,
+    lower_case: bool = True,
+    strip_accents: bool = True,
+) -> str:
+    """Path to a ``vocab.txt`` for this corpus, built AT MOST ONCE per
+    (corpus, params) fingerprint: every rank and every restart/incarnation
+    that sees the same corpus reuses the on-disk file instead of
+    re-counting it (the rebuild used to dominate small-run startup).
+    Concurrent builders race benignly — both derive identical content and
+    the write is build-to-temp + atomic rename."""
+    fp = corpus_fingerprint(texts, max_size, lower_case, strip_accents)
+    path = os.path.join(cache_dir, f"vocab_{fp}.txt")
+    if os.path.exists(path):
+        return path
+    os.makedirs(cache_dir, exist_ok=True)
+    tokens = build_vocab(texts, max_size, lower_case, strip_accents)
+    fd, tmp = tempfile.mkstemp(suffix=".txt", dir=cache_dir)
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write("\n".join(tokens) + "\n")
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return path

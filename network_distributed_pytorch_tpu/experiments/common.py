@@ -782,6 +782,7 @@ def resilient_train_loop(
     batch_sharding: Any = None,
     topology: Optional[Dict] = None,
     preemption_guard: Any = None,
+    loader_state_fn: Optional[Callable[[int, int], Optional[Dict]]] = None,
 ) -> Tuple[TrainState, "MetricsLogger", int]:
     """:func:`train_loop` plus the survival kit the reference lacks entirely
     (SURVEY §5: no checkpointing, no retry; a failed init doesn't even exit):
@@ -815,6 +816,16 @@ def resilient_train_loop(
       boundary: the save records an ``epoch_cursor`` in the topology tag,
       the loop stops early, and the NEXT resume re-enters the same epoch
       skipping exactly the steps already accounted for.
+    - ``loader_state_fn(epoch, batches_done)`` (optional) produces the
+      data-plane loader-state dict (e.g.
+      ``data.partition.ElasticIndexStream.state``) committed as
+      ``_LOADER_STATE.json`` inside every checkpoint's atomic commit —
+      epoch-boundary saves call it with ``(epoch + 1, 0)``, the
+      preemption-grace save with the mid-epoch ``(epoch, batches_done)``.
+      On resume, read it back via ``utils.checkpoint.read_loader_state(
+      utils.checkpoint.latest_step_path(checkpoint_dir))`` BEFORE building
+      ``batches_for_epoch``, so a resharded world re-enters the stream at
+      the committed cursor (zero samples dropped or duplicated).
 
     Returns ``(state, logger, start_epoch)`` — ``start_epoch`` tells the
     caller how many epochs were skipped via resume.
@@ -940,6 +951,13 @@ def resilient_train_loop(
         out["epoch_cursor"] = cursor
         return out
 
+    def _loader_state(epoch: int, cursor: Optional[Dict]) -> Optional[Dict]:
+        if loader_state_fn is None:
+            return None
+        if cursor is None:  # epoch-boundary save: the NEXT epoch starts clean
+            return loader_state_fn(epoch + 1, 0)
+        return loader_state_fn(int(cursor["epoch"]), int(cursor["batches_done"]))
+
     def _commit_save(st, epoch: int, cursor: Optional[Dict] = None) -> None:
         # small in-place retry budget for a transient write refusal, then
         # the typed fail-fast: emit the detection event and exit with the
@@ -956,6 +974,7 @@ def resilient_train_loop(
                 save_checkpoint(
                     checkpoint_dir, st, step=epoch, keep_last=keep_last,
                     topology=_topo(cursor),
+                    loader_state=_loader_state(epoch, cursor),
                 )
                 return
             except CheckpointUnwritableError as e:

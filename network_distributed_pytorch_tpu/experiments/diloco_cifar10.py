@@ -135,6 +135,22 @@ def run(
             if max_rounds is not None and rounds_done >= max_rounds:
                 pending = []
                 break
+            if len(bx) != len(by) or len(by) == 0:
+                # a genuinely malformed batch is the ONLY thing still
+                # dropped (and tallied): partial ROUNDS are padded and
+                # masked below, so the clean path's drop count is zero
+                telemetry.emit(
+                    DataDropEvent(
+                        label="diloco_cifar10",
+                        epoch=epoch,
+                        dropped_batches=1,
+                        dropped_samples=max(len(bx), len(by)),
+                        reason=f"malformed batch: {len(bx)} images vs"
+                               f" {len(by)} labels",
+                        rank=config.process_id,
+                    )
+                )
+                continue
             pending.append((bx, by))
             if len(pending) < sync_every:
                 continue
@@ -156,19 +172,31 @@ def run(
             rounds_done += 1
             total_rounds += 1
         if pending:
-            # same convention as the static-shape loader's ragged-batch
-            # drop, but TYPED: a partial round cannot sync, and the report's
-            # data-drop tally should see exactly how many samples that cost
-            telemetry.emit(
-                DataDropEvent(
-                    label="diloco_cifar10",
-                    epoch=epoch,
-                    dropped_batches=len(pending),
-                    dropped_samples=sum(len(b[1]) for b in pending),
-                    reason=f"partial round < sync_every={sync_every}",
-                    rank=config.process_id,
-                )
+            # pad-and-mask instead of dropping: the stack is padded to
+            # sync_every with zero batches weighted 0.0, which the compiled
+            # scan turns into carry no-ops (localsgd._mask_step) — every
+            # sample still trains and syncs, at the same static shapes (no
+            # recompile). Round loss averages over REAL steps only.
+            n_real = len(pending)
+            pad = sync_every - n_real
+            zero = tuple(np.zeros_like(a) for a in pending[0])
+            batches = tuple(
+                jnp.asarray(np.stack([b[i] for b in pending] + [zero[i]] * pad))
+                for i in range(2)
             )
+            weights = jnp.asarray(
+                [1.0] * n_real + [0.0] * pad, dtype=jnp.float32
+            )
+            pending = []
+            logger.start_step()
+            state, losses = diloco(state, batches, weights=weights)
+            losses = np.asarray(jax.device_get(losses))
+            logger.end_step(
+                epoch, float(losses.sum() / n_real),
+                bits=phase_bits[total_rounds % len(phase_bits)],
+            )
+            rounds_done += 1
+            total_rounds += 1
         logger.end_epoch(epoch, rank=config.process_id)
 
     extra = {

@@ -102,6 +102,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.ndp_loader_next.restype = c.c_int
     lib.ndp_loader_destroy.argtypes = [c.c_void_p]
     lib.ndp_loader_destroy.restype = None
+    lib.ndp_loader_stats.argtypes = [c.c_void_p, c.POINTER(c.c_longlong)]
+    lib.ndp_loader_stats.restype = None
     lib.ndp_tokenize_hash.argtypes = [
         c.c_void_p, c.c_void_p, c.c_int64, c.c_int32, c.c_int32, c.c_int,
         c.c_void_p, c.c_void_p,

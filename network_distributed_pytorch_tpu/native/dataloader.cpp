@@ -23,6 +23,7 @@
 //                             shuffle semantics) into a bounded ring buffer.
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -159,6 +160,11 @@ struct NdpLoader {
   std::mutex mu;
   std::condition_variable cv_space, cv_item;
   std::atomic<bool> stop{false};
+  // pipeline health counters (read via ndp_loader_stats): batches handed to
+  // the consumer, and how long the consumer sat blocked waiting for the
+  // worker — the "is assembly the bottleneck" number, measured natively.
+  std::atomic<long long> emitted{0};
+  std::atomic<long long> consumer_wait_ns{0};
   std::thread worker;
 
   void run() {
@@ -209,16 +215,33 @@ void* ndp_loader_create(const uint8_t* x_u8, const float* x_f32,
 int ndp_loader_next(void* loader, float* out_x, int32_t* out_y) {
   auto* L = (NdpLoader*)loader;
   if (L->next_emit >= L->n_batches) return 0;
+  auto t0 = std::chrono::steady_clock::now();
   std::unique_lock<std::mutex> lk(L->mu);
   L->cv_item.wait(lk, [&] { return !L->ready.empty(); });
   NdpLoader::Slot s = std::move(L->ready.front());
   L->ready.pop();
   L->cv_space.notify_one();
   lk.unlock();
+  L->consumer_wait_ns.fetch_add(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now() - t0)
+          .count());
   std::memcpy(out_x, s.x.data(), s.x.size() * sizeof(float));
   std::memcpy(out_y, s.y.data(), s.y.size() * sizeof(int32_t));
   L->next_emit++;
+  L->emitted.fetch_add(1);
   return 1;
+}
+
+// Pipeline counters since create: out[0] = batches emitted, out[1] = total
+// nanoseconds the consumer spent blocked in ndp_loader_next, out[2] = the
+// epoch's total batch count. Safe to call at any time, including after
+// exhaustion.
+void ndp_loader_stats(void* loader, long long* out) {
+  auto* L = (NdpLoader*)loader;
+  out[0] = L->emitted.load();
+  out[1] = L->consumer_wait_ns.load();
+  out[2] = (long long)L->n_batches;
 }
 
 void ndp_loader_destroy(void* loader) {

@@ -8,6 +8,7 @@ library can't build or ``NDP_TPU_NO_NATIVE=1``.
 
 from __future__ import annotations
 
+import ctypes
 import os
 from typing import Iterator, Optional, Tuple
 
@@ -269,6 +270,8 @@ class NativeBatchLoader:
         self._mean, self._std = mean, std
         self._depth = depth
         self._lib = load_library()
+        # pipeline counters of the most recently exhausted epoch (see epoch())
+        self.last_stats: Optional[dict] = None
 
     @classmethod
     def maybe_create(
@@ -296,9 +299,31 @@ class NativeBatchLoader:
             len(self._x), self._batch, self._seed, epoch, self._shuffle
         ).astype(np.int64)
 
-    def epoch(self, epoch: int = 0) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        """Yield (x_f32, y) batches for one epoch, prefetched natively."""
-        order = self._order(epoch)
+    def epoch(
+        self, epoch: int = 0, order: Optional[np.ndarray] = None
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Yield (x_f32, y) batches for one epoch, prefetched natively.
+
+        ``order`` overrides the seeded-shuffle permutation with an explicit
+        index sequence — the streamed-elastic-index hook: a rank hands the
+        loader exactly the ``data.partition.ElasticIndexStream`` window it
+        owns (already cursor-resumed, already resharded), and the native
+        assembly pipeline runs unchanged. Truncated to whole batches,
+        matching ``epoch_order``'s ``drop_last`` semantics.
+
+        After exhaustion, :attr:`last_stats` carries the pipeline counters
+        (batches emitted, time the consumer spent blocked on assembly,
+        which path ran) for :class:`observe.events.LoaderEvent`.
+        """
+        if order is None:
+            order = self._order(epoch)
+        else:
+            order = np.ascontiguousarray(np.asarray(order, np.int64))
+            if order.size and (
+                order.min() < 0 or int(order.max()) >= len(self._x)
+            ):
+                raise ValueError("explicit order index out of range")
+            order = order[: (len(order) // self._batch) * self._batch]
         if self._lib is None:
             yield from self._epoch_fallback(order)
             return
@@ -322,11 +347,20 @@ class NativeBatchLoader:
                     break
                 yield bx, by.reshape((self._batch,) + self._y_shape)
         finally:
+            stats = (ctypes.c_longlong * 3)()
+            self._lib.ndp_loader_stats(handle, stats)
+            self.last_stats = {
+                "native": True,
+                "batches": int(stats[0]),
+                "consumer_wait_s": stats[1] / 1e9,
+                "n_batches": int(stats[2]),
+            }
             self._lib.ndp_loader_destroy(handle)
 
     def _epoch_fallback(
         self, order: np.ndarray
     ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        emitted = 0
         for start in range(0, len(order), self._batch):
             sel = order[start : start + self._batch]
             bx = (
@@ -335,6 +369,13 @@ class NativeBatchLoader:
                 else self._x[sel]
             )
             yield bx, self._y[sel].reshape((len(sel),) + self._y_shape)
+            emitted += 1
+        self.last_stats = {
+            "native": False,
+            "batches": emitted,
+            "consumer_wait_s": 0.0,
+            "n_batches": len(order) // self._batch,
+        }
 
     def steps_per_epoch(self) -> int:
         return len(self._x) // self._batch

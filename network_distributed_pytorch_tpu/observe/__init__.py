@@ -63,6 +63,7 @@ from .events import (  # noqa: F401
     EpochEvent,
     Event,
     FailureEvent,
+    LoaderEvent,
     MarkerEvent,
     MfuEvent,
     NoteEvent,

@@ -394,6 +394,43 @@ class DataDropEvent(Event):
 
 
 @dataclass
+class LoaderEvent(Event):
+    """One ingestion-pipeline verdict per epoch (or bench phase): how fast
+    the data plane fed the device and where its time went.
+    ``samples_per_s`` is end-to-end through decode + assemble + staging;
+    ``wait_s`` is the staging loop's time blocked on the UPSTREAM producer
+    (decode/assemble), so ``wait_s ≈ 0`` means ingestion outran the
+    consumer and a large ``wait_s`` names the host hot path — the number
+    ``bench.py``'s loader-isolation phase regresses against. ``native``
+    says which decode/assemble path ran (True = the C++ loader, False =
+    the Python fallback, None = unknown/mixed); ``cursor`` carries the
+    global stream position for streamed-index runs (the same value
+    checkpointed in ``_LOADER_STATE.json``)."""
+
+    KIND: ClassVar[str] = "loader"
+
+    label: str
+    batches: int
+    samples: int
+    samples_per_s: float
+    prefetch_depth: int = 0
+    wait_s: float = 0.0
+    native: Optional[bool] = None
+    epoch: Optional[int] = None
+    cursor: Optional[int] = None
+    rank: Optional[int] = None
+
+    def banner(self) -> str:
+        path = {True: "native", False: "python", None: "?"}[self.native]
+        return (
+            f"[observe] loader {self.label}: {self.samples} sample(s) /"
+            f" {self.batches} batch(es) at {self.samples_per_s:,.0f}"
+            f" samples/s ({path} path, depth {self.prefetch_depth},"
+            f" producer wait {self.wait_s:.3f}s)"
+        )
+
+
+@dataclass
 class RequestEvent(Event):
     """Terminal record of one serving request through
     :mod:`serving.engine` — emitted once, when the request leaves the

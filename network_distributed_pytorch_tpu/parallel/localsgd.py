@@ -222,9 +222,32 @@ class DiLoCoState(NamedTuple):
     model_state: PyTree
 
 
+def _mask_step(inner_step):
+    """Wrap a scan body so zero-weight slots are no-ops: the carry is
+    select-restored leaf-wise and the loss zeroed. This lets a trailing
+    PARTIAL sync round run through the full-length compiled scan — pad the
+    batch stack to ``sync_every`` with anything (zeros work) and weight the
+    padding 0.0; no sample is dropped and no recompile is triggered. With
+    all-ones weights the select is the identity (``jnp.where(True, n, o)``
+    is ``n`` bitwise), so the legacy no-padding path is unchanged."""
+
+    def step(carry, xs):
+        batch, w = xs
+        new_carry, loss = inner_step(carry, batch)
+        keep = w > 0
+        new_carry = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(keep, n, o), new_carry, carry
+        )
+        return new_carry, jnp.where(keep, loss, 0.0)
+
+    return step
+
+
 class CompiledDiLoCo(NamedTuple):
-    """One jitted DiLoCo round: ``fn(state, stacked_batches) -> (state,
-    losses)`` with batch leaves carrying a leading ``sync_every`` axis.
+    """One jitted DiLoCo round: ``fn(state, stacked_batches, weights) ->
+    (state, losses)`` with batch leaves carrying a leading ``sync_every``
+    axis. ``__call__`` defaults ``weights`` to all-ones; pass 0.0 for
+    padded trailing-round slots (see :func:`_mask_step`).
     ``bits_per_round`` = one reducer pass over a parameter-shaped tree plus
     ``sync_every`` scalar loss pmeans (same scan-body caveat as
     :class:`CompiledLocalSGD`)."""
@@ -237,8 +260,10 @@ class CompiledDiLoCo(NamedTuple):
     reducer: Any
     inner_optimizer: Any = None
 
-    def __call__(self, state, batches):
-        return self.fn(state, batches)
+    def __call__(self, state, batches, weights=None):
+        if weights is None:
+            weights = jnp.ones((self.sync_every,), jnp.float32)
+        return self.fn(state, batches, weights)
 
     @property
     def bits_per_step(self) -> float:
@@ -340,7 +365,7 @@ def make_diloco_train_fn(
         axis_name, optimizer=inner_optimizer,
     )
 
-    def sharded_round(state: DiLoCoState, batches):
+    def sharded_round(state: DiLoCoState, batches, weights):
         params0 = state.params
         # cast to device-varying before differentiation so per-worker grads
         # (and hence deltas) stay unsynchronized until the reducer runs —
@@ -349,9 +374,9 @@ def make_diloco_train_fn(
             lambda p: jax.lax.pcast(p, axis_name, to="varying"), params0
         )
         (local_params, inner_opt, model_state), losses = jax.lax.scan(
-            inner_step,
+            _mask_step(inner_step),
             (local0, strip_leading(state.inner_opt), strip_leading(state.model_state)),
-            batches,
+            (batches, weights),
         )
         # outer gradient: this worker's round displacement θ₀ − θ_H, plus
         # the residual its compressor dropped last round (EF telescoping)
@@ -406,7 +431,9 @@ def make_diloco_train_fn(
         jax.shard_map(
             sharded_round,
             mesh=mesh,
-            in_specs=(state_specs, PartitionSpec(None, axis_name)),
+            in_specs=(
+                state_specs, PartitionSpec(None, axis_name), PartitionSpec()
+            ),
             out_specs=(state_specs, PartitionSpec()),
         ),
         donate_argnums=(0,) if donate_state else (),
@@ -485,7 +512,11 @@ class CompiledStreamingDiLoCo(NamedTuple):
     reducer: Any
     host_phase: dict = None  # mutable cell; seeded lazily from the carry
 
-    def __call__(self, state, batches, round_index: Optional[int] = None):
+    def __call__(
+        self, state, batches, round_index: Optional[int] = None, weights=None
+    ):
+        if weights is None:
+            weights = jnp.ones((self.sync_every,), jnp.float32)
         if round_index is None:
             # keep a host-side shadow of the carried phase counter: reading
             # int(state.phase) every call would block the host on the
@@ -501,7 +532,7 @@ class CompiledStreamingDiLoCo(NamedTuple):
             # an explicit call also advances the shadow so a later implicit
             # call continues from round_index + 1 instead of a stale count
             self.host_phase["phase"] = round_index + 1
-        return self.fns[k](state, batches)
+        return self.fns[k](state, batches, weights)
 
     @property
     def peak_sync_bits(self) -> int:
@@ -605,15 +636,15 @@ def make_streaming_diloco_train_fn(
     def make_phase(k: int):
         idx = frag_indices[k]
 
-        def phase(state: StreamingDiLoCoState, batches):
+        def phase(state: StreamingDiLoCoState, batches, weights):
             (params, inner_opt, model_state), losses = jax.lax.scan(
-                inner_step,
+                _mask_step(inner_step),
                 (
                     strip_leading(state.params),
                     strip_leading(state.inner_opt),
                     strip_leading(state.model_state),
                 ),
-                batches,
+                (batches, weights),
             )
             p_leaves = list(jax.tree_util.tree_leaves(params))
             a_leaves = list(jax.tree_util.tree_leaves(state.anchors))
@@ -674,7 +705,10 @@ def make_streaming_diloco_train_fn(
             jax.shard_map(
                 phase,
                 mesh=mesh,
-                in_specs=(state_specs, PartitionSpec(None, axis_name)),
+                in_specs=(
+                    state_specs, PartitionSpec(None, axis_name),
+                    PartitionSpec(),
+                ),
                 out_specs=(state_specs, PartitionSpec()),
             ),
             donate_argnums=(0,) if donate_state else (),

@@ -11,6 +11,14 @@ Fault kinds and where they bite:
 ==================  =========================================================
 ``loader_bad_batch``   the data loader yields a NaN-poisoned batch
 ``loader_short_batch`` the loader yields a batch with a truncated leading dim
+``loader_slow_shard``  this rank's data shard turns slow: every batch for
+                       the next ``payload["batches"]`` pays a fixed
+                       ``payload["delay_s"]`` host sleep (a cold filer /
+                       contended decode thread) — the PR 5 straggler
+                       detector must name the rank from step p50s alone
+``loader_skewed_shard`` like ``loader_slow_shard`` but the delay RAMPS
+                       linearly over the window (skewed shard sizes after a
+                       bad re-split: the rank falls progressively behind)
 ``step_transient``     the step raises a transient ``RuntimeError`` at the
                        reducer boundary (a preemption blip / tunnel hiccup)
 ``step_nan``           the step reports a NaN loss (gradient burst) without
@@ -61,7 +69,10 @@ from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
 
 import numpy as np
 
-LOADER_FAULTS = ("loader_bad_batch", "loader_short_batch")
+LOADER_FAULTS = (
+    "loader_bad_batch", "loader_short_batch",
+    "loader_slow_shard", "loader_skewed_shard",
+)
 STEP_FAULTS = ("step_transient", "step_nan")
 CHECKPOINT_FAULTS = ("ckpt_torn", "ckpt_bitflip", "ckpt_unwritable")
 PROCESS_FAULTS = ("proc_exit", "proc_kill", "proc_hang", "proc_preempt")
@@ -86,6 +97,8 @@ FAULT_KINDS = (
 INJECTION_SITES: Dict[str, str] = {
     "loader_bad_batch": "loader",       # chaos_batches
     "loader_short_batch": "loader",     # chaos_batches
+    "loader_slow_shard": "loader",      # chaos_batches (timing, not content)
+    "loader_skewed_shard": "loader",    # chaos_batches (timing, not content)
     "step_transient": "step",           # ChaosStep
     "step_nan": "step",                 # ChaosStep
     "ckpt_torn": "checkpoint",          # apply_checkpoint_fault
@@ -359,8 +372,19 @@ def chaos_batches(
 ) -> Callable[[int], Iterator[Any]]:
     """Wrap a per-epoch batch generator factory with the plan's loader
     faults. The trigger index counts batches ACROSS epochs within this
-    process, matching the step indexing of :class:`ChaosStep`."""
+    process, matching the step indexing of :class:`ChaosStep`.
+
+    Content faults (``loader_bad_batch`` / ``loader_short_batch``) poison
+    ONE batch. Timing faults (``loader_slow_shard`` /
+    ``loader_skewed_shard``) open a WINDOW: from the trigger batch, the
+    next ``payload["batches"]`` (default 8) batches each pay a host-side
+    sleep — fixed ``payload["delay_s"]`` (default 0.05) for the slow
+    shard, ramping ``delay_s * (k+1)/batches`` for the skewed shard — so
+    the target rank's step p50 rises and the straggler detector must name
+    it with no other signal."""
     counter = {"i": 0}
+    # open timing window: remaining batches, window size, per-batch delay fn
+    slow: Dict[str, Any] = {"left": 0, "total": 0, "delay": None}
     rng = np.random.RandomState(plan.seed)
 
     def poisoned(batch, spec: FaultSpec):
@@ -390,7 +414,20 @@ def chaos_batches(
             spec = plan.pop(LOADER_FAULTS, i, rank, incarnation)
             if spec is not None:
                 _emit_injected(telemetry, spec, i, rank, incarnation)
-                batch = poisoned(batch, spec)
+                if spec.kind in ("loader_slow_shard", "loader_skewed_shard"):
+                    n = max(1, int(spec.payload.get("batches", 8)))
+                    delay_s = float(spec.payload.get("delay_s", 0.05))
+                    if spec.kind == "loader_slow_shard":
+                        slow["delay"] = lambda k: delay_s
+                    else:
+                        slow["delay"] = lambda k, n=n: delay_s * (k + 1) / n
+                    slow["left"] = n
+                    slow["total"] = n
+                else:
+                    batch = poisoned(batch, spec)
+            if slow["left"] > 0:
+                time.sleep(slow["delay"](slow["total"] - slow["left"]))
+                slow["left"] -= 1
             yield batch
 
     return gen

@@ -45,10 +45,13 @@ from ..observe.spans import span
 COMMITTED_MARKER = "_COMMITTED"
 CHECKSUM_MANIFEST = "_CHECKSUMS.json"
 TOPOLOGY_RECORD = "_TOPOLOGY.json"
+LOADER_STATE_RECORD = "_LOADER_STATE.json"
 _TMP_PREFIX = "_tmp."
 # files our own protocol adds on top of what orbax wrote — excluded from the
 # manifest so the hash set covers exactly the checkpoint payload
-_PROTOCOL_FILES = {COMMITTED_MARKER, CHECKSUM_MANIFEST, TOPOLOGY_RECORD}
+_PROTOCOL_FILES = {
+    COMMITTED_MARKER, CHECKSUM_MANIFEST, TOPOLOGY_RECORD, LOADER_STATE_RECORD,
+}
 
 
 class TopologyMismatchError(ValueError):
@@ -142,6 +145,31 @@ def read_topology(path: str) -> Optional[Dict[str, Any]]:
     return topo if isinstance(topo, dict) else None
 
 
+def write_loader_state(path: str, state: Dict[str, Any]) -> str:
+    """Tag a checkpoint directory with its data-plane loader state (the
+    ``_TOPOLOGY.json``-adjacent record: stream kind, seed, data_len, global
+    cursor — ``data.partition.ElasticIndexStream.state`` builds the dict).
+    Committed atomically with the checkpoint itself when routed through
+    :func:`save_checkpoint`'s ``loader_state``, which is what makes the
+    zero-drop resume transactional: samples count as consumed exactly when
+    the checkpoint carrying their cursor commits."""
+    full = os.path.join(path, LOADER_STATE_RECORD)
+    with open(full, "w") as f:
+        json.dump(state, f, indent=2, sort_keys=True)
+    return full
+
+
+def read_loader_state(path: str) -> Optional[Dict[str, Any]]:
+    """The loader-state record of a checkpoint directory, or None for a
+    checkpoint written before (or without) the streamed data plane."""
+    try:
+        with open(os.path.join(path, LOADER_STATE_RECORD)) as f:
+            state = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return state if isinstance(state, dict) else None
+
+
 def _template_world(template: Any) -> Optional[int]:
     # TrainState-like templates carry the world size as the leading axis of
     # every per-rank memories leaf; anything else is topology-agnostic
@@ -193,9 +221,12 @@ def check_topology(path: str, template: Any) -> Optional[Dict[str, Any]]:
 def _commit(
     tmp: str, final: str, step: Optional[int],
     topology: Optional[Dict[str, Any]] = None,
+    loader_state: Optional[Dict[str, Any]] = None,
 ) -> None:
     if topology is not None:
         write_topology(tmp, topology)
+    if loader_state is not None:
+        write_loader_state(tmp, loader_state)
     write_manifest(tmp)
     with open(os.path.join(tmp, COMMITTED_MARKER), "w") as f:
         json.dump({"step": step, "ts": time.time()}, f)
@@ -210,6 +241,7 @@ def save_checkpoint(
     step: Optional[int] = None,
     keep_last: Optional[int] = None,
     topology: Optional[Dict[str, Any]] = None,
+    loader_state: Optional[Dict[str, Any]] = None,
     _abort_before_commit: bool = False,
 ) -> str:
     """Save a state pytree — a ``TrainState`` or any experiment carry —
@@ -218,6 +250,8 @@ def save_checkpoint(
     committed steps after the save lands. ``topology`` tags the checkpoint
     with its world-size record (see :func:`write_topology`), which is what
     makes it restorable at a SHRUNK world through the resharder.
+    ``loader_state`` tags it with the data-plane stream cursor (see
+    :func:`write_loader_state`) in the same atomic commit.
 
     ``_abort_before_commit`` is the fault-injection seam: it returns after
     the data write but BEFORE the manifest/marker/rename, leaving exactly
@@ -249,7 +283,9 @@ def save_checkpoint(
                 # context exit waits for the async write — data is on disk
             if _abort_before_commit:
                 return tmp
-            _commit(tmp, final, step, topology=topology)
+            _commit(
+                tmp, final, step, topology=topology, loader_state=loader_state
+            )
     except OSError as e:
         if isinstance(e, CheckpointUnwritableError):
             raise

@@ -55,7 +55,10 @@ set -e
 # or the newest BENCH_r*.json) — all inside run_probe. The probe's fifth
 # phase is the disaster game day: a correlated zone outage mid-epoch that
 # the supervisor must survive by replanning the mesh, with the measured
-# MTTR gated as recovery_time_s. Advisory because shared CI boxes have
+# MTTR gated as recovery_time_s. The sixth phase is the data plane: the
+# loader-throughput smoke with the native pipeline forced off, plus a
+# chaos loader_slow_shard that must surface as a straggler verdict in
+# the merged report. Advisory because shared CI boxes have
 # noisy step times; run gate.py without --advisory on dedicated perf
 # hardware to make it blocking.
 python scripts/run_probe.py || true

@@ -27,6 +27,11 @@ Metrics compared (only those present in BOTH report and baseline):
   imgs/sec")
 - ``p99_decode_ms_per_token`` lower is better (report ``slo`` section —
   the serving engine's tail decode latency per generated token)
+- ``loader_samples_per_s``    higher is better (bench loader phase —
+  host-side batch assembly rate, isolated from compute)
+- ``data_load_share``        lower is better (fraction of the step loop
+  blocked on data; also gated against the ABSOLUTE
+  ``data_load_share_target`` ceiling bench.py records — 5% flagship)
 
 Span time shares (report ``spans.by_name[*].share``) are compared
 separately when both sides carry them: a span name whose share of run
@@ -79,6 +84,17 @@ METRICS: Dict[str, str] = {
     # may legitimately be 0 (extract_metrics accepts it); more alerts than
     # the recorded baseline means the run's health envelope got worse
     "alerts_fired": "lower",
+    # loader-isolation assembly rate (bench.py ``loader`` phase) — the
+    # data plane's own throughput, gated so a loader regression can't
+    # hide behind a compute-bound flagship number
+    "loader_samples_per_s": "higher",
+    # fraction of the overlapped step loop blocked on data (bench's
+    # synthetic loop, or the run report's ``data_load`` span share) — a
+    # growing share means the loader stopped hiding under the step.
+    # Zero is the healthy value, so 0 records like alerts_fired, and an
+    # ABSOLUTE ceiling (``data_load_share_target``) backstops the
+    # relative comparison exactly as mfu_target does for MFU
+    "data_load_share": "lower",
 }
 
 BASELINE_NAME = "GATE_BASELINE.json"
@@ -134,6 +150,21 @@ def extract_metrics(doc: Dict) -> Dict[str, float]:
     v = doc.get("alerts_fired")
     if isinstance(v, (int, float)) and v == v and v >= 0:
         out.setdefault("alerts_fired", float(v))
+    # loader metrics: flat in bench baselines; a run report instead carries
+    # the data_load share nested in its spans section (zero is healthy and
+    # records, like alerts_fired)
+    v = doc.get("loader_samples_per_s")
+    if isinstance(v, (int, float)) and v == v and v > 0:
+        out["loader_samples_per_s"] = float(v)
+    v = doc.get("data_load_share")
+    if isinstance(v, (int, float)) and v == v and v >= 0:
+        out["data_load_share"] = float(v)
+    spans = doc.get("spans")
+    if isinstance(spans, dict):
+        slot = (spans.get("by_name") or {}).get("data_load")
+        share = slot.get("share") if isinstance(slot, dict) else None
+        if isinstance(share, (int, float)) and share == share and share >= 0:
+            out.setdefault("data_load_share", float(share))
     return out
 
 
@@ -290,6 +321,36 @@ def mfu_target_verdict(
     ]
 
 
+def data_load_share_verdict(
+    current: Dict[str, float], report: Dict, baseline_doc: Dict
+) -> List[Dict]:
+    """Absolute-ceiling verdict for the data-plane share against the
+    published target (``data_load_share_target``, recorded by bench.py —
+    DATA_LOAD_SHARE_TARGET, 5% at the flagship tier). Same shape and
+    rationale as :func:`mfu_target_verdict`: the relative comparison alone
+    lets the loader's share ratchet up one tolerance per round."""
+    share = current.get("data_load_share")
+    target = None
+    for doc in (baseline_doc, report):
+        v = doc.get("data_load_share_target")
+        if isinstance(v, (int, float)) and v == v and v > 0:
+            target = float(v)
+            break
+    if share is None or target is None:
+        return []
+    return [
+        {
+            "metric": "data_load_share_vs_target",
+            "direction": "lower",
+            "current": share,
+            "baseline": target,
+            "limit": target,
+            "ratio": share / target,
+            "regressed": share > target,
+        }
+    ]
+
+
 def compare_span_shares(
     current: Dict[str, float], baseline: Dict[str, float], tolerance: float
 ) -> List[Dict]:
@@ -364,6 +425,7 @@ def main(argv=None) -> int:
 
     verdicts = compare(current, baseline, args.tolerance)
     verdicts.extend(mfu_target_verdict(current, report, baseline_doc))
+    verdicts.extend(data_load_share_verdict(current, report, baseline_doc))
     verdicts.extend(
         compare_span_shares(
             extract_span_shares(report),
@@ -388,6 +450,7 @@ def main(argv=None) -> int:
         tol = (
             f"tol +{args.span_tolerance:.2f} abs" if is_span
             else "absolute floor" if v["metric"] == "mfu_vs_target"
+            else "absolute ceiling" if v["metric"] == "data_load_share_vs_target"
             else f"tol {args.tolerance:.0%}"
         )
         _say(

@@ -25,6 +25,15 @@ traded for data), resume from checkpoints, and the merged report must
 carry the replan incident with a finite MTTR (``recovery_time_s``) the
 gate reads in advisory mode.
 
+A sixth phase exercises the streaming data plane: a ``loader_smoke.py``
+subprocess with the native pipeline FORCED OFF (``NDP_TPU_NO_NATIVE=1``)
+proves the numpy fallback still moves samples end to end through
+double-buffered ``device_prefetch``, then a 2-rank toy run takes a chaos
+``loader_slow_shard`` on rank 1 and the merged report's straggler
+detector must name that rank from step-time p50s alone — the
+loader-fault -> data_load span -> StragglerEvent attribution chain,
+gated (advisory) at the end.
+
 A third phase supervises a 2-rank spool-SERVING fleet
 (``tests/toy_serving_worker.py`` over the real ``serving/`` request
 lifecycle + FileSpool) into ``artifacts/toy_run_serve/``: rank 1 kills
@@ -43,6 +52,7 @@ import importlib.util
 import json
 import os
 import shutil
+import subprocess
 import sys
 import threading
 import time
@@ -657,6 +667,138 @@ def main(argv=None) -> int:
         f" {game_world} -> {game_result.world_size} on mesh"
         f" {game_result.final_mesh}, MTTR {mttr:.3f}s) at {game_dir};"
         f" report -> {game_json}\n"
+    )
+
+    # --- phase 6: the streaming data plane -------------------------------
+    # 6a: the loader smoke, native pipeline FORCED OFF — CI must prove the
+    # fallback tier feeds devices even where no C++ toolchain exists (the
+    # same dataset/order/prefetch stack, one env var away from the fast
+    # path), and that the smoke's own zero-rate assertion is live
+    smoke_json = os.path.join(
+        os.path.dirname(args.json_out) or ".", "loader_smoke.json"
+    )
+    smoke_env = dict(os.environ, NDP_TPU_NO_NATIVE="1", JAX_PLATFORMS="cpu")
+    smoke = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "scripts", "loader_smoke.py"),
+            "--n", "1024", "--batch", "64", "--json-out", smoke_json,
+        ],
+        env=smoke_env, capture_output=True, text=True, cwd=REPO,
+    )
+    problems = []
+    if smoke.returncode != 0:
+        problems.append(
+            f"loader smoke exited {smoke.returncode}:"
+            f" {smoke.stderr.strip()[-200:]}"
+        )
+    else:
+        try:
+            with open(smoke_json) as f:
+                smoke_doc = json.load(f)
+        except (OSError, ValueError) as exc:
+            smoke_doc = {}
+            problems.append(f"loader smoke wrote no JSON: {exc}")
+        if smoke_doc:
+            if smoke_doc.get("native"):
+                problems.append(
+                    "loader smoke ran the native tier despite"
+                    " NDP_TPU_NO_NATIVE=1 — the fallback path is untested"
+                )
+            if not (smoke_doc.get("samples_per_s") or 0) > 0:
+                problems.append(
+                    f"fallback loader rate not positive:"
+                    f" {smoke_doc.get('samples_per_s')!r}"
+                )
+    if problems:
+        for prob in problems:
+            sys.stderr.write(f"# run_probe: FAIL: {prob}\n")
+        return 1
+
+    # 6b: a slow data shard must surface as a STRAGGLER, end to end — the
+    # chaos loader_slow_shard window delays every batch on rank 1, the
+    # delay lands inside the step's data_load span, and the merged
+    # report's detector must name rank 1 from cross-rank p50s alone
+    loader_dir = run_dir + "_loader"
+    shutil.rmtree(loader_dir, ignore_errors=True)
+    os.makedirs(loader_dir, exist_ok=True)
+    loader_steps = 12
+    loader_plan = os.path.join(loader_dir, "chaos_plan.json")
+    ChaosPlan([
+        FaultSpec(
+            kind="loader_slow_shard", step=2, rank=1,
+            # window outlasts the run: every remaining step on rank 1 pays
+            # the delay, so its steady-state p50 sits ~9x the peer's
+            payload={"delay_s": 0.08, "batches": 999},
+        )
+    ]).save(loader_plan)
+
+    def loader_argv_for_rank(rank, world_size, incarnation):
+        return [
+            sys.executable, worker,
+            "--rank", str(rank),
+            "--world", str(world_size),
+            "--steps", str(loader_steps),
+            "--state-dir", os.path.join(loader_dir, "state"),
+            "--result-dir", os.path.join(loader_dir, "results"),
+            "--step-seconds", str(args.step_seconds),
+            "--chaos-plan", loader_plan,
+        ]
+
+    loader_telemetry = telemetry_for_run(
+        event_log=os.path.join(loader_dir, SUPERVISOR_LOG), stdout=False
+    )
+    loader_result = Supervisor(
+        argv_for_rank=loader_argv_for_rank,
+        world_size=args.world,
+        config=SupervisorConfig(
+            max_restarts=1, backoff_base_s=0.05, poll_interval_s=0.05
+        ),
+        telemetry=loader_telemetry,
+        run_dir=loader_dir,
+    ).run()
+    loader_telemetry.close()
+    if not loader_result.success:
+        sys.stderr.write(
+            f"# run_probe: FAIL: loader-fault run failed: {loader_result}\n"
+        )
+        return 1
+
+    loader_json = os.path.join(
+        os.path.dirname(args.json_out) or ".", "loader_report.json"
+    )
+    rc = report.main(["--run-dir", loader_dir, "--json-out", loader_json])
+    if rc != 0:
+        return rc
+    with open(loader_json) as f:
+        loader_report = json.load(f)
+    stragglers = loader_report.get("stragglers") or []
+    flagged = sorted({s.get("rank") for s in stragglers})
+    if 1 not in flagged:
+        problems.append(
+            f"loader_slow_shard on rank 1 never surfaced as a straggler"
+            f" (flagged ranks: {flagged})"
+        )
+    data_load = (
+        (loader_report.get("spans") or {}).get("by_name") or {}
+    ).get("data_load")
+    if not data_load:
+        problems.append(
+            "no data_load span aggregate in the merged report — the fault"
+            " delay landed outside the loader span"
+        )
+    if problems:
+        for prob in problems:
+            sys.stderr.write(f"# run_probe: FAIL: {prob}\n")
+        return 1
+
+    # advisory gate over the loader-fault report: proves the data_load
+    # span share is extractable as the gate's lower-is-better metric
+    gate.main(["--report", loader_json, "--advisory", "--root", REPO])
+    sys.stderr.write(
+        f"# run_probe: data plane ok (fallback smoke"
+        f" {smoke_doc.get('samples_per_s'):,.0f} samples/s; slow shard on"
+        f" rank 1 flagged {stragglers[0].get('factor'):.2f}x median) at"
+        f" {loader_dir}; report -> {loader_json}\n"
     )
     return 0
 

@@ -193,7 +193,9 @@ def test_wire_accounting_hlo_exact(devices):
     )
     state = diloco.init_state(params)
     batches = _stack(batch, h)
-    hlo = compiled_hlo_text(diloco.fn, state, batches)
+    hlo = compiled_hlo_text(
+        diloco.fn, state, batches, jnp.ones((h,), jnp.float32)
+    )
     audit = collective_summary(hlo)
     # the loss pmean sits inside the scan body: audited once, executed H
     # times (see CompiledLocalSGD.bits_per_round docstring)
@@ -205,3 +207,74 @@ def test_wire_accounting_hlo_exact(devices):
         loss_fn, params, 0.05, sync_every=h, mesh=mesh, donate_state=False
     )
     assert diloco.bits_per_round < local.bits_per_round
+
+
+def test_padded_partial_round_equals_shorter_round(devices):
+    """Pad-and-mask contract: a sync_every=4 round fed 3 real batches plus
+    one zero-weighted pad slot must land on the SAME parameters as a
+    sync_every=3 compiled round on the real batches alone — the mask turns
+    the pad slot into a carry no-op, so no recompile and no dropped or
+    phantom inner steps. Pad CONTENT must be irrelevant (zeros, garbage,
+    even NaN — jnp.where is a select, not a blend)."""
+    params, loss_fn, batch = _problem()
+    mesh = make_mesh()
+    reducer_args = dict(
+        inner_learning_rate=0.05, mesh=mesh, donate_state=False,
+    )
+    padded = make_diloco_train_fn(
+        loss_fn, params, sync_every=4, **reducer_args
+    )
+    short = make_diloco_train_fn(
+        loss_fn, params, sync_every=3, **reducer_args
+    )
+    real = _stack(batch, 3)
+
+    def pad_with(filler):
+        return tuple(
+            jnp.concatenate([r, filler(r[:1])], axis=0) for r in real
+        )
+
+    w = jnp.asarray([1.0, 1.0, 1.0, 0.0], jnp.float32)
+    zero_state, zero_losses = padded(
+        padded.init_state(params), pad_with(jnp.zeros_like), w
+    )
+    nan_state, nan_losses = padded(
+        padded.init_state(params), pad_with(lambda r: jnp.full_like(r, jnp.nan)), w
+    )
+    short_state, short_losses = short(short.init_state(params), real)
+
+    np.testing.assert_array_equal(
+        np.asarray(zero_state.params["w"]), np.asarray(nan_state.params["w"])
+    )
+    assert np.all(np.isfinite(np.asarray(nan_state.params["w"])))
+    np.testing.assert_allclose(
+        np.asarray(zero_state.params["w"]),
+        np.asarray(short_state.params["w"]),
+        rtol=1e-6, atol=1e-8,
+    )
+    # masked slot reports exactly 0.0 loss; real slots match the short run
+    np.testing.assert_allclose(
+        np.asarray(zero_losses[:3]), np.asarray(short_losses), rtol=1e-6
+    )
+    assert float(zero_losses[3]) == 0.0 and float(nan_losses[3]) == 0.0
+
+
+def test_all_ones_weights_bitwise_legacy(devices):
+    """The default all-ones mask must be bitwise-neutral: calling with and
+    without explicit weights produces identical parameters (the select is
+    the identity when every weight is 1)."""
+    params, loss_fn, batch = _problem()
+    mesh = make_mesh()
+    h = 4
+    diloco = make_diloco_train_fn(
+        loss_fn, params, inner_learning_rate=0.05, sync_every=h, mesh=mesh,
+        donate_state=False,
+    )
+    batches = _stack(batch, h)
+    a, _ = diloco(diloco.init_state(params), batches)
+    b, _ = diloco(
+        diloco.init_state(params), batches, jnp.ones((h,), jnp.float32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.params["w"]), np.asarray(b.params["w"])
+    )

@@ -36,3 +36,41 @@ def test_diloco_cifar10_streaming(devices):
     assert out["experiment"] == "diloco_cifar10"
     assert out["fragments"] == 2
     assert np.isfinite(out["final_loss"])
+
+
+@pytest.mark.slow
+def test_trailing_partial_round_pads_not_drops(devices, tmp_path, monkeypatch):
+    """A dataset that exhausts mid-round must still train every sample: the
+    trailing partial round is padded to sync_every with zero-weighted
+    batches and synced, so the clean path's data-drop tally is exactly
+    zero and the padded round is logged as a real step."""
+    import json
+
+    import numpy as np
+
+    from network_distributed_pytorch_tpu.experiments import diloco_cifar10
+    from network_distributed_pytorch_tpu.utils.config import ExperimentConfig
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(448, 32, 32, 3).astype(np.float32)  # 7 batches of 64
+    y = rng.randint(0, 10, size=(448,)).astype(np.int32)
+    monkeypatch.setattr(
+        diloco_cifar10, "load_cifar10_or_synthetic",
+        lambda data_dir, train=True: (x, y, False),
+    )
+    log = tmp_path / "events.jsonl"
+    cfg = ExperimentConfig(
+        training_epochs=1, global_batch_size=64, log_every=0,
+        event_log=str(log),
+    )
+    out = diloco_cifar10.run(
+        config=cfg, preset="small", data_dir="/nonexistent",
+        sync_every=4, inner_learning_rate=0.05,
+    )
+    # 7 batches at sync_every=4: one full round + one padded (3 real + 1
+    # pad) round — both logged, nothing dropped
+    assert out["steps"] == 2, out
+    assert np.isfinite(out["final_loss"])
+    events = [json.loads(l) for l in log.read_text().splitlines() if l.strip()]
+    drops = [e for e in events if e.get("kind") == "data_drop"]
+    assert drops == [], drops

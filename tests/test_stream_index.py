@@ -1,0 +1,294 @@
+"""The streamed elastic index: bijectivity of the Feistel permutation,
+residue-ownership tiling (the zero-drop/zero-dup argument), mid-shard
+resume across a world reshape, a billion-index windowed property check
+(nothing materialized), and a SIGKILL-mid-shard subprocess resume whose
+committed sample multiset must equal an uninterrupted run's.
+
+Deliberately jax-free: ``data/partition.py`` is loaded by path, so these
+property tests (and the kill/resume subprocess) cost interpreter startup,
+not a backend init."""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PARTITION = os.path.join(
+    REPO, "network_distributed_pytorch_tpu", "data", "partition.py"
+)
+
+
+def _load_partition():
+    spec = importlib.util.spec_from_file_location("_stream_pt", _PARTITION)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+pt = _load_partition()
+
+
+# ---------------------------------------------------------------------------
+# the permutation
+# ---------------------------------------------------------------------------
+
+
+def test_streamed_permutation_is_bijection():
+    """apply over the full domain is a permutation of range(n) — including
+    awkward sizes (1, powers of two, one past a power of two) — and
+    invert is its exact inverse."""
+    for n in (1, 2, 3, 7, 64, 65, 1000, 4097):
+        perm = pt.StreamedPermutation(n, seed=5)
+        offs = np.arange(n, dtype=np.int64)
+        idx = perm.apply(offs)
+        assert sorted(idx.tolist()) == list(range(n)), n
+        np.testing.assert_array_equal(perm.invert(idx), offs)
+    with pytest.raises(ValueError):
+        pt.StreamedPermutation(0)
+    with pytest.raises(ValueError):
+        pt.StreamedPermutation(10).apply(np.array([10]))
+
+
+def test_streamed_permutation_deterministic_and_keyed():
+    """Same (seed, n) twice -> identical order across instances (the
+    cross-incarnation contract); a different seed must actually re-key."""
+    a = pt.StreamedPermutation(501, seed=9).apply(np.arange(501))
+    b = pt.StreamedPermutation(501, seed=9).apply(np.arange(501))
+    c = pt.StreamedPermutation(501, seed=10).apply(np.arange(501))
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+
+
+def test_billion_index_windowed_property():
+    """The acceptance property at scale: a 10^9-element stream, never
+    materialized. Windows at the head, the middle, the tail, and across
+    the epoch boundary must round-trip through invert, stay in range,
+    and be duplicate-free within an epoch."""
+    n = 1_000_000_000
+    stream = pt.ElasticIndexStream(n, seed=3)
+    perm = stream._perm(0)
+    assert perm.domain <= 4 * n  # cycle-walk cost bound
+    k = 100_000
+    for start in (0, n // 2, n - k):
+        offs = np.arange(start, start + k, dtype=np.int64)
+        idx = perm.apply(offs)
+        assert idx.min() >= 0 and idx.max() < n
+        assert len(np.unique(idx)) == k  # injective on the window
+        np.testing.assert_array_equal(perm.invert(idx), offs)
+    # the epoch seam: positions straddling n re-key to epoch 1's
+    # permutation and stay in range on both sides
+    seam = np.arange(n - 50, n + 50, dtype=np.int64)
+    idx = stream.indices_at(seam)
+    assert idx.min() >= 0 and idx.max() < n
+    assert len(np.unique(idx[:50])) == 50 and len(np.unique(idx[50:])) == 50
+    assert not np.array_equal(
+        stream.indices_at(np.arange(50)),
+        stream.indices_at(n + np.arange(50)),
+    )  # epochs reshuffle
+
+
+# ---------------------------------------------------------------------------
+# residue ownership: the zero-drop/zero-dup tiling
+# ---------------------------------------------------------------------------
+
+
+def _owned(cursor, group, world, rank):
+    """Rank's share of the window [cursor, cursor+group) by residue."""
+    want = [p for p in range(cursor, cursor + group) if p % world == rank]
+    return np.asarray(want, dtype=np.int64)
+
+
+def test_residue_windows_tile_exactly():
+    """For ANY (cursor, window, W): the union of per-rank position sets is
+    exactly [cursor, cursor+window), disjointly — the invariant that makes
+    a reshape a no-op. shard_positions must agree with the residue spec."""
+    for cursor in (0, 1, 7, 103):
+        for world in (1, 2, 3, 5, 8):
+            for group in (1, 4, 5, 17):
+                stream = pt.ElasticIndexStream(997, seed=1)
+                got = []
+                for rank in range(world):
+                    want = _owned(cursor, group, world, rank)
+                    have = stream.shard_positions(
+                        cursor, world, rank, len(want)
+                    )
+                    np.testing.assert_array_equal(have, want)
+                    got.extend(have.tolist())
+                assert sorted(got) == list(range(cursor, cursor + group))
+    with pytest.raises(ValueError):
+        pt.ElasticIndexStream(10).shard_positions(0, 2, 2, 1)
+
+
+def test_streamed_elastic_assignments_non_divisible():
+    """The elastic_assignments-shaped entry point on a non-divisible
+    dataset: per-rank shares are disjoint, in range, and identical in
+    SIZE across ranks (count = n // W, stream semantics — the remainder
+    stays in the stream for the next window, it is never dropped)."""
+    n, world = 103, 4
+    shards = pt.streamed_elastic_assignments(n, world, seed=2)
+    assert [len(s) for s in shards] == [n // world] * world
+    flat = np.concatenate(shards)
+    assert len(np.unique(flat)) == len(flat)
+    assert flat.min() >= 0 and flat.max() < n
+    # the remainder positions [100, 103) belong to the NEXT window: a
+    # follow-up read at cursor=100 hands them out, no index lost
+    stream = pt.ElasticIndexStream(n, seed=2)
+    consumed = world * (n // world)
+    rest = np.concatenate([
+        stream.shard_indices(consumed, world, r, 1) for r in range(world)
+    ])[: n - consumed]
+    full = set(flat.tolist()) | set(rest.tolist())
+    assert full == set(stream.indices_at(np.arange(n)).tolist())
+
+
+def test_midshard_resume_after_2x2_to_2x1_reshape():
+    """A 4-rank (2x2) world consumes to a cursor that divides NEITHER
+    world size, reshapes to 2 ranks (2x1), and finishes the window. The
+    combined multiset must equal the uninterrupted single-world read —
+    zero drop, zero dup, no migration step in between."""
+    n = 211
+    stream = pt.ElasticIndexStream(n, seed=11)
+    target = 2 * n + 17  # spans two epoch seams, ends mid-epoch
+    cut = 93  # 93 % 4 == 1 and 93 % 2 == 1: genuinely mid-shard
+    before = np.concatenate([
+        stream.shard_indices(0, 4, r, len(_owned(0, cut, 4, r)))
+        for r in range(4)
+    ])
+    after = np.concatenate([
+        stream.shard_indices(
+            cut, 2, r, len(_owned(cut, target - cut, 2, r))
+        )
+        for r in range(2)
+    ])
+    resharded = np.sort(np.concatenate([before, after]))
+    straight = np.sort(stream.indices_at(np.arange(target)))
+    np.testing.assert_array_equal(resharded, straight)
+
+
+def test_state_roundtrip_and_schema_guard():
+    stream = pt.ElasticIndexStream(4242, seed=6)
+    doc = json.loads(json.dumps(stream.state(cursor=777)))
+    back, cursor = pt.ElasticIndexStream.from_state(doc)
+    assert cursor == 777
+    assert (back.data_len, back.seed) == (4242, 6)
+    np.testing.assert_array_equal(
+        back.indices_at(np.arange(100)), stream.indices_at(np.arange(100))
+    )
+    with pytest.raises(ValueError):
+        pt.ElasticIndexStream.from_state({**doc, "kind": "bogus"})
+    with pytest.raises(ValueError):
+        pt.ElasticIndexStream.from_state({**doc, "schema": 99})
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL mid-shard, resume at a different world size
+# ---------------------------------------------------------------------------
+
+_WORKER = r"""
+import importlib.util, json, os, sys, time
+
+part_path, run_dir, world, group, target = sys.argv[1:6]
+world, group, target = int(world), int(group), int(target)
+spec = importlib.util.spec_from_file_location("p", part_path)
+p = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(p)
+
+state_path = os.path.join(run_dir, "loader_state.json")
+log_path = os.path.join(run_dir, "consumed.jsonl")
+if os.path.exists(state_path):
+    with open(state_path) as f:
+        stream, cursor = p.ElasticIndexStream.from_state(json.load(f))
+else:
+    stream, cursor = p.ElasticIndexStream(211, seed=11), 0
+
+log = open(log_path, "a")
+while cursor < target:
+    group_now = min(group, target - cursor)
+    indices = []
+    for rank in range(world):
+        count = len([
+            q for q in range(cursor, cursor + group_now)
+            if q % world == rank
+        ])
+        indices.extend(
+            stream.shard_indices(cursor, world, rank, count).tolist()
+        )
+    # commit protocol: append the window's record, fsync, THEN advance the
+    # durable cursor atomically — a kill between the two re-reads the same
+    # window, and determinism makes the re-read byte-identical
+    log.write(json.dumps(
+        {"cursor": cursor, "world": world, "indices": sorted(indices)}
+    ) + "\n")
+    log.flush()
+    os.fsync(log.fileno())
+    tmp = state_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(stream.state(cursor + group_now), f)
+    os.replace(tmp, state_path)
+    cursor += group_now
+    time.sleep(0.002)  # window for the parent's mid-run SIGKILL
+"""
+
+
+def test_sigkill_midshard_resume_zero_drop(tmp_path):
+    """The acceptance test verbatim: a 4-rank consumer is SIGKILLed
+    mid-stream (cursor persisted per committed window), the run resumes
+    at world size 2 from the durable cursor, and the committed sample
+    multiset equals the uninterrupted run's exactly. Windows replayed
+    across the kill must be byte-identical (zero-dup by determinism)."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    group, target = 5, 2 * 211 + 12  # mid-shard windows, two epoch seams
+    argv = [sys.executable, str(worker), _PARTITION, str(run_dir)]
+
+    proc = subprocess.Popen(argv + ["4", str(group), str(target)])
+    state_path = run_dir / "loader_state.json"
+    deadline = time.monotonic() + 30.0
+    cursor = 0
+    while time.monotonic() < deadline:
+        try:
+            with open(state_path) as f:
+                cursor = int(json.load(f)["cursor"])
+        except (OSError, ValueError, KeyError):
+            cursor = 0
+        if cursor >= 60:
+            break
+        time.sleep(0.001)
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=30)
+    assert proc.returncode == -signal.SIGKILL
+    assert 0 < cursor < target, "kill must land mid-run"
+
+    done = subprocess.run(
+        argv + ["2", str(group), str(target)], timeout=120
+    )
+    assert done.returncode == 0
+
+    by_cursor = {}
+    with open(run_dir / "consumed.jsonl") as f:
+        for line in f:
+            rec = json.loads(line)
+            prev = by_cursor.get(rec["cursor"])
+            if prev is not None:  # the replayed window across the kill
+                assert prev["indices"] == rec["indices"], rec["cursor"]
+            by_cursor[rec["cursor"]] = rec
+    assert sorted(by_cursor) == list(range(0, target, group))
+    committed = np.sort(np.concatenate([
+        by_cursor[c]["indices"] for c in sorted(by_cursor)
+    ]))
+    straight = np.sort(
+        pt.ElasticIndexStream(211, seed=11).indices_at(np.arange(target))
+    )
+    np.testing.assert_array_equal(committed, straight)
+    # both world sizes actually ran on the shared stream
+    worlds = {rec["world"] for rec in by_cursor.values()}
+    assert worlds == {4, 2}

@@ -141,7 +141,9 @@ def test_phase_wire_audit(devices):
     )
     state = stream.init_state(params)
     for k in range(2):
-        hlo = compiled_hlo_text(stream.fns[k], state, _stack(batch, h))
+        hlo = compiled_hlo_text(
+            stream.fns[k], state, _stack(batch, h), jnp.ones((h,), jnp.float32)
+        )
         audit = collective_summary(hlo)
         audited = 8 * audit["total_payload_bytes"] + (h - 1) * LOSS_SYNC_BITS
         assert audited == stream.bits_per_phase[k], (k, audit)

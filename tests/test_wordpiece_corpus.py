@@ -1,0 +1,96 @@
+"""Corpus-side WordPiece machinery (no HF dependency): contiguous rank
+sharding reassembles to the monolithic encode, the corpus-built vocab
+covers its own corpus, and the on-disk vocab cache builds exactly once
+per (corpus, params) fingerprint."""
+
+import numpy as np
+import pytest
+
+from network_distributed_pytorch_tpu.data import (
+    WordPieceTokenizer,
+    build_vocab,
+    cached_vocab_file,
+    merge_tokenized_shards,
+    shard_rows,
+)
+from network_distributed_pytorch_tpu.data import wordpiece as wp
+
+CORPUS = [
+    "The movie was great, really great!",
+    "Terrible acting. Unbelievable?",
+    "It was good -- co-op mode was bad.",
+    "Watched it in 2024, at the cafe.",
+    "a really REALLY long review " * 8,
+    "short",
+    "punctuation!!! everywhere... and digits 123 456",
+]
+
+
+def _tok(tmp_path, max_len=32):
+    path = cached_vocab_file(CORPUS, str(tmp_path / "vocab_cache"))
+    return WordPieceTokenizer(path, max_len=max_len)
+
+
+def test_shard_rows_partition_exact():
+    """Every (n, W): shards are contiguous, balanced within one row, and
+    their rank-order concatenation is exactly range(n) — including W > n
+    (some shards empty) and non-divisible splits."""
+    for n in (0, 1, 5, 7, 64):
+        for w in (1, 2, 3, 5, 9):
+            spans = [shard_rows(n, w, r) for r in range(w)]
+            rows = [i for a, b in spans for i in range(a, b)]
+            assert rows == list(range(n)), (n, w, spans)
+            sizes = [b - a for a, b in spans]
+            assert max(sizes) - min(sizes) <= 1, (n, w, sizes)
+    with pytest.raises(ValueError):
+        shard_rows(4, 2, 2)
+    with pytest.raises(ValueError):
+        shard_rows(4, 0, 0)
+
+
+def test_encode_shard_merge_equals_monolithic(tmp_path):
+    """Rank-sharded tokenization merged in rank order must be byte-equal
+    to one process encoding the full corpus — for divisible and
+    non-divisible world sizes."""
+    tok = _tok(tmp_path)
+    full = tok(CORPUS)
+    for w in (1, 2, 3, 7):
+        shards = [tok.encode_shard(CORPUS, w, r) for r in range(w)]
+        merged = merge_tokenized_shards(shards)
+        for k in ("input_ids", "attention_mask"):
+            np.testing.assert_array_equal(merged[k], full[k])
+
+
+def test_built_vocab_covers_corpus(tmp_path):
+    """Character coverage in build_vocab: no word made of seen characters
+    ever collapses to [UNK], and every corpus row encodes non-trivially."""
+    tok = _tok(tmp_path)
+    out = tok(CORPUS)
+    assert not np.any(out["input_ids"] == tok.unk_id)
+    # every row carries [CLS] + at least one real token + [SEP]
+    assert np.all(out["attention_mask"].sum(axis=1) >= 3)
+
+
+def test_vocab_build_is_deterministic():
+    assert build_vocab(CORPUS) == build_vocab(list(CORPUS))
+    # frequency-ranked words follow specials + chars; [PAD] stays id 0
+    v = build_vocab(CORPUS)
+    assert v[0] == "[PAD]" and v[1] == "[UNK]"
+
+
+def test_vocab_cache_builds_once(tmp_path, monkeypatch):
+    """Second call with the same corpus must return the cached file WITHOUT
+    rebuilding (ranks re-tokenizing per incarnation was the startup cost);
+    a changed corpus or changed params must miss the cache."""
+    cache = str(tmp_path / "cache")
+    p1 = cached_vocab_file(CORPUS, cache)
+
+    def boom(*a, **k):  # any rebuild attempt is the regression
+        raise AssertionError("vocab rebuilt despite cache hit")
+
+    monkeypatch.setattr(wp, "build_vocab", boom)
+    assert cached_vocab_file(CORPUS, cache) == p1
+    monkeypatch.undo()
+    p2 = cached_vocab_file(CORPUS + ["new document"], cache)
+    p3 = cached_vocab_file(CORPUS, cache, max_size=4096)
+    assert len({p1, p2, p3}) == 3

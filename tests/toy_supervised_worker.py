@@ -46,6 +46,7 @@ from network_distributed_pytorch_tpu.resilience.chaos import (  # noqa: E402
     CKPT_UNWRITABLE_EXIT_CODE,
     CORRELATED_FAULTS,
     HEALTH_FAULTS,
+    LOADER_FAULTS,
     PREEMPT_EXIT_CODE,
     PROCESS_FAULTS,
     ChaosPlan,
@@ -255,6 +256,9 @@ def main() -> int:
 
         signal.signal(signal.SIGTERM, _on_term)
 
+    # open loader timing-fault window (see the data_load injection below)
+    loader_slow = {"left": 0, "total": 0, "delay_s": 0.0, "ramp": False}
+
     with recording(telemetry):
         while state["step"] < args.steps:
             i = state["step"]
@@ -298,10 +302,32 @@ def main() -> int:
                             rank=args.rank, step=i, incarnation=incarnation,
                         )
                     )
+            # loader timing faults (loader_slow_shard / loader_skewed_shard):
+            # the toy data plane is a sleep, but the CONTRACT is the real
+            # one — the delay lands inside the step's data_load span, the
+            # step time absorbs it, and the merged report's straggler
+            # detector must name this rank from p50s alone (run_probe
+            # phase 6 asserts exactly that, jax-free)
+            spec = plan.pop(LOADER_FAULTS, i, args.rank, incarnation)
+            if spec is not None and spec.kind in (
+                "loader_slow_shard", "loader_skewed_shard"
+            ):
+                loader_slow["left"] = max(1, int(spec.payload.get("batches", 8)))
+                loader_slow["total"] = loader_slow["left"]
+                loader_slow["delay_s"] = float(spec.payload.get("delay_s", 0.05))
+                loader_slow["ramp"] = spec.kind == "loader_skewed_shard"
             t0 = time.monotonic()
             # nested spans, toy-sized like the real loop's: the trace export
             # e2e asserts this parent/child structure survives the merge
             with span("step", step=i, rank=args.rank):
+                if loader_slow["left"] > 0:
+                    k = loader_slow["total"] - loader_slow["left"]
+                    delay = loader_slow["delay_s"]
+                    if loader_slow["ramp"]:
+                        delay *= (k + 1) / loader_slow["total"]
+                    loader_slow["left"] -= 1
+                    with span("data_load", step=i, rank=args.rank):
+                        time.sleep(delay)
                 with span("step/compute", step=i, rank=args.rank):
                     time.sleep(
                         args.step_seconds * (FLAP_SLOWDOWN if in_flap else 1.0)
