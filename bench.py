@@ -57,6 +57,7 @@ compilation cache (``.xla_cache/``), so any run in the same machine image
 
 from __future__ import annotations
 
+import glob
 import json
 import math
 import os
@@ -93,6 +94,12 @@ MFU_TARGETS = {"small": 0.002, "full": 0.005}
 # acceptance). gate.py reads the recorded value as a lower-is-better
 # metric AND this target as an absolute bound, mirroring mfu_target.
 DATA_LOAD_SHARE_TARGET = 0.05
+# absolute ceiling for the offline cost model's predicted-vs-realized step
+# time error (observe.costmodel; ISSUE PR 13 acceptance): the planner's
+# predictions must stay within 25% of measured on executed configs.
+# gate.py reads the recorded costmodel_error as a lower-is-better metric
+# AND this target as an absolute bound, mirroring mfu_target.
+COSTMODEL_ERROR_TARGET = 0.25
 MARKER = "@BENCH@ "
 
 
@@ -1687,6 +1694,35 @@ def _record_gate_baseline(out: dict, status: dict) -> None:
             rec["recovery_time_s"] = float(mttr)
     except (OSError, ValueError):
         pass
+    # cost-model observatory (run_probe phase 7): the planner replay
+    # reports carry predicted-vs-realized step time; record the WORST
+    # fabric's error (the bound the model must hold everywhere) plus the
+    # matching ms pair, so gate.py's lower-is-better costmodel_error and
+    # its absolute 25% ceiling both have a recorded reference
+    worst = None
+    for name in sorted(glob.glob(
+        os.path.join(HERE, "artifacts", "plan_replay_*_report.json")
+    )):
+        try:
+            with open(name) as f:
+                cm = json.load(f).get("costmodel") or {}
+        except (OSError, ValueError):
+            continue
+        err = cm.get("error")
+        if isinstance(err, (int, float)) and err >= 0 and (
+            worst is None or err > worst.get("error", -1.0)
+        ):
+            worst = cm
+    if worst is not None:
+        rec["costmodel_error"] = float(worst["error"])
+        rec["costmodel_error_target"] = COSTMODEL_ERROR_TARGET
+        for src, dst in (
+            ("predicted_step_s", "predicted_step_ms"),
+            ("realized_step_s", "realized_step_ms"),
+        ):
+            v = worst.get(src)
+            if isinstance(v, (int, float)) and v > 0:
+                rec[dst] = float(v) * 1e3
     path = os.path.join(HERE, "artifacts", "GATE_BASELINE.json")
     try:
         os.makedirs(os.path.join(HERE, "artifacts"), exist_ok=True)

@@ -159,6 +159,9 @@ def run(
                     comm_strategy=overrides.get(
                         "comm_strategy", config.comm_strategy
                     ),
+                    bucket_bytes=overrides.get(
+                        "bucket_bytes", config.bucket_bytes
+                    ),
                 )
             return make_train_step(
                 loss_fn,
@@ -267,8 +270,25 @@ def run(
                 )
                 if plan is not None else None
             )
+            # with a tuned plan (launch.py --plan), walk the ladder in the
+            # cost model's predicted-best-first order for this fabric —
+            # same controller semantics, one recompile per decision, and a
+            # stale/unreadable plan degrades to the static DEFAULT_LADDER
+            ladder = None
+            if config.plan_path:
+                import json as _json
+
+                from ..resilience import ladder_from_plan
+
+                try:
+                    with open(config.plan_path, "r", encoding="utf-8") as fh:
+                        plan_doc = _json.load(fh)
+                except (OSError, ValueError):
+                    plan_doc = None
+                if plan_doc is not None:
+                    ladder = ladder_from_plan(plan_doc, config.comm_fabric)
             controller = FallbackController(
-                telemetry=telemetry, rank=config.process_id,
+                ladder=ladder, telemetry=telemetry, rank=config.process_id,
             )
             # under a supervised run, tail the run's alerts.jsonl so the
             # live plane's detectors can nudge the controller mid-epoch

@@ -259,7 +259,16 @@ def build_parser() -> argparse.ArgumentParser:
         choices=("1GbE", "10GbE", "100GbE", "ICI(v5e)"),
         help="--adaptive-comm: fabric whose modeled line rate"
              " (utils.bandwidth.FABRICS_BYTES_PER_S) budgets the collective"
-             " deadlines (default ICI(v5e))",
+             " deadlines (default ICI(v5e)); --plan: the fabric whose"
+             " tuned best pick is applied",
+    )
+    p.add_argument(
+        "--plan", type=str, default=None,
+        help="tuned per-fabric plan file from scripts/plan.py (the offline"
+             " what-if cost model): apply its predicted-best comm knobs for"
+             " --comm-fabric (explicit CLI knobs still win), and under"
+             " --adaptive-comm reorder the fallback ladder predicted-best-"
+             "first (cifar experiments)",
     )
     # --- supervised elastic launch (resilience.supervisor) ---------------
     # these flags configure the PARENT only and are stripped from the
@@ -360,6 +369,50 @@ def build_parser() -> argparse.ArgumentParser:
              " on whenever --event-log is set)",
     )
     return p
+
+
+def apply_plan(cfg: ExperimentConfig, args) -> None:
+    """Apply a scripts/plan.py plan file's predicted-best comm knobs for
+    the launch fabric onto ``cfg``. Explicit CLI knobs win over the plan;
+    the plan wins over the dataclass defaults. A plan naming a different
+    reducer family than the launched experiment only warns — the
+    experiment choice stays the user's (under --adaptive-comm the
+    reordered fallback ladder can still walk to the compressed rung)."""
+    import json
+
+    from .observe import costmodel
+
+    with open(args.plan, "r", encoding="utf-8") as fh:
+        plan = json.load(fh)
+    fabric = args.comm_fabric or cfg.comm_fabric
+    slot = (plan.get("fabrics") or {}).get(fabric)
+    if not isinstance(slot, dict):
+        sys.stderr.write(
+            f"# launch: plan {args.plan} has no fabric {fabric!r};"
+            " knobs unchanged\n"
+        )
+        cfg.plan_path = args.plan
+        return
+    best = costmodel.canonical_config((slot.get("best") or {}).get("config"))
+    if args.comm_chunks is None and best["comm_chunks"]:
+        cfg.comm_chunks = best["comm_chunks"]
+    if args.comm_strategy is None:
+        cfg.comm_strategy = best["comm_strategy"]
+    if args.bucket_bytes is None and best["bucket_bytes"]:
+        cfg.bucket_bytes = best["bucket_bytes"]
+    if args.reducer_rank is None and best["reducer_rank"]:
+        cfg.reducer_rank = best["reducer_rank"]
+    plan_reducer = best["reducer"]
+    exp_reducer = (
+        "powersgd" if "powersgd" in args.experiment else "exact"
+    )
+    if plan_reducer != exp_reducer:
+        sys.stderr.write(
+            f"# launch: plan's best pick for {fabric} uses the"
+            f" {plan_reducer!r} reducer but {args.experiment!r} runs"
+            f" {exp_reducer!r} — comm knobs applied, reducer unchanged\n"
+        )
+    cfg.plan_path = args.plan
 
 
 def config_from_args(args) -> ExperimentConfig:
@@ -569,6 +622,13 @@ def main(argv=None) -> dict:
         if not args.event_log:
             args.event_log = _runlog.shard_path(args.run_dir, args.process_id)
     cfg = config_from_args(args)
+    if args.plan is not None:
+        if args.experiment not in ("exact_cifar10", "powersgd_cifar10"):
+            raise ValueError(
+                f"--plan is not supported by {args.experiment!r}"
+                " (supported: exact_cifar10, powersgd_cifar10)"
+            )
+        apply_plan(cfg, args)
 
     # reject silently-ignored flags BEFORE any rendezvous: a pure-CLI error
     # must not burn a multi-host allocation on a doomed jax.distributed join
@@ -600,8 +660,12 @@ def main(argv=None) -> dict:
             f"--adaptive-comm is not supported by {args.experiment!r}"
             " (supported: exact_cifar10)"
         )
-    if args.comm_fabric is not None and not cfg.adaptive_comm:
-        raise ValueError("--comm-fabric requires --adaptive-comm")
+    if (
+        args.comm_fabric is not None
+        and not cfg.adaptive_comm
+        and args.plan is None
+    ):
+        raise ValueError("--comm-fabric requires --adaptive-comm or --plan")
     if args.remat and args.experiment not in _REMAT_OK:
         raise ValueError(
             f"--remat is not supported by {args.experiment!r}"

@@ -53,7 +53,7 @@ Everything imported here is jax-free, so the bench parent orchestrator
 (which deliberately imports no jax) can use the same sinks.
 """
 
-from . import analytics, health, live, mfu, runlog, spans  # noqa: F401
+from . import analytics, costmodel, health, live, mfu, runlog, spans  # noqa: F401
 from .events import (  # noqa: F401
     SCHEMA_VERSION,
     AlertEvent,
@@ -68,6 +68,7 @@ from .events import (  # noqa: F401
     MfuEvent,
     NoteEvent,
     PolicyEvent,
+    PredictionEvent,
     RawEvent,
     RequestEvent,
     ReshapeEvent,

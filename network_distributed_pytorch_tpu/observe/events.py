@@ -151,6 +151,12 @@ class CompileEvent(Event):
     flops_source: Optional[str] = None
     device_kind: Optional[str] = None
     peak_flops_per_s: Optional[float] = None
+    # the comm knobs the step was compiled with (``reducer``,
+    # ``reducer_rank``, ``comm_chunks``, ``comm_strategy``,
+    # ``bucket_bytes``) — what lets the offline cost model
+    # (:mod:`observe.costmodel`) identify WHICH config a run executed and
+    # join its predictions against the measured step time
+    comm_config: Dict = field(default_factory=dict)
 
     def banner(self) -> str:
         tail = "byte-exact" if self.exact else f"delta {self.delta_bytes:+d} B"
@@ -360,6 +366,41 @@ class PolicyEvent(Event):
     rung_index_after: int
     overrides: Dict = field(default_factory=dict)
     predicted_bytes_per_step: Optional[float] = None
+    realized_bytes_per_step: Optional[float] = None
+    rank: Optional[int] = None
+
+    def banner(self) -> str:
+        rec = {k: v for k, v in self.record().items() if v is not None}
+        return json.dumps(rec, default=str)
+
+
+@dataclass
+class PredictionEvent(Event):
+    """One what-if prediction of the offline analytic cost model
+    (:mod:`observe.costmodel`): for a named comm config on a named fabric,
+    the predicted step time and wire bytes with the per-component
+    breakdown (compute, exposed comm, collective latency, compression
+    compute) it was assembled from. ``config_key`` is the canonical
+    config string predictions and realized runs join on — when the config
+    is later actually executed, ``scripts/report.py`` fills
+    ``realized_step_s``/``realized_bytes_per_step`` and the relative
+    error becomes the gate's ``costmodel_error`` metric, extending
+    :class:`PolicyEvent`'s bytes calibration to time. The banner is the
+    record as JSON, like :class:`PolicyEvent`."""
+
+    KIND: ClassVar[str] = "prediction"
+
+    fabric: str
+    config_key: str
+    config: Dict = field(default_factory=dict)
+    predicted_step_s: Optional[float] = None
+    predicted_bytes_per_step: Optional[float] = None
+    compute_s: Optional[float] = None
+    exposed_comm_s: Optional[float] = None
+    latency_s: Optional[float] = None
+    compress_s: Optional[float] = None
+    source_run: str = ""
+    realized_step_s: Optional[float] = None
     realized_bytes_per_step: Optional[float] = None
     rank: Optional[int] = None
 

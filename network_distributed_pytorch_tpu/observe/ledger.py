@@ -329,6 +329,9 @@ def audit_compiled_step(step, *args, label: str = "train_step", telemetry=None) 
         ),
         compression_ratio=ledger.compression_ratio(),
         overlap=_overlap_extract(overlap_report(hlo_text)),
+        # which comm config this step compiled with (parallel.trainer
+        # stamps it on CompiledStep) — the offline cost model's join key
+        comm_config=dict(getattr(step, "comm_config", None) or {}),
         **device_cost_fields(
             compiled, getattr(step, "flops_per_step", None)
         ),

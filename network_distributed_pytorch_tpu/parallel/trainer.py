@@ -28,7 +28,7 @@ unfinished ``bits_communicated`` loop (SURVEY C9: collected, never reported).
 from __future__ import annotations
 
 
-from typing import Any, Callable, NamedTuple, Optional, Tuple
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -392,6 +392,10 @@ class CompiledStep(NamedTuple):
     optimizer: Any = None
     ledger: Any = None
     health_fn: Optional[Callable[[TrainState, Any], Any]] = None
+    # the comm knobs this step compiled with (reducer_comm_config) —
+    # stamped into the audit's CompileEvent so the offline cost model
+    # (observe.costmodel) can identify WHICH config a run executed
+    comm_config: Optional[Dict] = None
 
     def __call__(self, state, batch):
         return self.fn(state, batch)
@@ -509,6 +513,7 @@ def make_scanned_train_fn(
         health_fn=make_health_fn(
             loss_fn, reducer, mesh, axis_name, accum_steps
         ),
+        comm_config=reducer_comm_config(reducer),
     )
 
 
@@ -638,6 +643,24 @@ def _step_ledger(
     )
 
 
+def reducer_comm_config(reducer) -> Dict:
+    """The comm knobs a reducer was constructed with, read back off the
+    instance: what :mod:`observe.costmodel` joins plan predictions against
+    (via the ``CompileEvent.comm_config`` plumbing). Knobs a reducer does
+    not carry are simply absent — the cost model canonicalizes."""
+    cfg: Dict = {"reducer": type(reducer).__name__.lower()}
+    for attr, key in (
+        ("compression_rank", "reducer_rank"),
+        ("comm_chunks", "comm_chunks"),
+        ("comm_strategy", "comm_strategy"),
+        ("bucket_bytes", "bucket_bytes"),
+    ):
+        v = getattr(reducer, attr, None)
+        if v is not None:
+            cfg[key] = v
+    return cfg
+
+
 def make_train_step(
     loss_fn: LossFn,
     reducer,
@@ -685,6 +708,7 @@ def make_train_step(
             health_fn=make_health_fn(
                 loss_fn, reducer, None, axis_name, accum_steps
             ),
+            comm_config=reducer_comm_config(reducer),
         )
 
     body = make_step_fn(
@@ -737,4 +761,5 @@ def make_train_step(
         health_fn=make_health_fn(
             loss_fn, reducer, mesh, axis_name, accum_steps
         ),
+        comm_config=reducer_comm_config(reducer),
     )

@@ -76,6 +76,7 @@ from .controller import (  # noqa: F401
     FallbackController,
     PolicyDecision,
     Rung,
+    ladder_from_plan,
 )
 from .guards import (  # noqa: F401
     CheckpointUnwritableError,

@@ -44,6 +44,7 @@ __all__ = [
     "EpochHealth",
     "PolicyDecision",
     "FallbackController",
+    "ladder_from_plan",
 ]
 
 
@@ -74,6 +75,37 @@ DEFAULT_LADDER: List[Rung] = [
         {"reducer": "powersgd", "reducer_rank": 1, "sync_every": 8},
     ),
 ]
+
+
+def ladder_from_plan(
+    plan: Dict,
+    fabric: str,
+    ladder: Optional[List[Rung]] = None,
+    max_rungs: Optional[int] = None,
+) -> List[Rung]:
+    """Planner-ordered fallback ladder: reorder ``ladder`` (default
+    :data:`DEFAULT_LADDER`) so rungs come predicted-best-first per the
+    ``scripts/plan.py`` plan document's per-fabric rung ranking
+    (``plan["ladder"][fabric]``, cheapest predicted step first).
+
+    The controller's semantics are untouched — same hysteresis, one
+    recompile per decision — only the ORDER it walks changes: under a
+    planner-ordered ladder the first descent lands on the config the cost
+    model predicts cheapest for this fabric instead of blindly trying
+    chunking first. Rung names the plan does not rank keep their relative
+    order after the ranked ones (the planner can only reorder what it
+    priced); an unknown fabric or an empty ranking returns the ladder
+    unchanged, so a stale plan can never brick a launch. ``max_rungs``
+    optionally prunes the reordered ladder to its first N rungs."""
+    base = list(DEFAULT_LADDER if ladder is None else ladder)
+    names = [str(n) for n in (plan.get("ladder") or {}).get(fabric) or []]
+    by_name = {r.name: r for r in base}
+    ordered = [by_name[n] for n in names if n in by_name]
+    seen = {r.name for r in ordered}
+    ordered.extend(r for r in base if r.name not in seen)
+    if max_rungs is not None and max_rungs > 0:
+        ordered = ordered[:max_rungs]
+    return ordered
 
 
 @dataclass

@@ -96,3 +96,9 @@ class ExperimentConfig:
     # deadline budget (utils.bandwidth keys: "1GbE", "10GbE", "100GbE",
     # "ICI(v5e)")
     comm_fabric: str = "ICI(v5e)"
+    # tuned per-fabric plan file from scripts/plan.py (``launch.py --plan``):
+    # its best-pick knobs for ``comm_fabric`` are applied at launch, and
+    # under adaptive_comm the fallback ladder is reordered predicted-best-
+    # first (resilience.controller.ladder_from_plan). None = hand-set knobs
+    # and the static DEFAULT_LADDER order.
+    plan_path: Optional[str] = None

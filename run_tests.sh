@@ -58,7 +58,11 @@ set -e
 # MTTR gated as recovery_time_s. The sixth phase is the data plane: the
 # loader-throughput smoke with the native pipeline forced off, plus a
 # chaos loader_slow_shard that must surface as a straggler verdict in
-# the merged report. Advisory because shared CI boxes have
+# the merged report. The seventh phase is the what-if planner: simulated-
+# fabric toy runs calibrate scripts/plan.py's offline cost model, the
+# predicted-best config must beat the measured default when replayed, and
+# the gate reads the model's own costmodel_error against its 25% ceiling.
+# Advisory because shared CI boxes have
 # noisy step times; run gate.py without --advisory on dedicated perf
 # hardware to make it blocking.
 python scripts/run_probe.py || true

@@ -32,6 +32,16 @@ Metrics compared (only those present in BOTH report and baseline):
 - ``data_load_share``        lower is better (fraction of the step loop
   blocked on data; also gated against the ABSOLUTE
   ``data_load_share_target`` ceiling bench.py records — 5% flagship)
+- ``costmodel_error``        lower is better (the what-if planner's
+  relative predicted-vs-realized step-time error on an executed config,
+  from ``report.py --plan``; also gated against the ABSOLUTE
+  ``costmodel_error_target`` ceiling, default 25 % — the calibration
+  bound DESIGN.md states for cost-model predictions)
+
+A metric the current report carries but a stale baseline does not gets a
+clearly-labeled ``missing_baseline`` ADVISORY verdict (never a
+regression): adding a gate metric must never brick CI on an older
+``GATE_BASELINE.json``.
 
 Span time shares (report ``spans.by_name[*].share``) are compared
 separately when both sides carry them: a span name whose share of run
@@ -95,7 +105,20 @@ METRICS: Dict[str, str] = {
     # ABSOLUTE ceiling (``data_load_share_target``) backstops the
     # relative comparison exactly as mfu_target does for MFU
     "data_load_share": "lower",
+    # the cost model's own calibration error (report ``costmodel.error``:
+    # relative predicted-vs-realized step time on an executed config,
+    # scripts/plan.py + report.py --plan) — the what-if planner is only
+    # trustworthy while this stays small, so the MODEL is regression-gated
+    # like any other metric. Zero is the healthy value (0 records), and
+    # the ABSOLUTE ceiling ``costmodel_error_target`` (default
+    # DEFAULT_COSTMODEL_ERROR_TARGET) backstops the relative comparison
+    "costmodel_error": "lower",
 }
+
+# the calibration bound DESIGN.md states for cost-model predictions: a
+# prediction whose realized counterpart disagrees by more than this is a
+# gate regression even with no recorded baseline to ratchet against
+DEFAULT_COSTMODEL_ERROR_TARGET = 0.25
 
 BASELINE_NAME = "GATE_BASELINE.json"
 
@@ -165,6 +188,17 @@ def extract_metrics(doc: Dict) -> Dict[str, float]:
         share = slot.get("share") if isinstance(slot, dict) else None
         if isinstance(share, (int, float)) and share == share and share >= 0:
             out.setdefault("data_load_share", float(share))
+    # cost-model calibration error: nested under the report's "costmodel"
+    # section (report.py --plan), flat in bench baselines. Zero (a perfect
+    # prediction) is the healthy value, so >= 0 records
+    cm = doc.get("costmodel")
+    if isinstance(cm, dict):
+        v = cm.get("error")
+        if isinstance(v, (int, float)) and v == v and v >= 0:
+            out["costmodel_error"] = float(v)
+    v = doc.get("costmodel_error")
+    if isinstance(v, (int, float)) and v == v and v >= 0:
+        out.setdefault("costmodel_error", float(v))
     return out
 
 
@@ -262,10 +296,30 @@ def resolve_baseline(
 def compare(
     current: Dict[str, float], baseline: Dict[str, float], tolerance: float
 ) -> List[Dict]:
-    """Per-metric verdicts for metrics present on both sides."""
+    """Per-metric verdicts. Metrics present on both sides get the real
+    relative comparison; a metric the CURRENT report carries but the
+    (older, stale) baseline does not gets a clearly-labeled
+    ``missing_baseline`` advisory verdict — never a regression, never a
+    KeyError — so adding a gate metric can never brick CI until a fresh
+    baseline records it. Metrics only the baseline carries are skipped
+    silently (this run simply didn't measure them)."""
     verdicts: List[Dict] = []
     for name, direction in METRICS.items():
-        if name not in current or name not in baseline:
+        if name not in current:
+            continue
+        if name not in baseline:
+            verdicts.append(
+                {
+                    "metric": name,
+                    "direction": direction,
+                    "current": current[name],
+                    "baseline": None,
+                    "limit": None,
+                    "ratio": None,
+                    "regressed": False,
+                    "missing_baseline": True,
+                }
+            )
             continue
         cur, base = current[name], baseline[name]
         if direction == "lower":
@@ -351,6 +405,37 @@ def data_load_share_verdict(
     ]
 
 
+def costmodel_target_verdict(
+    current: Dict[str, float], report: Dict, baseline_doc: Dict
+) -> List[Dict]:
+    """Absolute-ceiling verdict for the cost model's calibration error,
+    mirroring :func:`mfu_target_verdict`. Unlike MFU's, the target has a
+    default (``DEFAULT_COSTMODEL_ERROR_TARGET``): the <= 25 % bound is part
+    of the model's stated guarantee class (DESIGN.md), not a per-tier
+    published number — so a wildly wrong prediction fails the gate even
+    before any baseline has recorded the metric."""
+    err = current.get("costmodel_error")
+    if err is None:
+        return []
+    target = DEFAULT_COSTMODEL_ERROR_TARGET
+    for doc in (baseline_doc, report):
+        v = doc.get("costmodel_error_target")
+        if isinstance(v, (int, float)) and v == v and v > 0:
+            target = float(v)
+            break
+    return [
+        {
+            "metric": "costmodel_error_vs_target",
+            "direction": "lower",
+            "current": err,
+            "baseline": target,
+            "limit": target,
+            "ratio": err / target if target else float("inf"),
+            "regressed": err > target,
+        }
+    ]
+
+
 def compare_span_shares(
     current: Dict[str, float], baseline: Dict[str, float], tolerance: float
 ) -> List[Dict]:
@@ -426,6 +511,7 @@ def main(argv=None) -> int:
     verdicts = compare(current, baseline, args.tolerance)
     verdicts.extend(mfu_target_verdict(current, report, baseline_doc))
     verdicts.extend(data_load_share_verdict(current, report, baseline_doc))
+    verdicts.extend(costmodel_target_verdict(current, report, baseline_doc))
     verdicts.extend(
         compare_span_shares(
             extract_span_shares(report),
@@ -445,12 +531,21 @@ def main(argv=None) -> int:
 
     regressions = [v for v in verdicts if v["regressed"]]
     for v in verdicts:
+        if v.get("missing_baseline"):
+            _say(
+                f"{v['metric']}: current {v['current']:.6g} has no entry in"
+                " the baseline -> missing_baseline (advisory; record a fresh"
+                " baseline to start gating it)"
+            )
+            continue
         status = "REGRESSED" if v["regressed"] else "ok"
         is_span = v["metric"].startswith("span:")
         tol = (
             f"tol +{args.span_tolerance:.2f} abs" if is_span
             else "absolute floor" if v["metric"] == "mfu_vs_target"
-            else "absolute ceiling" if v["metric"] == "data_load_share_vs_target"
+            else "absolute ceiling" if v["metric"] in (
+                "data_load_share_vs_target", "costmodel_error_vs_target"
+            )
             else f"tol {args.tolerance:.0%}"
         )
         _say(

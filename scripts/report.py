@@ -84,6 +84,16 @@ def load_events(path: str) -> List[Dict]:
     return load_events_counted(path)[0]
 
 
+def _load_plan(path: str) -> Optional[Dict]:
+    """A scripts/plan.py plan document, or None when unreadable."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) and "fabrics" in doc else None
+
+
 def percentile(values: List[float], p: float) -> float:
     """Nearest-rank percentile (exact for the small samples a run log has)."""
     if not values:
@@ -1161,10 +1171,8 @@ def run_report(
         )
         if p50s else None
     )
-    overlap = next(
-        (e.get("overlap") for e in merged.events if e.get("event") == "compile"),
-        None,
-    )
+    compile_events = [e for e in merged.events if e.get("event") == "compile"]
+    overlap = next((e.get("overlap") for e in compile_events), None)
     collectives = [e for e in merged.events if e.get("event") == "collective"]
     bandwidth = (
         analytics.effective_bandwidth(
@@ -1181,7 +1189,7 @@ def run_report(
     mfu_records = [
         ev.record()
         for ev in mfu_mod.mfu_from_compile_records(
-            [e for e in merged.events if e.get("event") == "compile"],
+            compile_events,
             step_p50,
             n_steps=n_steps,
         )
@@ -1252,6 +1260,21 @@ def run_report(
         "straggler_factor": straggler_factor,
         "stragglers": [ev.record() for ev in stragglers],
         "bandwidth": bandwidth,
+        # the wire-ledger compile extract (LAST compile event = the config
+        # the run finished on): analytic bytes, compression evidence, and
+        # the comm-config knobs the step compiled with — what the offline
+        # cost model (observe.costmodel) calibrates from and joins its
+        # predictions against
+        "compile": (
+            {
+                "analytic_bytes": compile_events[-1].get("analytic_bytes"),
+                "dense_grad_bytes": compile_events[-1].get("dense_grad_bytes"),
+                "compression_ratio": compile_events[-1].get("compression_ratio"),
+                "comm_config": compile_events[-1].get("comm_config") or {},
+                "n_compiles": len(compile_events),
+            }
+            if compile_events else None
+        ),
         # per-bucket exposed-comm attribution (DDP backward-order buckets;
         # empty when the run used a monolithic packed collective)
         "comm_buckets": comm_buckets,
@@ -1307,6 +1330,102 @@ def run_report(
         "slo": slo_summary_from_events(merged.events),
     }
     return text, report
+
+
+def _compare_metric(report: Dict, dotted: str) -> Optional[float]:
+    """Pull one (possibly nested) scalar out of a report dict."""
+    node = report
+    for part in dotted.split("."):
+        if not isinstance(node, dict):
+            return None
+        node = node.get(part)
+    return float(node) if isinstance(node, (int, float)) and node == node else None
+
+
+# what --compare diffs, in display order: (dotted key, label, formatter)
+_COMPARE_ROWS = (
+    ("step_p50_s", "step p50", lambda v: f"{v * 1e3:.2f} ms"),
+    ("step_p95_s", "step p95", lambda v: f"{v * 1e3:.2f} ms"),
+    ("bandwidth.total.payload_bytes", "bytes/step", _fmt_bytes),
+    ("bandwidth.total.achieved_bytes_per_s", "achieved bw", _fmt_rate),
+    ("mfu_headline", "MFU headline", lambda v: f"{v:.4f}"),
+    ("alerts.fired", "alerts fired", lambda v: f"{v:.0f}"),
+    ("policy.descends", "policy descends", lambda v: f"{v:.0f}"),
+    ("recovery_latency_s", "recovery latency", lambda v: f"{v:.2f} s"),
+)
+_COMPARE_TOP_SPANS = 5
+
+
+def compare_runs(
+    run_a: str, run_b: str, straggler_factor: float = 1.5
+) -> Tuple[str, Dict]:
+    """Side-by-side diff of two run directories — the manual workflow
+    behind every "did PR N help?" question, reusing the same run-dir
+    loaders as the single-run report. Returns (text, machine dict)."""
+    _, rep_a = run_report(run_a, straggler_factor=straggler_factor)
+    _, rep_b = run_report(run_b, straggler_factor=straggler_factor)
+
+    metrics: Dict[str, Dict] = {}
+    lines = [
+        "run compare",
+        f"  A: {run_a}",
+        f"  B: {run_b}",
+        "",
+        f"  {'metric':<18} {'A':>14} {'B':>14} {'B/A':>8}",
+    ]
+    for dotted, label, fmt in _COMPARE_ROWS:
+        a, b = _compare_metric(rep_a, dotted), _compare_metric(rep_b, dotted)
+        if a is None and b is None:
+            continue
+        metrics[dotted] = {
+            "a": a,
+            "b": b,
+            "ratio": (b / a) if a and b is not None else None,
+        }
+        ratio = metrics[dotted]["ratio"]
+        lines.append(
+            f"  {label:<18} {fmt(a) if a is not None else 'n/a':>14}"
+            f" {fmt(b) if b is not None else 'n/a':>14}"
+            f" {f'{ratio:.2f}x' if ratio is not None else 'n/a':>8}"
+        )
+
+    # top span shares: the union of each side's biggest time sinks, so a
+    # sink that newly appeared in B still shows up against A's 0
+    def _shares(rep: Dict) -> Dict[str, float]:
+        spans = rep.get("spans") or {}
+        out = {}
+        for name, slot in (spans.get("by_name") or {}).items():
+            share = slot.get("share") if isinstance(slot, dict) else None
+            if isinstance(share, (int, float)) and share == share:
+                out[str(name)] = float(share)
+        return out
+
+    sh_a, sh_b = _shares(rep_a), _shares(rep_b)
+    top = sorted(
+        set(sorted(sh_a, key=sh_a.get, reverse=True)[:_COMPARE_TOP_SPANS])
+        | set(sorted(sh_b, key=sh_b.get, reverse=True)[:_COMPARE_TOP_SPANS]),
+        key=lambda n: max(sh_a.get(n, 0.0), sh_b.get(n, 0.0)),
+        reverse=True,
+    )
+    spans_out: Dict[str, Dict] = {}
+    if top:
+        lines.append("")
+        lines.append(f"  {'span share':<18} {'A':>14} {'B':>14} {'B-A':>8}")
+        for name in top:
+            a, b = sh_a.get(name, 0.0), sh_b.get(name, 0.0)
+            spans_out[name] = {"a": a, "b": b, "delta": b - a}
+            lines.append(
+                f"  {name:<18} {a:>13.1%} {b:>13.1%} {b - a:>+8.3f}"
+            )
+
+    doc = {
+        "schema": 1,
+        "a": {"run_dir": rep_a.get("run_dir"), "run_id": rep_a.get("run_id")},
+        "b": {"run_dir": rep_b.get("run_dir"), "run_id": rep_b.get("run_id")},
+        "metrics": metrics,
+        "span_shares": spans_out,
+    }
+    return "\n".join(lines) + "\n", doc
 
 
 def _label_value(label_str: str, key: str) -> str:
@@ -1477,9 +1596,33 @@ def main(argv=None) -> int:
         help="--watch: stop after this many refreshes (0 = until"
              " interrupted; a bound exists for tests/CI)",
     )
+    parser.add_argument(
+        "--compare", nargs=2, metavar=("RUN_A", "RUN_B"), default=None,
+        help="side-by-side diff of two run directories (step p50,"
+             " bytes/step, MFU headline, top span shares, alert counts)",
+    )
+    parser.add_argument(
+        "--plan", default=None,
+        help="run-dir mode: join this scripts/plan.py plan file against"
+             " the realized run — adds the 'costmodel' section"
+             " (predicted-vs-realized step time, the gate's"
+             " costmodel_error) to the report",
+    )
+    parser.add_argument(
+        "--plan-fabric", default=None,
+        help="--plan: which fabric's predictions to join (default: the"
+             " plan's only fabric, else required)",
+    )
     args = parser.parse_args(argv)
+    if args.compare:
+        text, doc = compare_runs(
+            args.compare[0], args.compare[1],
+            straggler_factor=args.straggler_factor,
+        )
+        sys.stdout.write(json.dumps(doc) + "\n" if args.json else text)
+        return 0
     if not args.logs and not args.run_dir:
-        parser.error("need JSONL file(s) or --run-dir")
+        parser.error("need JSONL file(s), --run-dir, or --compare")
     if args.watch:
         if not args.run_dir:
             parser.error("--watch requires --run-dir")
@@ -1495,6 +1638,37 @@ def main(argv=None) -> int:
             straggler_factor=args.straggler_factor,
             trace_out=args.trace_out,
         )
+        if args.plan:
+            plan_doc = _load_plan(args.plan)
+            if plan_doc is None:
+                parser.error(f"--plan {args.plan}: not a readable plan JSON")
+            fabrics = sorted(plan_doc.get("fabrics") or {})
+            fabric = args.plan_fabric or (
+                fabrics[0] if len(fabrics) == 1 else None
+            )
+            if fabric is None:
+                parser.error(
+                    f"--plan has {len(fabrics)} fabrics; pick one with"
+                    " --plan-fabric"
+                )
+            from network_distributed_pytorch_tpu.observe import costmodel
+
+            joined = costmodel.join_realized(plan_doc, fabric, report)
+            report["costmodel"] = joined
+            if joined is not None:
+                pred = joined.get("predicted_step_s")
+                if pred is not None:
+                    text += (
+                        f"\ncostmodel [{fabric}] {joined['config_key']}:"
+                        f" predicted {pred * 1e3:.2f} ms vs realized"
+                        f" {joined['realized_step_s'] * 1e3:.2f} ms"
+                        f" ({joined.get('error', 0.0):.1%} error)\n"
+                    )
+                else:
+                    text += (
+                        f"\ncostmodel [{fabric}] {joined['config_key']}:"
+                        " no matching prediction in the plan\n"
+                    )
         if args.json:
             sys.stdout.write(json.dumps(report) + "\n")
         else:

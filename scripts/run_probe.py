@@ -34,6 +34,13 @@ detector must name that rank from step-time p50s alone — the
 loader-fault -> data_load span -> StragglerEvent attribution chain,
 gated (advisory) at the end.
 
+A seventh phase exercises the trace-driven what-if planner: default toy
+runs on two simulated fabrics (``--sim-fabric`` sleeps the modeled
+allreduce time) calibrate ``scripts/plan.py``'s offline cost model, the
+predicted-best config is replayed and must BEAT the measured default on
+both fabrics, ``report.py --plan`` joins predicted-vs-realized under the
+25% ``costmodel_error`` ceiling, and ``gate.py`` reads the metric.
+
 A third phase supervises a 2-rank spool-SERVING fleet
 (``tests/toy_serving_worker.py`` over the real ``serving/`` request
 lifecycle + FileSpool) into ``artifacts/toy_run_serve/``: rank 1 kills
@@ -799,6 +806,194 @@ def main(argv=None) -> int:
         f" {smoke_doc.get('samples_per_s'):,.0f} samples/s; slow shard on"
         f" rank 1 flagged {stragglers[0].get('factor'):.2f}x median) at"
         f" {loader_dir}; report -> {loader_json}\n"
+    )
+
+    # --- phase 7: the trace-driven what-if planner -----------------------
+    # A default-config toy run on each simulated fabric (the toy sleeps the
+    # modeled allreduce wall time of its payload per step) measures the
+    # hand-set baseline; scripts/plan.py calibrates the offline cost model
+    # from the slow-fabric run and prices every fallback-ladder config per
+    # fabric; the predicted-best toy rung is then REPLAYED on both fabrics
+    # and must beat the measured default (not just the predicted one);
+    # report.py --plan joins predicted-vs-realized within the gate's 25%
+    # costmodel_error ceiling, and gate.py reads the metric (advisory).
+    plan_script = _load_script("plan")
+    plan_fabrics = ("1GbE", "10GbE")
+    plan_steps = 12
+    art_dir = os.path.dirname(args.json_out) or "."
+
+    def _planner_toy_run(tag, extra_argv):
+        """One supervised toy run + merged report; (dir, report_path, doc)
+        with doc=None on failure."""
+        d = run_dir + "_" + tag
+        shutil.rmtree(d, ignore_errors=True)
+        os.makedirs(d, exist_ok=True)
+
+        def argv_fn(rank, world_size, incarnation):
+            return [
+                sys.executable, worker,
+                "--rank", str(rank),
+                "--world", str(world_size),
+                "--steps", str(plan_steps),
+                "--state-dir", os.path.join(d, "state"),
+                "--result-dir", os.path.join(d, "results"),
+                "--step-seconds", str(args.step_seconds),
+                "--payload-mult", "8",
+                *extra_argv,
+            ]
+
+        tele = telemetry_for_run(
+            event_log=os.path.join(d, SUPERVISOR_LOG), stdout=False
+        )
+        res = Supervisor(
+            argv_for_rank=argv_fn,
+            world_size=args.world,
+            config=SupervisorConfig(
+                max_restarts=1, backoff_base_s=0.05, poll_interval_s=0.05
+            ),
+            telemetry=tele,
+            run_dir=d,
+        ).run()
+        tele.close()
+        if not res.success:
+            sys.stderr.write(f"# run_probe: FAIL: {tag} run failed: {res}\n")
+            return d, None, None
+        out_json = os.path.join(art_dir, f"{tag}_report.json")
+        if report.main(["--run-dir", d, "--json-out", out_json]) != 0:
+            return d, None, None
+        with open(out_json) as f:
+            return d, out_json, json.load(f)
+
+    default_p50 = {}
+    calib_report_path = None
+    for fabric in plan_fabrics:
+        _, path, doc = _planner_toy_run(
+            f"plan_default_{fabric}", ["--sim-fabric", fabric]
+        )
+        if doc is None:
+            return 1
+        p50 = doc.get("step_p50_s")
+        if not isinstance(p50, (int, float)) or not p50 > 0:
+            problems.append(f"default run on {fabric} has no step_p50_s")
+        default_p50[fabric] = p50
+        if fabric == plan_fabrics[0]:
+            calib_report_path = path
+
+    plan_path = os.path.join(art_dir, "plan.json")
+    pred_path = os.path.join(art_dir, "predictions.jsonl")
+    rc = plan_script.main([
+        "--report", calib_report_path, "--out", plan_path,
+        "--events-out", pred_path,
+        "--fabrics", ",".join(plan_fabrics) + ",ICI(v5e)",
+    ])
+    if rc != 0:
+        sys.stderr.write("# run_probe: FAIL: plan.py returned nonzero\n")
+        return 1
+    with open(plan_path) as f:
+        plan_doc = json.load(f)
+    if plan_doc.get("schema") != 1 or not plan_doc.get("fabrics"):
+        problems.append(f"plan at {plan_path} malformed: {sorted(plan_doc)}")
+    for fabric in plan_fabrics:
+        best = (plan_doc.get("fabrics", {}).get(fabric) or {}).get("best")
+        if not best or not (best.get("predicted_step_s") or 0) > 0:
+            problems.append(f"plan has no usable best pick for {fabric}")
+    with open(pred_path) as f:
+        pred_lines = [json.loads(ln) for ln in f if ln.strip()]
+    bad_preds = [
+        p for p in pred_lines
+        if p.get("event") != "prediction" or not p.get("config_key")
+        or not (p.get("predicted_step_s") or 0) > 0
+    ]
+    if not pred_lines or bad_preds:
+        problems.append(
+            f"predictions.jsonl not well-formed PredictionEvents"
+            f" ({len(pred_lines)} lines, {len(bad_preds)} bad)"
+        )
+    if problems:
+        for prob in problems:
+            sys.stderr.write(f"# run_probe: FAIL: {prob}\n")
+        return 1
+
+    # replay the predicted-best config on each fabric. The toy executes
+    # the rung subset of the search space; pick the best-ranked name the
+    # toy knows how to run (TOY_RUNG_SPECS: the compress rung carries the
+    # ladder's compress-low-rank knobs).
+    toy_rungs = {"baseline": "baseline", "compress-low-rank": "compress",
+                 "localsgd": "localsgd"}
+    costmodel_error = None
+    realized_best = {}
+    for fabric in plan_fabrics:
+        names = plan_doc.get("ladder", {}).get(fabric) or []
+        pick = next((n for n in names if n in toy_rungs), None)
+        if pick is None:
+            problems.append(f"no toy-executable rung in {fabric} plan ladder")
+            continue
+        if pick == "baseline":
+            problems.append(
+                f"planner picked the hand-set default on {fabric} — nothing"
+                " to beat (model regression: compression should win on a"
+                " slow simulated fabric)"
+            )
+            continue
+        _, replay_json, replay_doc = _planner_toy_run(
+            f"plan_replay_{fabric}",
+            ["--sim-fabric", fabric, "--rung", toy_rungs[pick]],
+        )
+        if replay_doc is None:
+            return 1
+        # re-join through report.py --plan so the costmodel section lands
+        # in the replay report exactly as a user would produce it
+        if report.main([
+            "--run-dir", run_dir + f"_plan_replay_{fabric}",
+            "--json-out", replay_json, "--plan", plan_path,
+            "--plan-fabric", fabric,
+        ]) != 0:
+            return 1
+        with open(replay_json) as f:
+            replay_doc = json.load(f)
+        cm = replay_doc.get("costmodel") or {}
+        realized = replay_doc.get("step_p50_s")
+        realized_best[fabric] = realized
+        if not cm.get("matched"):
+            problems.append(
+                f"replayed {pick} on {fabric} did not match a plan"
+                f" prediction (costmodel: {cm})"
+            )
+            continue
+        if not (isinstance(realized, (int, float)) and realized > 0
+                and realized < default_p50[fabric]):
+            problems.append(
+                f"planner pick {pick} on {fabric} did not beat the measured"
+                f" default ({realized!r} vs {default_p50[fabric]!r})"
+            )
+        err = cm.get("error")
+        if not isinstance(err, (int, float)) or err > 0.25:
+            problems.append(
+                f"costmodel_error on {fabric} outside the 25% calibration"
+                f" bound: {err!r}"
+            )
+        elif costmodel_error is None or err > costmodel_error:
+            costmodel_error = err  # gate the worst fabric's error
+        # advisory gate over the replay report: costmodel_error must be
+        # extractable and the absolute 25% ceiling verdict must show up
+        if "costmodel_error" not in gate.extract_metrics(replay_doc):
+            problems.append(
+                f"gate cannot extract costmodel_error from {replay_json}"
+            )
+        gate.main(["--report", replay_json, "--advisory", "--root", REPO])
+    if problems:
+        for prob in problems:
+            sys.stderr.write(f"# run_probe: FAIL: {prob}\n")
+        return 1
+    sys.stderr.write(
+        "# run_probe: what-if planner ok ("
+        + "; ".join(
+            f"{fab}: default {default_p50[fab] * 1e3:.1f} ms -> planned"
+            f" {realized_best[fab] * 1e3:.1f} ms"
+            for fab in plan_fabrics
+        )
+        + f"; worst costmodel_error {costmodel_error:.1%})"
+        f" plan -> {plan_path}\n"
     )
     return 0
 

@@ -26,6 +26,12 @@ SpanEvents (``step`` > ``step/compute`` / ``checkpoint/save``) — what the
 run-level merger, straggler detector, bandwidth estimator, MFU
 accounting, and trace export consume in tests.
 
+With ``--sim-fabric`` the worker also sleeps the modeled allreduce wall
+time of the active comm rung's payload (``--rung`` / ``--payload-mult``)
+in a ``step/comm`` span each step — the measured step then responds to
+comm configs, which is what lets run_probe exercise the offline what-if
+planner (``observe.costmodel``) end-to-end against realized times.
+
 Usage::
 
     python toy_supervised_worker.py --rank R --world W --steps N \
@@ -89,6 +95,22 @@ FLAP_SLOWDOWN = 5.0
 EPOCH_LEN = 4
 # the toy compressed rung's ledger: rank-1 toy compression of the payload
 TOY_COMPRESSED_BYTES = TOY_PAYLOAD_BYTES // 8
+# --sim-fabric / --rung: the toy comm configs a planner replay can force.
+# Each entry is (compression divisor of the payload, sync_every,
+# n_collectives, the CompileEvent comm_config) — byte-compatible with the
+# DEFAULT_LADDER rungs the offline cost model prices (compress is the toy
+# rank-1 compression = the ladder's "compress-low-rank" knobs; localsgd
+# widens the sync period like the ladder's "localsgd" rung). The simulated
+# allreduce sleep is amortized (comm/sync_every every step) so each step is
+# identical and the report's p50 equals the modeled mean.
+TOY_RUNG_SPECS = {
+    "baseline": (1, 1, 1, {"reducer": "exact"}),
+    "compress": (8, 1, 2, {"reducer": "powersgd", "reducer_rank": 1}),
+    "localsgd": (
+        8, 8, 2,
+        {"reducer": "powersgd", "reducer_rank": 1, "sync_every": 8},
+    ),
+}
 # --health-every: the synthetic grad norm baseline — near-constant, so the
 # live plane's EWMA spike detector has an almost-zero-variance envelope and
 # a chaos ``grad_spike`` (factor 1000 by default) is unambiguously critical
@@ -155,6 +177,29 @@ def main() -> int:
              " the comm-layer PolicyEvent round-trip, jax-free",
     )
     p.add_argument(
+        "--sim-fabric", default=None, metavar="FABRIC",
+        choices=("1GbE", "10GbE", "100GbE", "ICI(v5e)"),
+        help="sleep the modeled ring-allreduce wire time"
+             " (utils.bandwidth.allreduce_time_s) of the active rung's"
+             " payload on this fabric every step, in its own step/comm"
+             " span — what makes the toy's measured step respond to comm"
+             " configs so the offline planner's predictions are testable"
+             " end-to-end, jax-free",
+    )
+    p.add_argument(
+        "--payload-mult", type=int, default=1, metavar="K",
+        help="scale the toy wire payload (and its compressed rung) by K —"
+             " larger payloads separate simulated comm time from sleep"
+             " jitter on slow fabrics",
+    )
+    p.add_argument(
+        "--rung", default="baseline", choices=sorted(TOY_RUNG_SPECS),
+        help="force the toy comm rung (payload compression + sync period +"
+             " CompileEvent comm_config) — how a planner replay executes"
+             " the predicted-best config; a --comm-flap controller"
+             " overrides it per-step",
+    )
+    p.add_argument(
         "--health-every", type=int, default=0, metavar="N",
         help="emit a synthetic TrainHealthEvent every N steps (0 = never);"
              " a chaos grad_spike fault multiplies the reading by its"
@@ -175,6 +220,13 @@ def main() -> int:
     state_path = os.path.join(args.state_dir, f"rank{args.rank}.json")
     state = _load_state(state_path)
 
+    # the toy wire payload, scaled by --payload-mult, and the forced rung's
+    # compression/sync/comm_config (the --comm-flap controller below takes
+    # over rung selection per-step when present)
+    payload_bytes = TOY_PAYLOAD_BYTES * max(1, args.payload_mult)
+    divisor, sync_every, n_coll, comm_config = TOY_RUNG_SPECS[args.rung]
+    rung_bytes_now = payload_bytes // divisor
+
     # per-rank telemetry shard: explicit --event-log wins, else the
     # supervisor-exported run dir (run_start marker auto-emitted from env)
     event_log = args.event_log or shard_event_log_from_env()
@@ -187,16 +239,18 @@ def main() -> int:
             CollectiveEvent(
                 label="toy", tag="toy.grads", layer="reducer",
                 op="all-reduce", axis="data", dtype="float32",
-                payload_bytes=TOY_PAYLOAD_BYTES,
+                payload_bytes=rung_bytes_now,
             )
         )
         # the toy compile verdict: byte-exact by fiat, one fully-exposed
-        # collective, and the cost fields observe.mfu joins at report time
+        # collective, the cost fields observe.mfu joins at report time, and
+        # the active rung's comm_config so the cost-model observatory can
+        # identify WHICH config this run executed (join_realized)
         telemetry.emit(
             CompileEvent(
                 label="toy",
-                analytic_bytes=TOY_PAYLOAD_BYTES,
-                hlo_bytes=TOY_PAYLOAD_BYTES,
+                analytic_bytes=rung_bytes_now,
+                hlo_bytes=rung_bytes_now,
                 delta_bytes=0,
                 exact=True,
                 hlo_collective_count=1,
@@ -210,6 +264,7 @@ def main() -> int:
                 flops_source="analytic",
                 device_kind=TOY_DEVICE_KIND,
                 peak_flops_per_s=TOY_PEAK_FLOPS,
+                comm_config=dict(comm_config),
             )
         )
 
@@ -244,7 +299,28 @@ def main() -> int:
         pseudo_epoch = 0
 
     def _rung_bytes(index):
-        return TOY_PAYLOAD_BYTES if index == 0 else TOY_COMPRESSED_BYTES
+        return payload_bytes if index == 0 else payload_bytes // 8
+
+    # simulated comm plane (--sim-fabric): the modeled allreduce wall time
+    # of the active rung's payload, amortized over the rung's sync period.
+    # Computed lazily per step because a --comm-flap controller can switch
+    # rungs mid-run.
+    def _comm_sleep_s():
+        if args.sim_fabric is None:
+            return 0.0
+        if controller is not None:
+            b, sync, nc = _rung_bytes(controller.index), 1, (
+                1 if controller.index == 0 else 2
+            )
+        else:
+            b, sync, nc = rung_bytes_now, sync_every, n_coll
+        from network_distributed_pytorch_tpu.utils.bandwidth import (
+            allreduce_time_s,
+        )
+
+        return allreduce_time_s(
+            b, args.world, args.sim_fabric, n_collectives=nc
+        ) / sync
 
     if args.graceful_term:
         # the PreemptionGuard contract, toy-sized: SIGTERM -> persist the
@@ -332,6 +408,13 @@ def main() -> int:
                     time.sleep(
                         args.step_seconds * (FLAP_SLOWDOWN if in_flap else 1.0)
                     )
+                # the simulated wire time lives OUTSIDE step/compute so the
+                # cost model's compute calibration (the step/compute span
+                # mean) stays comm-free, exactly like a non-jitted loop
+                comm_s = _comm_sleep_s()
+                if comm_s > 0:
+                    with span("step/comm", step=i, rank=args.rank):
+                        time.sleep(comm_s)
                 state = {"step": i + 1, "value": state["value"] + args.world}
                 with span("checkpoint/save", step=i, rank=args.rank):
                     _save_state(state_path, state)
@@ -351,7 +434,7 @@ def main() -> int:
                     StepEvent(
                         step=i, epoch=0, loss=1.0 / (i + 1),
                         step_time_s=step_time,
-                        bits_cumulative=8 * TOY_PAYLOAD_BYTES * (i + 1),
+                        bits_cumulative=8 * rung_bytes_now * (i + 1),
                     )
                 )
             if (
