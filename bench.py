@@ -1669,6 +1669,13 @@ def _record_gate_baseline(out: dict, status: dict) -> None:
         fired = (doc.get("alerts") or {}).get("fired")
         if isinstance(fired, (int, float)) and fired >= 0:
             rec["alerts_fired"] = float(fired)
+        # cross-rank critical-path comm share (observe.critpath) rides
+        # along from the same report: zero (compute-bound path) is the
+        # healthy value and records as such, so a later round whose steps
+        # start gating on collective-wait regresses against it
+        share = (doc.get("critpath") or {}).get("comm_share")
+        if isinstance(share, (int, float)) and share >= 0:
+            rec["critpath_comm_share"] = float(share)
     except (OSError, ValueError):
         pass
     # loader-isolation arm (PR 12): native assembly samples/s is a

@@ -27,6 +27,14 @@ that loop as a first-class subsystem instead of scattered fragments:
 - :mod:`observe.analytics` — straggler detection (typed
   ``StragglerEvent``) and the effective-bandwidth estimator joining
   ledger bytes, measured step times, and schedule overlap attribution.
+- :mod:`observe.critpath`  — the cross-rank critical-path analyzer:
+  per-step blame attribution (which rank, which phase, which ring edge
+  gated the step) as typed ``CritPathEvent`` records, stitched from the
+  merged span shards and the ledger's synchronization semantics.
+- :mod:`observe.fabric`    — the measured per-edge fabric matrix
+  (``artifacts/fabric_matrix.json``): effective bandwidth/latency per
+  (src, dst) ring neighbor, consumed back through
+  ``utils.bandwidth.fabric_model`` by the cost model and the live plane.
 - :mod:`observe.spans`     — nested, thread-safe host-side spans
   (``with span("step/compute"): ...``) emitting typed ``SpanEvent``
   records through the ambient recorder and mirrored into
@@ -53,12 +61,23 @@ Everything imported here is jax-free, so the bench parent orchestrator
 (which deliberately imports no jax) can use the same sinks.
 """
 
-from . import analytics, costmodel, health, live, mfu, runlog, spans  # noqa: F401
+from . import (  # noqa: F401
+    analytics,
+    costmodel,
+    critpath,
+    fabric,
+    health,
+    live,
+    mfu,
+    runlog,
+    spans,
+)
 from .events import (  # noqa: F401
     SCHEMA_VERSION,
     AlertEvent,
     CollectiveEvent,
     CompileEvent,
+    CritPathEvent,
     DataDropEvent,
     EpochEvent,
     Event,

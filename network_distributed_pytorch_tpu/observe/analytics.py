@@ -63,10 +63,10 @@ def _load_utils_module(name: str):
 
 
 def __getattr__(name: str):
-    # surface the fabric line-rate table without a jax-pulling package
-    # import (PEP 562 lazy attribute)
-    if name == "FABRICS_BYTES_PER_S":
-        return _load_utils_module("bandwidth").FABRICS_BYTES_PER_S
+    # surface the fabric line-rate table and the typed accessor without a
+    # jax-pulling package import (PEP 562 lazy attributes)
+    if name in ("FABRICS_BYTES_PER_S", "fabric_model"):
+        return getattr(_load_utils_module("bandwidth"), name)
     raise AttributeError(name)
 
 
@@ -171,14 +171,18 @@ def effective_bandwidth(
     n_workers: int,
     overlap: Optional[Dict] = None,
     fabrics: Optional[Sequence[str]] = None,
+    matrix: Optional[Dict] = None,
 ) -> Optional[Dict]:
     """Achieved wire rate and per-fabric utilization for one run.
 
     ``step_time_s`` is the measured steady-state step time (cross-rank
     median p50); ``collectives`` are CollectiveEvent records (deduped here
     across rank shards); ``overlap`` is a CompileEvent's overlap extract
-    (None ⇒ all collectives treated as exposed). Returns None when there
-    is nothing to estimate."""
+    (None ⇒ all collectives treated as exposed); ``matrix`` is an optional
+    measured per-edge fabric matrix (``observe.fabric``) — when present,
+    the modeled comm time prices the ring against its slowest edge via the
+    shared :func:`utils.bandwidth.fabric_model` accessor. Returns None
+    when there is nothing to estimate."""
     collectives = _dedupe_collectives(
         [c for c in collectives if isinstance(c.get("payload_bytes"), (int, float))]
     )
@@ -188,7 +192,8 @@ def effective_bandwidth(
         return None
     bw = _load_utils_module("bandwidth")
     ov = _load_utils_module("overlap")
-    fabrics = list(fabrics) if fabrics else list(bw.FABRICS_BYTES_PER_S)
+    model = bw.fabric_model(matrix)
+    fabrics = list(fabrics) if fabrics else list(model.fabrics)
 
     attribution = ov.comm_attribution(overlap or {})
     # the exposed-comm budget: with no schedule evidence every collective
@@ -209,8 +214,8 @@ def effective_bandwidth(
         util = {}
         modeled = {}
         for f in fabrics:
-            util[f] = achieved / bw.FABRICS_BYTES_PER_S[f]
-            modeled[f] = bw.allreduce_time_s(
+            util[f] = achieved / model.bytes_per_s(f)
+            modeled[f] = model.allreduce_time_s(
                 payload_bytes, max(n_workers, 1), f, n_collectives=max(count, 1)
             )
         return {"utilization": util, "modeled_comm_s": modeled}

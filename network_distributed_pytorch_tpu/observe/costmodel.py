@@ -217,8 +217,10 @@ def calibrate(report: Dict, source_fabric: Optional[str] = None) -> CostCalibrat
     compute = _num(slot.get("mean_s")) if isinstance(slot, dict) else None
     base = min(compute, step) if compute and compute > 0 else step
     if source_fabric and bytes_per_step > 0:
-        bwmod = _load_utils_module("bandwidth")
-        modeled = exposed * bwmod.allreduce_time_s(
+        # the shared typed accessor (scalar tables here: the source run's
+        # own fabric is what the measured step already priced in)
+        model = _load_utils_module("bandwidth").fabric_model()
+        modeled = exposed * model.allreduce_time_s(
             bytes_per_step, n_workers, source_fabric,
             n_collectives=n_collectives,
         )
@@ -241,17 +243,27 @@ def calibrate(report: Dict, source_fabric: Optional[str] = None) -> CostCalibrat
     )
 
 
-def predict(calib: CostCalibration, config: Dict, fabric: str) -> Dict:
+def predict(
+    calib: CostCalibration,
+    config: Dict,
+    fabric: str,
+    matrix: Optional[Dict] = None,
+) -> Dict:
     """Price one config on one fabric. Returns the prediction dict with
-    its full per-component breakdown (the PredictionEvent payload)."""
-    bwmod = _load_utils_module("bandwidth")
-    fabrics = bwmod.FABRICS_BYTES_PER_S
-    if fabric not in fabrics:
+    its full per-component breakdown (the PredictionEvent payload).
+
+    ``matrix`` is an optional measured per-edge fabric matrix
+    (``observe.fabric`` / ``artifacts/fabric_matrix.json``). When present,
+    the ring terms price against the SLOWEST measured edge — every chunk
+    of a ring reduction traverses every link, so the worst link gates the
+    whole collective — instead of the named fabric's scalar."""
+    model = _load_utils_module("bandwidth").fabric_model(matrix)
+    if fabric not in model.fabrics:
         raise ValueError(
-            f"unknown fabric {fabric!r} (have {sorted(fabrics)})"
+            f"unknown fabric {fabric!r} (have {sorted(model.fabrics)})"
         )
-    beta = fabrics[fabric]
-    lat = bwmod.LATENCY_S.get(fabric, 0.0)
+    beta = model.ring_beta(fabric)
+    lat = model.ring_latency_s(fabric)
     c = canonical_config(config)
     w = max(1, calib.n_workers)
 
@@ -304,6 +316,13 @@ def predict(calib: CostCalibration, config: Dict, fabric: str) -> Dict:
         "compress_s": compress_s / sync,
         "pipeline_depth": depth,
         "n_collectives": n_coll,
+        # provenance: scalar table vs measured per-edge matrix, and which
+        # edge gated the ring when a matrix was supplied
+        "per_edge": model.per_edge,
+        "bottleneck_edge": (
+            {"src": model.bottleneck().src, "dst": model.bottleneck().dst}
+            if model.per_edge else None
+        ),
     }
 
 
@@ -349,14 +368,15 @@ def search(
     calib: CostCalibration,
     fabrics: Optional[List[str]] = None,
     configs: Optional[List[Dict]] = None,
+    matrix: Optional[Dict] = None,
 ) -> Dict[str, List[Dict]]:
     """Rank every config per fabric, cheapest predicted step first."""
-    bwmod = _load_utils_module("bandwidth")
-    fabrics = list(fabrics or bwmod.FABRICS_BYTES_PER_S)
+    model = _load_utils_module("bandwidth").fabric_model(matrix)
+    fabrics = list(fabrics or model.fabrics)
     configs = configs if configs is not None else default_configs(calib)
     return {
         fabric: sorted(
-            (predict(calib, c, fabric) for c in configs),
+            (predict(calib, c, fabric, matrix=matrix) for c in configs),
             key=lambda p: p["predicted_step_s"],
         )
         for fabric in fabrics
@@ -367,17 +387,28 @@ def build_plan(
     calib: CostCalibration,
     fabrics: Optional[List[str]] = None,
     configs: Optional[List[Dict]] = None,
+    matrix: Optional[Dict] = None,
 ) -> Dict:
     """The tuned per-fabric plan document ``launch.py --plan`` consumes:
     per fabric the ranked predictions and the best pick, plus the
     rung-name ladder ordering ``resilience.controller.ladder_from_plan``
     reorders the fallback ladder with."""
-    ranked = search(calib, fabrics=fabrics, configs=configs)
+    ranked = search(calib, fabrics=fabrics, configs=configs, matrix=matrix)
     return {
         "schema": PLAN_SCHEMA,
         "source": "observe.costmodel",
         "source_run": calib.source_run,
         "calibration": asdict(calib),
+        # provenance of the ring pricing: None = scalar tables, else the
+        # measured matrix's bottleneck edge gated every prediction
+        "fabric_matrix": (
+            {
+                "per_edge": True,
+                "world_size": matrix.get("world_size"),
+                "bottleneck": matrix.get("bottleneck"),
+            }
+            if isinstance(matrix, dict) and matrix.get("edges") else None
+        ),
         "fabrics": {
             fabric: {"best": preds[0], "ranked": preds}
             for fabric, preds in ranked.items()

@@ -302,6 +302,32 @@ class SpanEvent(Event):
 
 
 @dataclass
+class CritPathEvent(Event):
+    """One step's cross-rank critical-path blame verdict
+    (:mod:`observe.critpath`): which rank gated the step, which phase of
+    that rank's timeline (``data_load`` / ``compute`` / ``collective-wait``)
+    carried the gating excess over the cross-rank median, and — when the
+    phase is collective-wait — which ring edge the wait sat on.
+    ``path_s`` is the critical rank's wall time through the step (the
+    longest path through the stitched span graph); the per-phase seconds
+    alongside make the verdict auditable. Timings inherit the clock-model
+    merge tolerance (see DESIGN.md) — they are never bitwise cross-rank
+    facts. Silent on stdout — one per step would drown the banners."""
+
+    KIND: ClassVar[str] = "critpath"
+
+    step: int
+    rank: int  # the gating rank
+    phase: str  # data_load | compute | collective-wait
+    path_s: float  # the critical rank's total through the step
+    edge_src: Optional[int] = None  # set when phase == collective-wait
+    edge_dst: Optional[int] = None
+    data_s: float = 0.0  # the critical rank's per-phase split
+    compute_s: float = 0.0
+    comm_s: float = 0.0
+
+
+@dataclass
 class MfuEvent(Event):
     """A per-window MFU + roofline verdict (:mod:`observe.mfu`): measured
     steady-state step time joined with the compile-time FLOPs record and the

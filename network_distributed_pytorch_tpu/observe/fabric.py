@@ -1,0 +1,160 @@
+"""The measured per-edge fabric matrix.
+
+``utils.bandwidth`` declares the fabric as one scalar per name; this module
+MEASURES it per link from a merged run: the deduped wire ledger says how
+many bytes each ring edge carried per step, the per-rank ``step/comm``
+spans say how long each rank's outgoing link was exposed, and the quotient
+is an effective per-edge bandwidth keyed by (src_rank, dst_rank) ring
+neighbors. The result persists as ``artifacts/fabric_matrix.json`` and
+feeds back through :func:`utils.bandwidth.fabric_model` into the cost
+model (slowest-edge-gates ring pricing), the live health plane (per-edge
+bandwidth-collapse alerts), and the report's per-edge utilization table.
+
+Honesty note on the measurement: with a roughly constant per-step payload,
+bandwidth and latency are NOT separable from wait times alone — the
+reported ``bytes_per_s`` is the EFFECTIVE (latency-inclusive) rate at the
+measured payload, and ``latency_s`` is the minimum observed wait, an upper
+bound on the true per-collective latency. Both are exactly what the
+slowest-edge ring model needs; neither is a line-rate claim.
+
+jax-free, stdlib + observe only, like the rest of the package.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from .analytics import _dedupe_collectives, _load_utils_module, percentile
+
+MATRIX_SCHEMA = 1
+MATRIX_NAME = "fabric_matrix.json"
+
+# span names whose durations count as exposed collective wait on the
+# emitting rank's outgoing ring link (substring match, same convention the
+# critical-path phase mapping uses)
+_COMM_SPAN_MARKER = "comm"
+
+
+def _comm_waits_by_rank(events: List[Dict]) -> Dict[int, List[float]]:
+    """Per-rank exposed-comm span durations, in event order."""
+    waits: Dict[int, List[float]] = {}
+    for e in events:
+        if e.get("event") != "span":
+            continue
+        rank = e.get("rank")
+        dur = e.get("dur_s")
+        if rank is None or not isinstance(dur, (int, float)):
+            continue
+        name = str(e.get("name") or "")
+        if _COMM_SPAN_MARKER in name:
+            waits.setdefault(int(rank), []).append(float(dur))
+    return waits
+
+
+def measure_fabric_matrix(
+    events: List[Dict], world_size: int
+) -> Optional[Dict]:
+    """Derive the per-edge matrix from a merged run's events.
+
+    Edge (r, (r+1) mod W) is charged rank r's p50 ``step/comm`` wait; the
+    bytes every ring link moves per step are ``2·(W-1)/W`` times the
+    deduped ledger's per-step payload (each link carries ~2B(W-1)/W bytes
+    in a ring allreduce of B bytes). Returns None when the run carries no
+    measurable evidence (single rank, no comm spans, or no ledger)."""
+    bw = _load_utils_module("bandwidth")
+    edges_topo = bw.ring_neighbors(world_size)
+    if not edges_topo:
+        return None
+    collectives = [e for e in events if e.get("event") == "collective"]
+    per_step_bytes = sum(
+        float(e.get("payload_bytes") or 0.0)
+        for e in _dedupe_collectives(collectives)
+    )
+    if per_step_bytes <= 0:
+        return None
+    per_edge_bytes = (
+        2.0 * (world_size - 1) / world_size * per_step_bytes
+    )
+    waits = _comm_waits_by_rank(events)
+    rows: List[Dict] = []
+    for src, dst in edges_topo:
+        ws = waits.get(src) or []
+        # drop the first wait per rank when there is more than one: it
+        # rides the same warmup the step-time stats drop
+        eligible = ws[1:] if len(ws) > 1 else ws
+        if not eligible:
+            continue
+        p50 = percentile(eligible, 50)
+        if not p50 or p50 <= 0:
+            continue
+        rows.append({
+            "src": src,
+            "dst": dst,
+            "bytes_per_s": per_edge_bytes / p50,
+            # min observed wait: an upper bound on per-collective latency
+            # (bandwidth/latency are not separable at constant payload)
+            "latency_s": min(eligible),
+            "wait_s_p50": p50,
+            "n_steps": len(eligible),
+        })
+    if not rows:
+        return None
+    worst = min(rows, key=lambda r: r["bytes_per_s"])
+    return {
+        "schema": MATRIX_SCHEMA,
+        "topology": "ring",
+        "world_size": world_size,
+        "per_step_bytes": per_step_bytes,
+        "per_step_edge_bytes": per_edge_bytes,
+        "edges": rows,
+        "bottleneck": {"src": worst["src"], "dst": worst["dst"]},
+    }
+
+
+def edge_utilization(
+    matrix: Optional[Dict], fabrics: Optional[Dict[str, float]] = None
+) -> List[Dict]:
+    """Per-edge utilization rows against each named fabric's line rate —
+    the report's per-edge table. Empty when there is no matrix."""
+    if not isinstance(matrix, dict):
+        return []
+    if fabrics is None:
+        fabrics = _load_utils_module("bandwidth").FABRICS_BYTES_PER_S
+    rows = []
+    for e in matrix.get("edges") or []:
+        achieved = float(e.get("bytes_per_s") or 0.0)
+        rows.append({
+            **e,
+            "utilization": {
+                name: achieved / rate
+                for name, rate in fabrics.items()
+                if rate > 0
+            },
+        })
+    return rows
+
+
+def save_matrix(matrix: Dict, path: str) -> str:
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(matrix, f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def load_matrix(path: str) -> Optional[Dict]:
+    """Read a persisted matrix; None (never a raise) on a missing or
+    malformed file, so consumers degrade to the scalar model."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or not doc.get("edges"):
+        return None
+    return doc

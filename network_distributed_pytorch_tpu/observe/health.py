@@ -38,7 +38,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from .events import AlertEvent
 
@@ -320,7 +320,12 @@ class HealthMonitor:
         self._grad: Dict[Optional[int], GradNormSpikeDetector] = {}
         self._loss = LossPlateauDetector(self.config)
         self._drift: Dict[Optional[int], StepTimeDriftDetector] = {}
-        self._bandwidth = BandwidthCollapseDetector(self.config)
+        # keyed per mesh edge ((src, dst) rank pair); the None key is the
+        # run-aggregate signal, so the historical single-detector behavior
+        # is the edge=None special case
+        self._bandwidth: Dict[
+            Optional[Tuple[int, int]], BandwidthCollapseDetector
+        ] = {}
         self._slo = SloBurnRateDetector(self.config)
         self.alerts: List[AlertEvent] = []
 
@@ -347,8 +352,20 @@ class HealthMonitor:
         det = self._drift.setdefault(rank, StepTimeDriftDetector(self.config))
         return self._keep(det.observe(value, rank=rank, step=step))
 
-    def observe_bytes_per_s(self, value: float) -> List[AlertEvent]:
-        return self._keep(self._bandwidth.observe(value))
+    def observe_bytes_per_s(
+        self, value: float, edge: Optional[Tuple[int, int]] = None
+    ) -> List[AlertEvent]:
+        """``edge=None`` is the run-aggregate achieved rate; an (src, dst)
+        rank pair tracks ONE mesh link's effective rate with its own
+        baseline, so a collapse alert names the edge (and blames the src
+        rank) instead of the whole run."""
+        det = self._bandwidth.setdefault(
+            edge, BandwidthCollapseDetector(self.config)
+        )
+        alert = det.observe(value, rank=edge[0] if edge else None)
+        if alert is not None and edge is not None:
+            alert.message = f"edge {edge[0]}->{edge[1]}: {alert.message}"
+        return self._keep(alert)
 
     def observe_serving_p99(self, value: float) -> List[AlertEvent]:
         return self._keep(self._slo.observe(value))

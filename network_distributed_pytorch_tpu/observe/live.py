@@ -420,6 +420,10 @@ class LiveAggregator:
         self._pending_first: Dict[Tuple[int, int], bool] = {}
         self._ledger: Dict[Tuple, Dict] = {}  # deduped collective records
         self._overlap: Optional[Dict] = None
+        # per-rank exposed-comm span waits: rank r's collective-wait spans
+        # price its OUTGOING ring edge (r, (r+1) mod W), the same charging
+        # rule observe.fabric and observe.critpath use
+        self._comm_waits: Dict[int, List[float]] = {}
         self._step_times: deque = deque()  # (t_run, rank) of steps, windowed
         self._now: Optional[float] = None  # max observed run time
 
@@ -585,6 +589,14 @@ class LiveAggregator:
             ov = rec.get("overlap")
             if isinstance(ov, dict) and ov:
                 self._overlap = ov
+        elif kind == "span" and rank is not None:
+            dur = rec.get("dur_s")
+            if (
+                isinstance(dur, (int, float))
+                and dur >= 0
+                and "comm" in str(rec.get("name") or "")
+            ):
+                self._comm_waits.setdefault(rank, []).append(float(dur))
         elif kind == "train_health":
             gn = rec.get("grad_norm")
             if isinstance(gn, (int, float)):
@@ -613,6 +625,33 @@ class LiveAggregator:
         return analytics.effective_bandwidth(
             p50, list(self._ledger.values()), world, overlap=self._overlap
         )
+
+    def edge_rates(self) -> Dict[Tuple[int, int], float]:
+        """Effective per-edge wire rate off the live evidence: the deduped
+        ledger's per-step ring-link bytes over each src rank's p50 exposed
+        comm wait (first wait per rank dropped as warmup). Empty when the
+        run has no comm spans or no ledger."""
+        world = self.manifest.world_size if self.manifest is not None else 1
+        if world < 2 or not self._ledger or not self._comm_waits:
+            return {}
+        per_step_bytes = sum(
+            float(rec.get("payload_bytes") or 0.0)
+            for rec in self._ledger.values()
+        )
+        if per_step_bytes <= 0:
+            return {}
+        per_edge_bytes = 2.0 * (world - 1) / world * per_step_bytes
+        bwmod = analytics._load_utils_module("bandwidth")
+        out: Dict[Tuple[int, int], float] = {}
+        for src, dst in bwmod.ring_neighbors(world):
+            waits = self._comm_waits.get(src) or []
+            eligible = waits[1:] if len(waits) > 1 else waits
+            if not eligible:
+                continue
+            p50 = analytics.percentile(eligible, 50)
+            if p50 and p50 > 0:
+                out[(src, dst)] = per_edge_bytes / p50
+        return out
 
     def _refresh_gauges(self) -> List[AlertEvent]:
         fired: List[AlertEvent] = []
@@ -661,6 +700,16 @@ class LiveAggregator:
                     fabric=fabric,
                 )
             fired += self.monitor.observe_bytes_per_s(achieved)
+        for (src, dst), rate in sorted(self.edge_rates().items()):
+            self.registry.gauge(
+                "live_edge_bytes_per_s", rate,
+                help="effective per-ring-edge wire rate (ledger bytes over"
+                     " the src rank's p50 exposed comm wait)",
+                edge=f"{src}->{dst}",
+            )
+            # per-edge collapse detection: the alert names the edge and
+            # blames the src rank, not just the run
+            fired += self.monitor.observe_bytes_per_s(rate, edge=(src, dst))
         hist = self.registry.get_histogram("live_serving_total_seconds")
         if hist is not None and len(hist):
             p99 = hist.percentile(99)

@@ -302,6 +302,12 @@ class MergedRun:
     per_rank: Dict[int, Dict]
     torn_lines: int
     startup_s: float  # the cross-spawn median marker-minus-spawn estimate
+    # the merge-tolerance bound every stitched cross-rank comparison
+    # inherits: the worst per-spawn deviation from the shared startup
+    # median. Two ranks' t_run values closer than this are NOT ordered
+    # facts — the critical-path analyzer and DESIGN.md's guarantee entry
+    # quote this number instead of pretending bitwise alignment.
+    clock_skew_bound_s: float = 0.0
 
 
 def merge_run(run_dir: str, manifest: Optional[RunManifest] = None) -> MergedRun:
@@ -343,6 +349,7 @@ def merge_run(run_dir: str, manifest: Optional[RunManifest] = None) -> MergedRun
             if spawn is not None and isinstance(e.get("ts"), (int, float)):
                 deltas.append(e["ts"] - spawn)
     startup = _percentile(deltas, 50) if deltas else 0.0
+    skew_bound = max((abs(d - startup) for d in deltas), default=0.0)
 
     merged: List[Tuple[Optional[float], int, Dict]] = []
     seq = 0
@@ -402,4 +409,5 @@ def merge_run(run_dir: str, manifest: Optional[RunManifest] = None) -> MergedRun
         per_rank=per_rank,
         torn_lines=torn_total,
         startup_s=startup,
+        clock_skew_bound_s=skew_bound,
     )

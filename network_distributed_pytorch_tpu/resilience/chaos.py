@@ -65,7 +65,9 @@ import os
 import signal
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+from typing import (
+    Any, Callable, Dict, Iterable, Iterator, List, Optional, Tuple,
+)
 
 import numpy as np
 
@@ -82,7 +84,11 @@ PROCESS_FAULTS = ("proc_exit", "proc_kill", "proc_hang", "proc_preempt")
 # rank=None fires on every zone member); ``host_flap`` re-kills the same
 # rank each life until ``payload["flaps"]`` restarts have burned.
 CORRELATED_FAULTS = ("zone_outage", "host_flap")
-COMM_FAULTS = ("comm_throttle", "comm_stall", "comm_flap")
+# ``comm_slow_edge`` is the heterogeneous-link fault: a per-rank-pair
+# throttle (payload {"edge": [src, dst], "bytes_per_s": ...}) that only
+# the edge's SRC rank pays, so a per-edge blame pipeline (observe.critpath
+# / observe.fabric) can be verified end to end against a known-slow link.
+COMM_FAULTS = ("comm_throttle", "comm_stall", "comm_flap", "comm_slow_edge")
 HEALTH_FAULTS = ("grad_spike",)
 FAULT_KINDS = (
     LOADER_FAULTS + STEP_FAULTS + CHECKPOINT_FAULTS + PROCESS_FAULTS
@@ -113,6 +119,7 @@ INJECTION_SITES: Dict[str, str] = {
     "comm_throttle": "comm-hook",       # CommFaultInjector fence hook
     "comm_stall": "comm-hook",          # CommFaultInjector fence hook
     "comm_flap": "comm-hook",           # CommFaultInjector fence hook
+    "comm_slow_edge": "comm-hook",      # CommFaultInjector fence hook
     "grad_spike": "health-probe",       # health sampler (TrainHealthEvent)
 }
 
@@ -481,6 +488,32 @@ class CommFaultInjector:
     def stall_pending(self) -> bool:
         return self._stall is not None
 
+    @property
+    def throttle_edge(self) -> Optional[Tuple[int, int]]:
+        """The (src, dst) rank pair of an active ``comm_slow_edge``
+        throttle (None for edgeless throttles/flaps)."""
+        t = self._throttle
+        if t is None or not t.get("edge"):
+            return None
+        src, dst = t["edge"][0], t["edge"][1]
+        return (int(src), int(dst))
+
+    def host_throttle_sleep_s(self, payload_bytes: float) -> float:
+        """The sleep the fence hook would add for ONE collective of this
+        payload — for jax-free hosts (the toy worker's simulated wire)
+        that model the throttle inline instead of registering fence
+        hooks. 0.0 when no throttle is active or this rank is not the
+        throttled edge's src."""
+        t = self._throttle
+        if t is None:
+            return 0.0
+        edge = self.throttle_edge
+        if edge is not None and edge[0] != self._rank:
+            return 0.0
+        return min(
+            float(payload_bytes) / t["bytes_per_s"], t["max_sleep_s"]
+        )
+
     def advance(self, step_index: int) -> None:
         """Pop any comm fault scheduled for ``step_index`` and retire an
         expiring flap/throttle. Call BEFORE running the step."""
@@ -513,12 +546,21 @@ class CommFaultInjector:
             self._telemetry, spec, step_index, self._rank, self._incarnation
         )
         p = spec.payload
-        if spec.kind in ("comm_throttle", "comm_flap"):
+        if spec.kind in ("comm_throttle", "comm_flap", "comm_slow_edge"):
             clears = p.get("clears_after", 3 if spec.kind == "comm_flap" else None)
             if clears is None:
                 clears = p.get("duration_steps")
+            edge = p.get("edge")
+            if spec.kind == "comm_slow_edge":
+                # a per-link throttle: only the edge's src rank pays it.
+                # Target the spec at rank=src (or payload["ranks"]=[src]);
+                # a spec popped by a non-src rank is a plan mistake and
+                # deliberately degrades to a plain throttle with the edge
+                # recorded for the blame assertions.
+                edge = [int(x) for x in (edge or (self._rank, self._rank + 1))]
             self._throttle = {
                 "kind": spec.kind,
+                "edge": edge,
                 "bytes_per_s": float(p.get("bytes_per_s", 1.25e9)),
                 "max_sleep_s": float(p.get("max_sleep_s", 0.25)),
                 "until_step": (

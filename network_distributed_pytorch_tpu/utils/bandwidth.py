@@ -16,8 +16,8 @@ paper uses for its speedup claims.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Dict, Sequence
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
 
 # bytes/second; ICI figure is v5e per-chip interconnect bandwidth (public
 # spec ~1.6 Tbps aggregate), GbE figures are the reference's fabrics
@@ -42,6 +42,118 @@ class FabricEstimate:
     comm_time_s: float
     step_time_s: float
     comm_fraction: float
+
+
+def ring_neighbors(world_size: int) -> List[Tuple[int, int]]:
+    """The (src, dst) directed edge list of the rank ring: rank r sends to
+    rank (r+1) mod W. One entry per rank; a 1-rank world has no edges."""
+    if world_size < 2:
+        return []
+    return [(r, (r + 1) % world_size) for r in range(world_size)]
+
+
+@dataclass
+class EdgeEstimate:
+    """One measured (or declared) link of the mesh. ``bytes_per_s`` is the
+    EFFECTIVE rate at the measured payload (latency folded in when the
+    measurement cannot separate the two — see observe.fabric)."""
+
+    src: int
+    dst: int
+    bytes_per_s: float
+    latency_s: float = 0.0
+
+
+@dataclass
+class FabricModel:
+    """The fabric as the planner sees it: the named scalar tables always,
+    plus (when a measured ``fabric_matrix.json`` is supplied) a per-edge
+    matrix whose SLOWEST edge gates every ring reduction.
+
+    This is the one sanctioned accessor for fabric numbers —
+    ``observe.analytics`` and ``observe.costmodel`` both route through
+    :func:`fabric_model` instead of touching the module tables directly, so
+    a per-edge measurement upgrades every consumer at once.
+    """
+
+    fabrics: Dict[str, float] = field(
+        default_factory=lambda: dict(FABRICS_BYTES_PER_S)
+    )
+    latency: Dict[str, float] = field(default_factory=lambda: dict(LATENCY_S))
+    edges: List[EdgeEstimate] = field(default_factory=list)
+
+    @property
+    def per_edge(self) -> bool:
+        return bool(self.edges)
+
+    def bytes_per_s(self, fabric: str) -> float:
+        return self.fabrics[fabric]
+
+    def latency_s(self, fabric: str) -> float:
+        return self.latency.get(fabric, 0.0)
+
+    def bottleneck(self) -> Optional[EdgeEstimate]:
+        """The slowest measured edge (None without a matrix)."""
+        if not self.edges:
+            return None
+        return min(self.edges, key=lambda e: e.bytes_per_s)
+
+    def ring_beta(self, fabric: str) -> float:
+        """Effective per-link bandwidth for a ring reduction: the slowest
+        edge when a measured matrix is present (every chunk traverses every
+        link, so the worst link gates the whole ring), else the named
+        fabric's scalar."""
+        worst = self.bottleneck()
+        if worst is not None and worst.bytes_per_s > 0:
+            return worst.bytes_per_s
+        return self.fabrics[fabric]
+
+    def ring_latency_s(self, fabric: str) -> float:
+        """Per-collective latency: the bottleneck edge's measured latency
+        when present, else the named fabric's scalar."""
+        worst = self.bottleneck()
+        if worst is not None and worst.latency_s > 0:
+            return worst.latency_s
+        return self.latency.get(fabric, 0.0)
+
+    def allreduce_time_s(
+        self,
+        payload_bytes: float,
+        n_workers: int,
+        fabric: str,
+        n_collectives: int = 1,
+    ) -> float:
+        beta = self.ring_beta(fabric)
+        ring = 2.0 * (n_workers - 1) / max(n_workers, 1) * payload_bytes / beta
+        return ring + n_collectives * self.ring_latency_s(fabric)
+
+
+def fabric_model(matrix: Optional[Dict] = None) -> FabricModel:
+    """The typed accessor every fabric consumer goes through.
+
+    Without arguments: the scalar tables (exactly the historical behavior).
+    With a ``fabric_matrix.json``-shaped dict (``observe.fabric`` writes
+    it): a per-edge model whose ring semantics are slowest-edge-gates.
+    Malformed edge rows are skipped rather than raised — a half-written
+    artifact degrades to the scalar model."""
+    model = FabricModel()
+    if not isinstance(matrix, dict):
+        return model
+    for row in matrix.get("edges") or []:
+        if not isinstance(row, dict):
+            continue
+        try:
+            edge = EdgeEstimate(
+                src=int(row["src"]),
+                dst=int(row["dst"]),
+                bytes_per_s=float(row["bytes_per_s"]),
+                latency_s=float(row.get("latency_s", 0.0) or 0.0),
+            )
+        except (KeyError, TypeError, ValueError):
+            continue
+        if edge.bytes_per_s > 0:
+            model.edges.append(edge)
+    return model
 
 
 def allreduce_time_s(

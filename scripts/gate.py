@@ -37,6 +37,9 @@ Metrics compared (only those present in BOTH report and baseline):
   from ``report.py --plan``; also gated against the ABSOLUTE
   ``costmodel_error_target`` ceiling, default 25 % — the calibration
   bound DESIGN.md states for cost-model predictions)
+- ``critpath_comm_share``    lower is better (report ``critpath`` section —
+  share of the cross-rank critical path spent blocked in collective-wait,
+  from the observe.critpath analyzer)
 
 A metric the current report carries but a stale baseline does not gets a
 clearly-labeled ``missing_baseline`` ADVISORY verdict (never a
@@ -113,6 +116,12 @@ METRICS: Dict[str, str] = {
     # the ABSOLUTE ceiling ``costmodel_error_target`` (default
     # DEFAULT_COSTMODEL_ERROR_TARGET) backstops the relative comparison
     "costmodel_error": "lower",
+    # share of the cross-rank critical path spent in collective-wait
+    # (report ``critpath.comm_share``, observe.critpath) — the fraction of
+    # every step's gating chain blocked on the fabric. Zero is the healthy
+    # value (fully compute-bound run), so 0 records like alerts_fired; a
+    # growing share means stragglers/slow edges started gating steps
+    "critpath_comm_share": "lower",
 }
 
 # the calibration bound DESIGN.md states for cost-model predictions: a
@@ -199,6 +208,17 @@ def extract_metrics(doc: Dict) -> Dict[str, float]:
     v = doc.get("costmodel_error")
     if isinstance(v, (int, float)) and v == v and v >= 0:
         out.setdefault("costmodel_error", float(v))
+    # critical-path comm share: nested under the report's "critpath"
+    # section (observe.critpath via report.py --run-dir), flat in bench
+    # baselines. Zero (compute-bound path) is healthy, so >= 0 records
+    cp = doc.get("critpath")
+    if isinstance(cp, dict):
+        v = cp.get("comm_share")
+        if isinstance(v, (int, float)) and v == v and v >= 0:
+            out["critpath_comm_share"] = float(v)
+    v = doc.get("critpath_comm_share")
+    if isinstance(v, (int, float)) and v == v and v >= 0:
+        out.setdefault("critpath_comm_share", float(v))
     return out
 
 

@@ -97,6 +97,13 @@ def main(argv=None) -> int:
              " step/compute span encloses the collectives)",
     )
     parser.add_argument(
+        "--fabric-matrix", default=None,
+        help="measured per-edge fabric matrix (scripts/report.py writes"
+             " artifacts/fabric_matrix.json) — prices every ring term"
+             " against the slowest measured edge instead of the named"
+             " fabric's scalar",
+    )
+    parser.add_argument(
         "--top", type=int, default=3,
         help="per-fabric predictions to summarize on stderr (default 3)",
     )
@@ -118,7 +125,21 @@ def main(argv=None) -> int:
         [f.strip() for f in args.fabrics.split(",") if f.strip()]
         if args.fabrics else None
     )
-    plan = costmodel.build_plan(calib, fabrics=fabrics)
+    matrix = None
+    if args.fabric_matrix:
+        from network_distributed_pytorch_tpu.observe import fabric as fabric_mod
+
+        matrix = fabric_mod.load_matrix(args.fabric_matrix)
+        if matrix is None:
+            _say(f"no usable fabric matrix at {args.fabric_matrix};"
+                 " falling back to scalar fabric tables")
+        else:
+            bn = matrix.get("bottleneck") or {}
+            _say(
+                f"per-edge matrix: {len(matrix.get('edges', []))} edge(s),"
+                f" bottleneck {bn.get('src')}->{bn.get('dst')}"
+            )
+    plan = costmodel.build_plan(calib, fabrics=fabrics, matrix=matrix)
 
     for path in (args.out, args.events_out):
         parent = os.path.dirname(path)

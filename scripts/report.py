@@ -127,7 +127,9 @@ _RECOVERY_KINDS = {
 }
 # the comm-layer fault kinds (resilience.chaos.COMM_FAULTS) — the
 # recovery-latency clock starts at the first of these injected
-_COMM_FAULT_LABELS = {"comm_throttle", "comm_stall", "comm_flap"}
+_COMM_FAULT_LABELS = {
+    "comm_throttle", "comm_stall", "comm_flap", "comm_slow_edge",
+}
 # supervisor-observed worker deaths; their messages carry the supervisor's
 # graceful-vs-hard classification (SIGTERM honored within the grace window
 # vs SIGKILL/crash), which the timeline tallies
@@ -1059,6 +1061,65 @@ def render_mfu_section(mfu_records: List[Dict]) -> List[str]:
     return lines
 
 
+def render_critpath_section(
+    crit: Optional[Dict],
+    matrix: Optional[Dict],
+    clock_skew_bound_s: float = 0.0,
+) -> List[str]:
+    """The cross-rank critical-path section: per-rank and per-phase blame
+    shares, the top gating edge, and the measured per-edge utilization
+    table. Empty when the run carries no stepped, ranked spans."""
+    if not isinstance(crit, dict):
+        return []
+    lines = ["", "critical path (cross-rank)",
+             "--------------------------"]
+    lines.append(
+        f"  {crit['n_steps']} step(s) analyzed, collective-wait share of"
+        f" the critical path {100 * crit['comm_share']:.1f}%"
+        f" (merge tolerance +/- {clock_skew_bound_s * 1e3:.1f} ms)"
+    )
+    ranks = ", ".join(
+        f"rank {r}: {100 * s:.1f}%"
+        for r, s in crit["blame_by_rank"].items()
+    )
+    lines.append(f"  blame by rank   {ranks}")
+    phases = ", ".join(
+        f"{p}: {100 * s:.1f}%"
+        for p, s in crit["blame_by_phase"].items()
+    )
+    lines.append(f"  blame by phase  {phases}")
+    top = crit.get("top_edge")
+    if top:
+        lines.append(
+            f"  top gating edge {top['src']} -> {top['dst']}"
+            f" (gated {top['blamed_steps']} step(s) in collective-wait)"
+        )
+    if isinstance(matrix, dict):
+        from network_distributed_pytorch_tpu.observe import fabric as fabric_mod
+
+        lines.append(
+            f"  per-edge fabric matrix ({matrix.get('topology')},"
+            f" {_fmt_bytes(matrix.get('per_step_edge_bytes', 0.0))}/step"
+            f" per link):"
+        )
+        for row in fabric_mod.edge_utilization(matrix):
+            util = "  ".join(
+                f"{name} {100 * u:5.1f}%"
+                for name, u in sorted(row["utilization"].items())
+            )
+            lines.append(
+                f"    {row['src']} -> {row['dst']}  "
+                f"{_fmt_rate(row['bytes_per_s']):>12}  "
+                f"wait p50 {row['wait_s_p50'] * 1e3:7.2f} ms  util {util}"
+            )
+        b = matrix.get("bottleneck") or {}
+        if b:
+            lines.append(
+                f"    bottleneck edge: {b.get('src')} -> {b.get('dst')}"
+            )
+    return lines
+
+
 # Chrome-trace lanes, one pid per rank (Perfetto renders pid -1, the
 # supervisor, as its own process track)
 _TID_SPANS, _TID_STEPS, _TID_COLLECTIVES, _TID_FAILURES = 0, 1, 2, 3
@@ -1132,6 +1193,48 @@ def chrome_trace(events: List[Dict]) -> Dict:
                 "pid": pid, "tid": _TID_FAILURES, "ts": us(e["t_run"]),
                 "args": {"message": e.get("message")},
             })
+    # Perfetto flow arrows across rank tracks at each collective: every
+    # step's exposed-comm slices are ring-chained rank r -> rank r+1 (the
+    # same (src, dst) charging the fabric matrix uses), so the UI draws
+    # the cross-rank synchronization edge the critical-path analyzer
+    # reasons about. A flow phase must carry a ts INSIDE the slice it
+    # binds to — the midpoint is used.
+    comm_mid: Dict[Tuple[int, int], float] = {}
+    for e in timed:
+        if (
+            e.get("event") == "span"
+            and isinstance(e.get("dur_s"), (int, float))
+            and "comm" in str(e.get("name") or "")
+            and e.get("rank") is not None
+            and e.get("step") is not None
+        ):
+            comm_mid[(int(e["step"]), int(e["rank"]))] = us(
+                e["t_run"] - e["dur_s"] / 2.0
+            )
+    steps_seen: Dict[int, List[int]] = {}
+    for step, rank in comm_mid:
+        steps_seen.setdefault(step, []).append(rank)
+    for step, ranks in sorted(steps_seen.items()):
+        ranks = sorted(ranks)
+        if len(ranks) < 2:
+            continue
+        for k, src in enumerate(ranks):
+            dst = ranks[(k + 1) % len(ranks)]
+            flow_id = f"step{step}:{src}->{dst}"
+            common = {
+                "cat": "collective-flow",
+                "name": f"step {step} sync",
+                "id": flow_id,
+                "tid": _TID_SPANS,
+            }
+            trace_events.append({
+                "ph": "s", "pid": src, "ts": comm_mid[(step, src)], **common,
+            })
+            trace_events.append({
+                "ph": "f", "bp": "e", "pid": dst,
+                "ts": comm_mid[(step, dst)], **common,
+            })
+
     meta: List[Dict] = []
     for pid, name in sorted(pids.items()):
         meta.append({
@@ -1197,8 +1300,43 @@ def run_report(
     mfus = [m["mfu"] for m in mfu_records if m.get("mfu") is not None]
     spans = span_summary(merged.events)
 
+    # the cross-rank critical path and the measured per-edge matrix
+    from network_distributed_pytorch_tpu.observe import critpath as critpath_mod
+    from network_distributed_pytorch_tpu.observe import fabric as fabric_mod
+
+    crit = critpath_mod.analyze(merged.events, merged.manifest.world_size)
+    matrix = fabric_mod.measure_fabric_matrix(
+        merged.events, merged.manifest.world_size
+    )
+    straggler_records = [ev.record() for ev in stragglers]
+    if crit:
+        # join the straggler verdicts against the blame attribution: a
+        # flagged rank carries the phase (and, for collective-wait, the
+        # ring edge) its critical-path excess sat in
+        by_rank: Dict[int, List[Dict]] = {}
+        for ev in crit["events"]:
+            by_rank.setdefault(int(ev["rank"]), []).append(ev)
+        for rec in straggler_records:
+            blamed = by_rank.get(int(rec.get("rank", -1))) or []
+            if not blamed:
+                continue
+            phases = [e["phase"] for e in blamed]
+            rec["blamed_phase"] = max(set(phases), key=phases.count)
+            edges = [
+                (e["edge_src"], e["edge_dst"])
+                for e in blamed if e.get("edge_src") is not None
+            ]
+            if rec["blamed_phase"] == "collective-wait" and edges:
+                src, dst = max(set(edges), key=edges.count)
+                rec["blamed_edge"] = {"src": src, "dst": dst}
+
     sections = render_run_sections(
         merged, stats, stragglers, bandwidth, straggler_factor
+    )
+    sections.extend(
+        render_critpath_section(
+            crit, matrix, clock_skew_bound_s=merged.clock_skew_bound_s
+        )
     )
     sections.extend(render_mfu_section(mfu_records))
     comm_buckets = bucket_attribution(bandwidth, overlap)
@@ -1258,8 +1396,16 @@ def run_report(
             max(p50s) / step_p50 if p50s and step_p50 and step_p50 > 0 else None
         ),
         "straggler_factor": straggler_factor,
-        "stragglers": [ev.record() for ev in stragglers],
+        "stragglers": straggler_records,
         "bandwidth": bandwidth,
+        # the cross-rank critical path (None when the run has no stepped
+        # spans) — the gate's critpath_comm_share lives at
+        # critpath.comm_share; timings inherit clock_skew_bound_s
+        "critpath": crit,
+        "clock_skew_bound_s": merged.clock_skew_bound_s,
+        # the measured per-edge matrix (also persisted next to --json-out
+        # as fabric_matrix.json for costmodel/plan.py to consume)
+        "fabric_matrix": matrix,
         # the wire-ledger compile extract (LAST compile event = the config
         # the run finished on): analytic bytes, compression evidence, and
         # the comm-config knobs the step compiled with — what the offline
@@ -1480,6 +1626,14 @@ def render_watch_frame(agg, run_dir: str = "") -> str:
         lines.append(
             f"  comm    {_fmt_rate(bps):>10}   util " + "  ".join(utils)
         )
+    edges = snap.get("live_edge_bytes_per_s", {})
+    if edges:
+        tiles = "  ".join(
+            f"{_label_value(lbl, 'edge')} {_fmt_rate(v)}"
+            for lbl, v in sorted(edges.items())
+            if isinstance(v, (int, float))
+        )
+        lines.append(f"  edges   {tiles}")
     gn = snap.get("live_grad_norm", {})
     if gn:
         tiles = "   ".join(
@@ -1680,6 +1834,16 @@ def main(argv=None) -> int:
         with open(json_out, "w") as f:
             json.dump(report, f, indent=1)
         sys.stderr.write(f"# report: wrote {json_out}\n")
+        if report.get("fabric_matrix"):
+            from network_distributed_pytorch_tpu.observe import (
+                fabric as fabric_mod,
+            )
+
+            matrix_path = os.path.join(
+                os.path.dirname(json_out) or ".", fabric_mod.MATRIX_NAME
+            )
+            fabric_mod.save_matrix(report["fabric_matrix"], matrix_path)
+            sys.stderr.write(f"# report: wrote {matrix_path}\n")
 
     for path in args.logs:
         events, skipped = load_events_counted(path)

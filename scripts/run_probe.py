@@ -41,6 +41,15 @@ predicted-best config is replayed and must BEAT the measured default on
 both fabrics, ``report.py --plan`` joins predicted-vs-realized under the
 25% ``costmodel_error`` ceiling, and ``gate.py`` reads the metric.
 
+An eighth phase is the critical-path GAME DAY: a 2-rank simulated-fabric
+run takes a chaos ``comm_slow_edge`` (rank 1's outgoing ring edge 1 -> 0
+throttled to ~20 MB/s) and the merged report must blame that exact edge
+three independent ways — the cross-rank critical-path analyzer's top
+gating edge and per-step (rank, phase) verdicts, the straggler record's
+``blamed_edge`` enrichment, and the measured per-edge fabric matrix's
+bottleneck — while the exported trace carries cross-rank
+collective-flow arrows and ``gate.py`` reads ``critpath_comm_share``.
+
 A third phase supervises a 2-rank spool-SERVING fleet
 (``tests/toy_serving_worker.py`` over the real ``serving/`` request
 lifecycle + FileSpool) into ``artifacts/toy_run_serve/``: rank 1 kills
@@ -994,6 +1003,176 @@ def main(argv=None) -> int:
         )
         + f"; worst costmodel_error {costmodel_error:.1%})"
         f" plan -> {plan_path}\n"
+    )
+
+    # --- phase 8: critical-path game day (slow-edge blame round-trip) ----
+    # A 2-rank run on a simulated 10GbE fabric takes a chaos
+    # ``comm_slow_edge`` on rank 1's outgoing ring edge (1 -> 0, throttled
+    # to ~20 MB/s from step 2 on). The merged report must blame that exact
+    # edge three independent ways: the critical-path analyzer's top gating
+    # edge AND per-step (rank, phase) verdicts, the straggler record's
+    # ``blamed_edge`` enrichment, and the measured per-edge fabric matrix's
+    # bottleneck — and the exported trace must carry the cross-rank
+    # collective-flow arrows the analyzer's causality stitching implies.
+    crit_dir = run_dir + "_critpath"
+    shutil.rmtree(crit_dir, ignore_errors=True)
+    os.makedirs(crit_dir, exist_ok=True)
+    crit_steps = 12
+    crit_plan = os.path.join(crit_dir, "chaos_plan.json")
+    slow_bytes_per_s = 2e7  # ~52 ms/step on the 1 MiB toy payload
+    ChaosPlan([
+        FaultSpec(
+            kind="comm_slow_edge", step=2, rank=1,
+            payload={"edge": [1, 0], "bytes_per_s": slow_bytes_per_s,
+                     "duration_steps": 999, "max_sleep_s": 0.25},
+        )
+    ]).save(crit_plan)
+
+    def crit_argv_for_rank(rank, world_size, incarnation):
+        return [
+            sys.executable, worker,
+            "--rank", str(rank),
+            "--world", str(world_size),
+            "--steps", str(crit_steps),
+            "--state-dir", os.path.join(crit_dir, "state"),
+            "--result-dir", os.path.join(crit_dir, "results"),
+            "--step-seconds", str(args.step_seconds),
+            "--sim-fabric", "10GbE",
+            "--chaos-plan", crit_plan,
+        ]
+
+    crit_telemetry = telemetry_for_run(
+        event_log=os.path.join(crit_dir, SUPERVISOR_LOG), stdout=False
+    )
+    crit_result = Supervisor(
+        argv_for_rank=crit_argv_for_rank,
+        world_size=2,
+        config=SupervisorConfig(
+            max_restarts=1, backoff_base_s=0.05, poll_interval_s=0.05
+        ),
+        telemetry=crit_telemetry,
+        run_dir=crit_dir,
+    ).run()
+    crit_telemetry.close()
+    if not crit_result.success:
+        sys.stderr.write(
+            f"# run_probe: critpath game-day run failed: {crit_result}\n"
+        )
+        return 1
+
+    crit_json = os.path.join(crit_dir, "report.json")
+    crit_trace = os.path.join(crit_dir, "trace.json")
+    if report.main([
+        "--run-dir", crit_dir, "--json-out", crit_json,
+        "--trace-out", crit_trace,
+    ]) != 0:
+        return 1
+    with open(crit_json) as f:
+        crit_doc = json.load(f)
+
+    problems = []
+    crit = crit_doc.get("critpath") or {}
+    top_edge = crit.get("top_edge") or {}
+    if (top_edge.get("src"), top_edge.get("dst")) != (1, 0):
+        problems.append(
+            f"critpath top gating edge is {top_edge!r}, expected the"
+            " throttled 1 -> 0"
+        )
+    # per-step verdicts: once the throttle lands (step >= 2) the blamed
+    # (rank, phase) must be (1, collective-wait) on a clear majority
+    late = [
+        ev for ev in crit.get("events") or []
+        if isinstance(ev.get("step"), int) and ev["step"] >= 2
+    ]
+    hits = [
+        ev for ev in late
+        if ev.get("rank") == 1 and ev.get("phase") == "collective-wait"
+    ]
+    if not late or len(hits) * 2 <= len(late):
+        problems.append(
+            f"per-step blame did not converge on (rank 1, collective-wait)"
+            f" after the throttle: {len(hits)}/{len(late)} steps"
+        )
+    share = crit.get("comm_share")
+    if not (isinstance(share, (int, float)) and 0 < share <= 1):
+        problems.append(f"critpath comm_share not in (0, 1]: {share!r}")
+    # straggler attribution: rank 1 flagged, carrying the edge blame
+    stragglers = crit_doc.get("stragglers") or []
+    flagged = {s.get("rank") for s in stragglers}
+    if 1 not in flagged:
+        problems.append(
+            f"straggler detector missed throttled rank 1 (flagged:"
+            f" {sorted(flagged)})"
+        )
+    else:
+        rec = next(s for s in stragglers if s.get("rank") == 1)
+        blamed = rec.get("blamed_edge") or {}
+        if (blamed.get("src"), blamed.get("dst")) != (1, 0):
+            problems.append(
+                f"straggler record blames edge {blamed!r}, expected 1 -> 0"
+            )
+    # measured fabric matrix: bottleneck must be the throttled edge and
+    # its effective rate must sit near the injected throttle, far below
+    # the healthy reverse edge
+    matrix = crit_doc.get("fabric_matrix") or {}
+    bottleneck = matrix.get("bottleneck") or {}
+    if (bottleneck.get("src"), bottleneck.get("dst")) != (1, 0):
+        problems.append(
+            f"fabric-matrix bottleneck is {bottleneck!r}, expected 1 -> 0"
+        )
+    rates = {
+        (e.get("src"), e.get("dst")): e.get("bytes_per_s")
+        for e in matrix.get("edges") or []
+    }
+    slow = rates.get((1, 0))
+    healthy = rates.get((0, 1))
+    if not (isinstance(slow, (int, float))
+            and slow < 3 * slow_bytes_per_s):
+        problems.append(
+            f"measured 1 -> 0 rate {slow!r} B/s not near the injected"
+            f" {slow_bytes_per_s:.0f} B/s throttle"
+        )
+    if not (isinstance(healthy, (int, float)) and isinstance(slow, (int, float))
+            and healthy > 3 * slow):
+        problems.append(
+            f"throttled edge not clearly slower than the healthy one"
+            f" ({slow!r} vs {healthy!r} B/s)"
+        )
+    if not os.path.exists(os.path.join(crit_dir, "fabric_matrix.json")):
+        problems.append("report did not persist fabric_matrix.json")
+    # the trace must carry paired cross-rank collective-flow arrows
+    prob = _check_trace(crit_trace, 2)
+    if prob:
+        problems.append(prob)
+    else:
+        with open(crit_trace) as f:
+            trace_events = json.load(f).get("traceEvents") or []
+        flows = [
+            ev for ev in trace_events
+            if ev.get("cat") == "collective-flow"
+        ]
+        flow_phs = {ev.get("ph") for ev in flows}
+        flow_pids = {ev.get("pid") for ev in flows}
+        if flow_phs != {"s", "f"} or flow_pids != {0, 1}:
+            problems.append(
+                f"trace collective-flow arrows malformed ({len(flows)}"
+                f" events, ph {sorted(flow_phs)}, pids {sorted(flow_pids)})"
+            )
+    # and the gate must be able to read the new metric off this report
+    if "critpath_comm_share" not in gate.extract_metrics(crit_doc):
+        problems.append(
+            f"gate cannot extract critpath_comm_share from {crit_json}"
+        )
+    if problems:
+        for prob in problems:
+            sys.stderr.write(f"# run_probe: FAIL: {prob}\n")
+        return 1
+    gate.main(["--report", crit_json, "--advisory", "--root", REPO])
+    sys.stderr.write(
+        "# run_probe: critpath game day ok (edge 1 -> 0 blamed by"
+        f" analyzer, straggler record, and matrix bottleneck;"
+        f" measured {slow / 1e6:.1f} MB/s vs healthy {healthy / 1e6:.1f}"
+        f" MB/s; comm share {share:.0%}) report -> {crit_json}\n"
     )
     return 0
 

@@ -1,0 +1,501 @@
+"""Cross-rank critical path + per-edge fabric matrix (jax-free, fast).
+
+Pins the observability tentpole end to end on synthetic evidence: the
+typed fabric-model accessor in ``utils.bandwidth`` (scalar tables vs a
+measured per-edge matrix, slowest-edge ring semantics against a
+hand-computed 3-rank oracle), the critical-path analyzer's blame
+discipline (rank AND phase AND ring edge, excess-over-median so a
+throttled link is blamed even when compute is absolutely larger), the
+matrix measurement/persistence round-trip, the per-edge health-alert
+naming, the live aggregator's edge rates, the report's Perfetto
+collective-flow arrows and ``--watch`` dashboard rendering, and the
+gate's ``critpath_comm_share`` extraction.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+from network_distributed_pytorch_tpu.observe import (
+    CritPathEvent,
+    critpath,
+    fabric,
+    runlog,
+)
+from network_distributed_pytorch_tpu.observe import costmodel
+from network_distributed_pytorch_tpu.observe.health import (
+    DetectorConfig,
+    HealthMonitor,
+)
+from network_distributed_pytorch_tpu.observe.live import (
+    LiveAggregator,
+    MetricRegistry,
+    ShardFollower,
+)
+from network_distributed_pytorch_tpu.utils import bandwidth
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TESTS_DIR)
+
+MIB = 1 << 20
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        f"_critpath_test_{name}", os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[f"_critpath_test_{name}"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# the typed fabric-model accessor (utils.bandwidth)
+# ---------------------------------------------------------------------------
+
+
+def test_ring_neighbors():
+    assert bandwidth.ring_neighbors(3) == [(0, 1), (1, 2), (2, 0)]
+    assert bandwidth.ring_neighbors(2) == [(0, 1), (1, 0)]
+    assert bandwidth.ring_neighbors(1) == []
+    assert bandwidth.ring_neighbors(0) == []
+
+
+def test_fabric_model_scalar_matches_tables():
+    model = bandwidth.fabric_model()
+    assert not model.per_edge
+    assert model.bottleneck() is None
+    for name, rate in bandwidth.FABRICS_BYTES_PER_S.items():
+        assert model.ring_beta(name) == rate
+        assert model.ring_latency_s(name) == bandwidth.LATENCY_S[name]
+    # the model's allreduce matches the module-level closed form
+    assert model.allreduce_time_s(MIB, 4, "10GbE") == pytest.approx(
+        bandwidth.allreduce_time_s(MIB, 4, "10GbE")
+    )
+
+
+def test_fabric_model_matrix_slowest_edge_gates():
+    matrix = {
+        "edges": [
+            {"src": 0, "dst": 1, "bytes_per_s": 2e9, "latency_s": 1e-4},
+            {"src": 1, "dst": 2, "bytes_per_s": 0.5e9, "latency_s": 2e-4},
+            {"src": 2, "dst": 0, "bytes_per_s": 1e9, "latency_s": 1e-4},
+        ]
+    }
+    model = bandwidth.fabric_model(matrix)
+    assert model.per_edge
+    bn = model.bottleneck()
+    assert (bn.src, bn.dst) == (1, 2)
+    # the matrix overrides every named fabric's scalar: the worst link
+    # gates the ring regardless of what the fabric claims
+    assert model.ring_beta("100GbE") == 0.5e9
+    assert model.ring_latency_s("100GbE") == 2e-4
+
+
+def test_fabric_model_degrades_on_malformed_matrix():
+    for bad in (None, "nope", {"edges": "x"},
+                {"edges": [{"src": 0}]},
+                {"edges": [{"src": 0, "dst": 1, "bytes_per_s": 0}]},
+                {"edges": [{"src": 0, "dst": 1, "bytes_per_s": -2.0}]}):
+        model = bandwidth.fabric_model(bad)
+        assert not model.per_edge
+        assert model.ring_beta("10GbE") == bandwidth.FABRICS_BYTES_PER_S[
+            "10GbE"
+        ]
+
+
+def test_costmodel_slowest_edge_oracle_3_rank_ring():
+    """Acceptance oracle: predict() with a measured 3-rank matrix must
+    price the ring against the slowest edge, term by hand-computed term."""
+    calib = costmodel.CostCalibration(
+        step_time_s=0.05, compute_s=0.03, dense_bytes=float(4 * MIB),
+        bytes_per_step=float(4 * MIB), n_workers=3, exposed_fraction=1.0,
+        n_collectives=1,
+    )
+    worst_beta = 0.25e9
+    worst_lat = 5e-4
+    matrix = {
+        "edges": [
+            {"src": 0, "dst": 1, "bytes_per_s": 4e9, "latency_s": 1e-5},
+            {"src": 1, "dst": 2, "bytes_per_s": worst_beta,
+             "latency_s": worst_lat},
+            {"src": 2, "dst": 0, "bytes_per_s": 2e9, "latency_s": 1e-5},
+        ]
+    }
+    pred = costmodel.predict(calib, {"reducer": "exact"}, "100GbE",
+                             matrix=matrix)
+    # hand oracle: 2(W-1)/W * B / beta_worst + n_coll * lat_worst
+    wire = (2.0 * 2 / 3) * (4 * MIB) / worst_beta
+    assert pred["wire_s"] == pytest.approx(wire)
+    assert pred["predicted_step_s"] == pytest.approx(
+        0.03 + wire + worst_lat
+    )
+    assert pred["per_edge"] is True
+    assert pred["bottleneck_edge"] == {"src": 1, "dst": 2}
+    # without the matrix the same fabric prices off its (faster) scalar
+    scalar = costmodel.predict(calib, {"reducer": "exact"}, "100GbE")
+    assert scalar["per_edge"] is False
+    assert scalar["bottleneck_edge"] is None
+    assert scalar["predicted_step_s"] < pred["predicted_step_s"]
+
+
+# ---------------------------------------------------------------------------
+# the critical-path analyzer
+# ---------------------------------------------------------------------------
+
+
+def _span(step, rank, name, dur, span_id=None, parent_id=None):
+    return {
+        "event": "span", "name": name, "dur_s": dur, "step": step,
+        "rank": rank, "span_id": span_id or f"s{step}r{rank}{name}",
+        "parent_id": parent_id,
+    }
+
+
+def _rank_step(step, rank, data=0.0, compute=0.01, comm=0.0):
+    """One rank-step's leaf spans under a container (the toy layout)."""
+    container = f"c{step}r{rank}"
+    spans = [
+        {"event": "span", "name": "step", "dur_s": data + compute + comm,
+         "step": step, "rank": rank, "span_id": container,
+         "parent_id": None},
+        _span(step, rank, "step/compute", compute, parent_id=container),
+    ]
+    if data > 0:
+        spans.append(_span(step, rank, "data_load", data,
+                           parent_id=container))
+    if comm > 0:
+        spans.append(_span(step, rank, "step/comm", comm,
+                           parent_id=container))
+    return spans
+
+
+def test_phase_of_taxonomy():
+    assert critpath.phase_of("data_load") == critpath.PHASE_DATA
+    assert critpath.phase_of("step/comm") == critpath.PHASE_COMM
+    assert critpath.phase_of("step/compute") == critpath.PHASE_COMPUTE
+    assert critpath.phase_of("checkpoint/save") == critpath.PHASE_COMPUTE
+
+
+def test_analyze_blames_rank_phase_and_edge():
+    events = []
+    for step in range(4):
+        events += _rank_step(step, 0, compute=0.010, comm=0.002)
+        slow = 0.002 if step == 0 else 0.050  # throttle lands at step 1
+        events += _rank_step(step, 1, compute=0.010, comm=slow)
+    crit = critpath.analyze(events, world_size=2)
+    assert crit is not None
+    assert crit["n_steps"] == 4
+    late = [e for e in crit["events"] if e["step"] >= 1]
+    assert all(e["rank"] == 1 for e in late)
+    assert all(e["phase"] == critpath.PHASE_COMM for e in late)
+    assert all(
+        (e["edge_src"], e["edge_dst"]) == (1, 0) for e in late
+    )
+    assert crit["top_edge"] == {"src": 1, "dst": 0, "blamed_steps": 3}
+    assert crit["blame_by_rank"]["1"] > 0.5
+    assert crit["blame_by_phase"][critpath.PHASE_COMM] > 0.5
+    assert 0 < crit["comm_share"] <= 1
+
+
+def test_blame_is_excess_over_median_not_absolute():
+    # compute (40 ms) is absolutely larger than comm everywhere, but only
+    # rank 2's comm stands out vs the cross-rank median -> blame comm
+    per_rank = {
+        0: {"data_load": 0.0, "compute": 0.040, "collective-wait": 0.002},
+        1: {"data_load": 0.0, "compute": 0.040, "collective-wait": 0.002},
+        2: {"data_load": 0.0, "compute": 0.041, "collective-wait": 0.020},
+    }
+    ev = critpath.step_blame(per_rank, world_size=3, step=7)
+    assert isinstance(ev, CritPathEvent)
+    assert ev.rank == 2
+    assert ev.phase == critpath.PHASE_COMM
+    assert (ev.edge_src, ev.edge_dst) == (2, 0)
+    assert ev.path_s == pytest.approx(0.061)
+
+
+def test_step_blame_uniform_ranks_fall_back_to_absolute_phase():
+    per_rank = {
+        0: {"data_load": 0.0, "compute": 0.040, "collective-wait": 0.002},
+        1: {"data_load": 0.0, "compute": 0.040, "collective-wait": 0.002},
+    }
+    ev = critpath.step_blame(per_rank, world_size=2, step=0)
+    assert ev.phase == critpath.PHASE_COMPUTE
+    assert ev.edge_src is None and ev.edge_dst is None
+
+
+def test_analyze_none_without_ranked_spans():
+    assert critpath.analyze([], world_size=2) is None
+    # spans without step/rank (the single-log mode) carry no evidence
+    assert critpath.analyze(
+        [{"event": "span", "name": "step/compute", "dur_s": 0.01}], 2
+    ) is None
+
+
+def test_critpath_event_record_round_trip():
+    ev = CritPathEvent(step=3, rank=1, phase="collective-wait",
+                       path_s=0.05, edge_src=1, edge_dst=0,
+                       comm_s=0.04, compute_s=0.01)
+    rec = ev.record()
+    assert rec["event"] == "critpath"
+    assert (rec["step"], rec["rank"]) == (3, 1)
+    assert rec["phase"] == "collective-wait"
+    assert (rec["edge_src"], rec["edge_dst"]) == (1, 0)
+
+
+# ---------------------------------------------------------------------------
+# the measured fabric matrix
+# ---------------------------------------------------------------------------
+
+
+def _collective(rank, payload=MIB):
+    return {
+        "event": "collective", "label": "toy", "tag": "toy.grads",
+        "layer": "reducer", "op": "all-reduce", "axis": "data",
+        "dtype": "float32", "payload_bytes": payload, "rank": rank,
+    }
+
+
+def test_measure_fabric_matrix_rates_and_bottleneck():
+    events = [_collective(0), _collective(1)]  # dedupes to ONE payload
+    for step in range(5):
+        events += _rank_step(step, 0, comm=0.010)
+        events += _rank_step(step, 1, comm=0.100)
+    matrix = fabric.measure_fabric_matrix(events, world_size=2)
+    assert matrix is not None
+    assert matrix["topology"] == "ring"
+    assert matrix["per_step_bytes"] == pytest.approx(float(MIB))
+    per_edge_bytes = 2.0 * 1 / 2 * MIB
+    assert matrix["per_step_edge_bytes"] == pytest.approx(per_edge_bytes)
+    rows = {(r["src"], r["dst"]): r for r in matrix["edges"]}
+    # warmup: the first wait per rank is dropped, 4 samples remain
+    assert rows[(0, 1)]["n_steps"] == 4
+    assert rows[(0, 1)]["bytes_per_s"] == pytest.approx(
+        per_edge_bytes / 0.010
+    )
+    assert rows[(1, 0)]["bytes_per_s"] == pytest.approx(
+        per_edge_bytes / 0.100
+    )
+    assert matrix["bottleneck"] == {"src": 1, "dst": 0}
+    # the utilization table prices each edge against every named fabric
+    util = fabric.edge_utilization(matrix)
+    u01 = next(r for r in util if (r["src"], r["dst"]) == (0, 1))
+    assert u01["utilization"]["10GbE"] == pytest.approx(
+        (per_edge_bytes / 0.010) / bandwidth.FABRICS_BYTES_PER_S["10GbE"]
+    )
+
+
+def test_measure_fabric_matrix_needs_evidence():
+    assert fabric.measure_fabric_matrix([], 2) is None
+    assert fabric.measure_fabric_matrix([_collective(0)], 1) is None
+    # ledger but no comm spans
+    assert fabric.measure_fabric_matrix([_collective(0)], 2) is None
+    # comm spans but no ledger bytes
+    events = _rank_step(0, 0, comm=0.01) + _rank_step(0, 1, comm=0.01)
+    assert fabric.measure_fabric_matrix(events, 2) is None
+
+
+def test_matrix_save_load_round_trip(tmp_path):
+    events = [_collective(0)]
+    for step in range(3):
+        events += _rank_step(step, 0, comm=0.01)
+        events += _rank_step(step, 1, comm=0.02)
+    matrix = fabric.measure_fabric_matrix(events, 2)
+    path = str(tmp_path / "fabric_matrix.json")
+    fabric.save_matrix(matrix, path)
+    loaded = fabric.load_matrix(path)
+    assert loaded == json.loads(json.dumps(matrix))
+    # and the loaded doc drives the typed accessor
+    model = bandwidth.fabric_model(loaded)
+    assert model.per_edge
+    assert fabric.load_matrix(str(tmp_path / "absent.json")) is None
+    (tmp_path / "bad.json").write_text("{not json")
+    assert fabric.load_matrix(str(tmp_path / "bad.json")) is None
+    (tmp_path / "empty.json").write_text('{"edges": []}')
+    assert fabric.load_matrix(str(tmp_path / "empty.json")) is None
+
+
+# ---------------------------------------------------------------------------
+# per-edge health alerts + live edge rates
+# ---------------------------------------------------------------------------
+
+
+def test_health_monitor_per_edge_alert_names_edge():
+    cfg = DetectorConfig(collapse_min_obs=3, collapse_sustain=1,
+                         cooldown=100)
+    mon = HealthMonitor(cfg)
+    for _ in range(5):
+        assert mon.observe_bytes_per_s(1e9, edge=(1, 0)) == []
+        assert mon.observe_bytes_per_s(1e9) == []  # aggregate detector
+    fired = mon.observe_bytes_per_s(1e7, edge=(1, 0))
+    assert len(fired) == 1
+    assert fired[0].alert == "bandwidth_collapse"
+    assert fired[0].message.startswith("edge 1->0:")
+    assert fired[0].rank == 1
+    # the collapse on edge (1, 0) must not have touched edge (0, 1)
+    assert mon.observe_bytes_per_s(1e9, edge=(0, 1)) == []
+
+
+def _live_run_dir(tmp_path, comm_by_rank):
+    run_dir = str(tmp_path)
+    m = runlog.new_manifest("runC", world_size=2)
+    for r in (0, 1):
+        m.record_spawn(rank=r, incarnation=0, world_size=2,
+                       spawned_unix=100.0)
+    m.save(run_dir)
+    for r, comm in comm_by_rank.items():
+        shard = os.path.join(run_dir, runlog.shard_name(r))
+        with open(shard, "a") as f:
+            f.write(json.dumps({
+                "event": "marker", "kind": "run_start", "run_id": "runC",
+                "rank": r, "world_size": 2, "incarnation": 0,
+                "ts": 100.5, "ts_mono": 50.0,
+            }) + "\n")
+            f.write(json.dumps(_collective(r)) + "\n")
+            for step, dur in enumerate(comm):
+                f.write(json.dumps(_span(step, r, "step/comm", dur)) + "\n")
+    return run_dir
+
+
+def test_aggregator_edge_rates_and_gauges(tmp_path):
+    run_dir = _live_run_dir(
+        tmp_path, {0: [0.01, 0.01, 0.01], 1: [0.05, 0.05, 0.05]}
+    )
+    agg = LiveAggregator(run_dir)
+    agg.poll()
+    rates = agg.edge_rates()
+    per_edge_bytes = 2.0 * 1 / 2 * MIB
+    assert rates[(0, 1)] == pytest.approx(per_edge_bytes / 0.01)
+    assert rates[(1, 0)] == pytest.approx(per_edge_bytes / 0.05)
+    assert agg.registry.get_gauge(
+        "live_edge_bytes_per_s", edge="1->0"
+    ) == pytest.approx(per_edge_bytes / 0.05)
+
+
+# ---------------------------------------------------------------------------
+# satellite: ShardFollower truncation/rotation round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_follower_truncation_resets_cleanly(tmp_path):
+    shard = str(tmp_path / "events_rank0.jsonl")
+    with open(shard, "w") as f:
+        for i in range(4):
+            f.write(json.dumps({"event": "step", "step": i}) + "\n")
+    follower = ShardFollower(shard)
+    assert [e["step"] for e in follower.poll()] == [0, 1, 2, 3]
+    saved = follower.offset
+    # rotation: the file is truncated SHORTER than the persisted offset
+    # and a new incarnation starts writing from scratch
+    with open(shard, "w") as f:
+        f.write(json.dumps({"event": "step", "step": 100}) + "\n")
+    assert os.path.getsize(shard) < saved
+    resumed = ShardFollower(shard, offset=saved)
+    assert [e["step"] for e in resumed.poll()] == [100]  # reset, no raise
+    with open(shard, "a") as f:
+        f.write(json.dumps({"event": "step", "step": 101}) + "\n")
+    assert [e["step"] for e in resumed.poll()] == [101]  # and keeps tailing
+
+
+# ---------------------------------------------------------------------------
+# report plumbing: watch dashboard, flow arrows, critpath section, gate
+# ---------------------------------------------------------------------------
+
+
+class _StubAgg:
+    def __init__(self, registry=None, alerts=None, run_dir=""):
+        self.registry = registry or MetricRegistry()
+        self.alerts = alerts or []
+        self.run_dir = run_dir
+
+
+def test_render_watch_frame_never_raises_on_empty_or_partial(tmp_path):
+    report = _load_script("report")
+    # empty: a fresh registry with no samples at all
+    frame = report.render_watch_frame(_StubAgg(run_dir=str(tmp_path)))
+    assert "alerts fired: 0" in frame
+    assert "steps" in frame
+    # partial: some gauges present, others absent, odd label shapes
+    reg = MetricRegistry()
+    reg.counter("live_steps_total", 5, rank="0")
+    reg.gauge("live_step_time_p50_seconds", 0.012)
+    reg.gauge("live_comm_bytes_per_s", 1.5e8)
+    reg.gauge("live_fabric_utilization", 0.4, fabric="10GbE")
+    reg.gauge("live_edge_bytes_per_s", 2e7, edge="1->0")
+    reg.gauge("live_torn_lines_total", 2)
+    frame = report.render_watch_frame(_StubAgg(registry=reg))
+    assert "p50" in frame and "10GbE" in frame
+    assert "1->0" in frame  # the per-edge tile rides the dashboard
+    assert "torn shard lines: 2" in frame
+    # a real (but empty) aggregator over an empty run dir also renders
+    agg = LiveAggregator(str(tmp_path))
+    agg.poll()
+    assert report.render_watch_frame(agg, run_dir=str(tmp_path))
+
+
+def test_chrome_trace_emits_paired_flow_arrows():
+    report = _load_script("report")
+    events = []
+    base = 100.0
+    for step in range(2):
+        for rank in (0, 1):
+            t = base + step * 0.1 + 0.05
+            events.append({
+                "event": "span", "name": "step/comm", "dur_s": 0.02,
+                "step": step, "rank": rank, "span_id": f"c{step}{rank}",
+                "t_run": t,
+            })
+    doc = report.chrome_trace(events)
+    flows = [e for e in doc["traceEvents"]
+             if e.get("cat") == "collective-flow"]
+    starts = [e for e in flows if e["ph"] == "s"]
+    finishes = [e for e in flows if e["ph"] == "f"]
+    # 2 steps x 2 ranks chained cyclically = 4 arrows, each s+f paired
+    assert len(starts) == 4 and len(finishes) == 4
+    assert {e["id"] for e in starts} == {e["id"] for e in finishes}
+    for f in finishes:
+        assert f["bp"] == "e"
+    # every arrow crosses ranks: its s and f land on different pids
+    by_id = {e["id"]: e for e in starts}
+    for f in finishes:
+        assert f["pid"] != by_id[f["id"]]["pid"]
+
+
+def test_render_critpath_section_renders_matrix_table():
+    report = _load_script("report")
+    events = [_collective(0)]
+    for step in range(3):
+        events += _rank_step(step, 0, comm=0.01)
+        events += _rank_step(step, 1, comm=0.05)
+    crit = critpath.analyze(events, 2)
+    matrix = fabric.measure_fabric_matrix(events, 2)
+    lines = report.render_critpath_section(
+        crit, matrix, clock_skew_bound_s=0.002
+    )
+    text = "\n".join(lines)
+    assert "critical path (cross-rank)" in text
+    assert "top gating edge 1 -> 0" in text
+    assert "bottleneck edge: 1 -> 0" in text
+    assert "+/- 2.0 ms" in text
+    # and the empty case renders nothing rather than raising
+    assert report.render_critpath_section(None, None) == []
+
+
+def test_gate_extracts_critpath_comm_share():
+    gate = _load_script("gate")
+    assert gate.METRICS["critpath_comm_share"] == "lower"
+    nested = gate.extract_metrics({"critpath": {"comm_share": 0.25}})
+    assert nested["critpath_comm_share"] == 0.25
+    flat = gate.extract_metrics({"critpath_comm_share": 0.0})
+    assert flat["critpath_comm_share"] == 0.0  # zero is healthy, records
+    # current-only metric vs a stale baseline: advisory, never a regression
+    verdicts = gate.compare(
+        {"critpath_comm_share": 0.3}, {"step_p50_s": 0.01}, tolerance=0.05
+    )
+    v = next(v for v in verdicts if v["metric"] == "critpath_comm_share")
+    assert v.get("missing_baseline") is True
+    assert v["regressed"] is False

@@ -56,6 +56,7 @@ from network_distributed_pytorch_tpu.resilience.chaos import (  # noqa: E402
     PREEMPT_EXIT_CODE,
     PROCESS_FAULTS,
     ChaosPlan,
+    CommFaultInjector,
 )
 from network_distributed_pytorch_tpu.observe import (  # noqa: E402
     CollectiveEvent,
@@ -268,6 +269,16 @@ def main() -> int:
             )
         )
 
+    # the comm-hook face of the chaos plan: pops COMM_FAULTS once per step
+    # in advance(). The toy has no real fence hooks (jax-free), so the
+    # simulated wire below adds the injector's modeled host-side sleep
+    # inside the step/comm span — a comm_slow_edge on this rank's outgoing
+    # ring link grows exactly the span the critical-path analyzer charges
+    # to that edge (run_probe phase 8 asserts the blame end to end).
+    comm_chaos = CommFaultInjector(
+        plan, rank=args.rank, incarnation=incarnation, telemetry=telemetry
+    )
+
     flap = args.comm_flap
     run_dir = os.environ.get(ENV_RUN_DIR)
     # the alert feed tails the supervisor's alerts.jsonl; only meaningful
@@ -340,6 +351,7 @@ def main() -> int:
             i = state["step"]
             if args.heartbeat_dir:
                 _beat(args.heartbeat_dir, args.rank, incarnation, i)
+            comm_chaos.advance(i)
             spec = plan.pop(
                 PROCESS_FAULTS + CORRELATED_FAULTS, i, args.rank, incarnation
             )
@@ -412,6 +424,9 @@ def main() -> int:
                 # cost model's compute calibration (the step/compute span
                 # mean) stays comm-free, exactly like a non-jitted loop
                 comm_s = _comm_sleep_s()
+                # active per-edge throttle: the modeled extra wire time the
+                # fence hook would have injected, paid on the host here
+                comm_s += comm_chaos.host_throttle_sleep_s(rung_bytes_now)
                 if comm_s > 0:
                     with span("step/comm", step=i, rank=args.rank):
                         time.sleep(comm_s)
