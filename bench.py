@@ -329,10 +329,21 @@ def _phase_probe() -> dict:
     import jax
 
     d = jax.devices()[0]
+    # runtime attestation: jaxlib pins the compiled XLA the numbers came
+    # from — a perf delta across rounds with different jaxlibs is a
+    # toolchain change, not a repo regression (gate.py's device-provenance
+    # guard reads the platform field; the version rides along for humans)
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", None)
+    except Exception:  # noqa: BLE001 — attestation is best-effort
+        jaxlib_version = None
     return {
         "device": getattr(d, "device_kind", d.platform),
         "platform": d.platform,
         "n_devices": jax.device_count(),
+        "jaxlib_version": jaxlib_version,
     }
 
 
@@ -1296,6 +1307,7 @@ def _merge(
         out["device"] = data["device"]
         out["platform"] = data["platform"]
         out["n_devices"] = data["n_devices"]
+        out["jaxlib_version"] = data.get("jaxlib_version")
     else:
         out.update(data)
     flag = out.get("flagship_imgs_per_sec")
@@ -1352,7 +1364,8 @@ _SUMMARY_LIMIT = 1200
 # overflows _SUMMARY_LIMIT, keys drop from the BOTTOM of this list first
 _SUMMARY_PRIORITY = (
     "metric", "value", "unit", "vs_baseline", "device", "platform",
-    "n_devices", "preset", "wall_s", "partial", "value_tier",
+    "n_devices", "jaxlib_version", "preset", "wall_s", "partial",
+    "value_tier",
     "flagship_imgs_per_sec", "flagship_imgs_per_sec_min",
     "flagship_imgs_per_sec_max", "baseline_imgs_per_sec",
     "baseline_imgs_per_sec_min", "baseline_imgs_per_sec_max", "mfu",
@@ -1638,7 +1651,14 @@ def _record_gate_baseline(out: dict, status: dict) -> None:
         "schema": 1,
         "source": "bench.py",
         "recorded_unix": int(time.time()),
+        # runtime attestation, so gate.py's device-provenance guard (and a
+        # human reading the baseline) knows exactly what produced these
+        # numbers: a CPU report gating against this on a chip baseline is
+        # flagged, not silently compared
         "platform": out.get("platform"),
+        "jaxlib_version": out.get("jaxlib_version"),
+        "n_devices": out.get("n_devices"),
+        "init_retries": int(out.get("init_retries", 0) or 0),
         "preset": out.get("preset"),
         "value_tier": out.get("value_tier"),
         "flagship_imgs_per_sec": out.get("flagship_imgs_per_sec"),
@@ -1676,6 +1696,14 @@ def _record_gate_baseline(out: dict, status: dict) -> None:
         share = (doc.get("critpath") or {}).get("comm_share")
         if isinstance(share, (int, float)) and share >= 0:
             rec["critpath_comm_share"] = float(share)
+        # peak device memory from the memory observatory: measured when
+        # the sampler ran, else the compile-time predicted peak
+        # (memory_summary picks and labels the source). Lower-is-better
+        # in gate.py — a model/step change that doubles the footprint
+        # regresses against this baseline before it OOMs in production
+        hbm = (doc.get("memory") or {}).get("hbm_peak_bytes")
+        if isinstance(hbm, (int, float)) and hbm > 0:
+            rec["hbm_peak_bytes"] = float(hbm)
     except (OSError, ValueError):
         pass
     # loader-isolation arm (PR 12): native assembly samples/s is a

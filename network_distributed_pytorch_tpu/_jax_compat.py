@@ -33,6 +33,12 @@ On the 0.4.x line:
   one-element list of dicts) and raises outright on backends without an XLA
   cost model. ``observe.mfu`` wants "XLA's FLOPs number or None", never an
   exception, so the version/backed variance is absorbed here.
+- ``compiled_memory`` (same contract for the memory side):
+  ``Compiled.memory_analysis()`` varies across jaxlib versions between an
+  object with ``*_size_in_bytes`` attributes, a plain dict, a one-element
+  list, and raising on backends without buffer-assignment stats.
+  ``observe.memory`` wants "XLA's footprint split or None", never an
+  exception.
 """
 
 from __future__ import annotations
@@ -123,3 +129,53 @@ def compiled_cost(compiled):
     if not out.get("flops"):
         return None
     return out
+
+
+# memory_analysis() attribute/key name -> the normalized field name the
+# observe plane publishes (CompileEvent / the run report's memory section)
+_MEMORY_FIELDS = {
+    "argument_size_in_bytes": "argument_bytes",
+    "output_size_in_bytes": "output_bytes",
+    "temp_size_in_bytes": "temp_bytes",
+    "generated_code_size_in_bytes": "generated_code_bytes",
+    "alias_size_in_bytes": "alias_bytes",
+    # some jaxlib versions spell the dict keys without the suffix
+    "argument_bytes": "argument_bytes",
+    "output_bytes": "output_bytes",
+    "temp_bytes": "temp_bytes",
+    "generated_code_bytes": "generated_code_bytes",
+    "alias_bytes": "alias_bytes",
+}
+
+
+def compiled_memory(compiled):
+    """XLA's compile-time memory footprint for a ``jax.stages.Compiled``,
+    normalized.
+
+    Returns ``{"argument_bytes", "output_bytes", "temp_bytes",
+    "generated_code_bytes", ...}`` floats or ``None`` when the backend has
+    no buffer-assignment stats, the call raises, or nothing numeric comes
+    back — callers (``observe.memory`` via ``observe.ledger``) then mark
+    the predicted side of the footprint join unavailable instead of
+    crashing the audit.
+    """
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return None
+    if isinstance(mem, (list, tuple)):
+        mem = mem[0] if mem else None
+    if mem is None:
+        return None
+    out = {}
+    if isinstance(mem, dict):
+        items = mem.items()
+    else:
+        items = (
+            (name, getattr(mem, name, None)) for name in _MEMORY_FIELDS
+        )
+    for name, value in items:
+        field = _MEMORY_FIELDS.get(name)
+        if field is not None and isinstance(value, (int, float)):
+            out[field] = float(value)
+    return out or None

@@ -176,6 +176,14 @@ def train_loop(
     compression error) — the live plane's NaN-precursor feed. Off the hot
     path by construction: a distinct dispatch that reads state, never
     mutates it; cost documented in DESIGN.md "health sampling".
+
+    The same cadence drives an ``observe.memory.MemorySampler``: one
+    ``device.memory_stats()`` read per health interval, emitted as a
+    ``MemoryEvent`` (the live side of the memory observatory; needs no
+    ``health_fn``). On CPU the sampler disables itself after the first
+    empty read — zero events, zero log lines. If the step is a
+    ``GuardedStep`` without a sampler of its own, the loop attaches this
+    one so the OOM forensics report carries the last live sample.
     """
     import contextlib
 
@@ -202,6 +210,15 @@ def train_loop(
     logger = MetricsLogger(
         bits_per_step=step.bits_per_step, log_every=log_every, telemetry=telemetry
     )
+    memory_sampler = None
+    if health_every > 0 and telemetry is not None:
+        from ..observe.memory import MemorySampler
+
+        memory_sampler = MemorySampler(telemetry, label=run_name, rank=rank)
+        if getattr(step, "memory_sampler", False) is None:
+            # a GuardedStep (or compatible wrapper) constructed without a
+            # sampler: share this one so OOM forensics see the live feed
+            step.memory_sampler = memory_sampler
     audit_pending = audit
     trace_ctx = trace(trace_dir) if trace_dir else contextlib.nullcontext()
     # recording(telemetry) installs the ambient span recorder for the loop's
@@ -256,6 +273,15 @@ def train_loop(
                         loss = jax.device_get(loss)
                 logger.end_step(epoch, loss)
                 steps_done += 1
+                if (
+                    memory_sampler is not None
+                    and memory_sampler.enabled
+                    and logger._step % health_every == 0
+                ):
+                    # allocator read + one event emit; a backend without
+                    # memory_stats turns this into a permanent no-op
+                    with span("memory_probe", step=logger._step):
+                        memory_sampler.sample(logger._step)
                 health_fn = getattr(step, "health_fn", None)
                 if (
                     health_every > 0
@@ -577,7 +603,10 @@ def adaptive_train_loop(
 
     Live-plane hooks (PR 10): ``health_every > 0`` emits a
     ``TrainHealthEvent`` every N steps via the step's ``health_fn`` probe
-    (same contract as :func:`train_loop`). ``alert_feed`` (an
+    (same contract as :func:`train_loop`) and a ``MemoryEvent`` from the
+    shared ``observe.memory.MemorySampler`` on the same cadence — the
+    sampler is also handed to the inner ``GuardedStep`` (with the carry's
+    buffer-class sizes) so an OOM's post-mortem names its top suspect. ``alert_feed`` (an
     ``observe.live.AlertFeed`` tailing the run's ``alerts.jsonl``) is
     polled every step; each alert record is offered to
     ``controller.nudge`` — a critical or comm-shaped alert descends one
@@ -612,11 +641,41 @@ def adaptive_train_loop(
         telemetry=telemetry, rank=rank, label=run_name,
     )
 
+    memory_sampler = None
+    if health_every > 0 and telemetry is not None:
+        from ..observe.memory import MemorySampler
+
+        memory_sampler = MemorySampler(telemetry, label=run_name, rank=rank)
+
+    def _buffer_classes() -> Dict[str, float]:
+        # leaf shapes are static across steps, so the current carry's
+        # sizes ARE the live attribution — this runs only inside the OOM
+        # post-mortem, never on the hot path
+        from ..observe.memory import tree_bytes
+
+        return {
+            "params": float(tree_bytes(getattr(state, "params", None))),
+            "momenta": float(tree_bytes(getattr(state, "momenta", None))),
+            "ef_memory": float(tree_bytes(getattr(state, "memories", None))),
+            "reducer_state": float(
+                tree_bytes(getattr(state, "reducer_state", None))
+            ),
+            "model_state": float(
+                tree_bytes(getattr(state, "model_state", None))
+            ),
+        }
+
     def _guard(inner: CompiledStep):
+        from ..observe.memory import memory_footprint_fields
+
         return CommDeadlineGuard(
             GuardedStep(
                 inner, retries=step_retries, telemetry=telemetry,
-                label=run_name,
+                label=run_name, rank=rank, memory_sampler=memory_sampler,
+                footprint=memory_footprint_fields(
+                    getattr(inner, "compiled", None)
+                ) or None,
+                buffers_fn=_buffer_classes,
             ),
             watchdog, telemetry=telemetry, label=run_name, rank=rank,
         )
@@ -678,6 +737,13 @@ def adaptive_train_loop(
                         step_times.append(_time.monotonic() - t0)
                     logger.end_step(epoch, loss, bits=base.bits_per_step)
                     gstep += 1
+                    if (
+                        memory_sampler is not None
+                        and memory_sampler.enabled
+                        and gstep % health_every == 0
+                    ):
+                        with span("memory_probe", step=gstep):
+                            memory_sampler.sample(gstep)
                     health_fn = getattr(base, "health_fn", None)
                     if (
                         health_every > 0
@@ -931,10 +997,30 @@ def resilient_train_loop(
             incarnation=incarnation, telemetry=telemetry,
         )
     if step_retries > 0:
+        from ..observe.memory import tree_bytes
         from ..resilience.guards import GuardedStep
 
+        def _buffer_classes() -> Dict[str, float]:
+            # the restored/initial carry: leaf shapes never change across
+            # steps, so its sizes attribute the live state's bytes exactly
+            # (runs only inside the OOM post-mortem, never per step)
+            return {
+                "params": float(tree_bytes(getattr(state, "params", None))),
+                "momenta": float(tree_bytes(getattr(state, "momenta", None))),
+                "ef_memory": float(
+                    tree_bytes(getattr(state, "memories", None))
+                ),
+                "reducer_state": float(
+                    tree_bytes(getattr(state, "reducer_state", None))
+                ),
+                "model_state": float(
+                    tree_bytes(getattr(state, "model_state", None))
+                ),
+            }
+
         step = GuardedStep(
-            step, retries=step_retries, telemetry=telemetry, label=run_name
+            step, retries=step_retries, telemetry=telemetry, label=run_name,
+            rank=rank, buffers_fn=_buffer_classes,
         )
     if guard_batches:
         from ..resilience.guards import guarded_batches

@@ -47,8 +47,14 @@ that loop as a first-class subsystem instead of scattered fragments:
   resumable shard tailing, the supervisor-side aggregator, and the
   Prometheus-text ``/metrics`` exposition server.
 - :mod:`observe.health`    — EWMA streaming detectors (grad-norm spike,
-  loss plateau, step-time drift, bandwidth collapse, serving SLO burn)
-  emitting typed ``AlertEvent`` records back into the control plane.
+  loss plateau, step-time drift, bandwidth collapse, serving SLO burn,
+  HBM headroom) emitting typed ``AlertEvent`` records back into the
+  control plane.
+- :mod:`observe.memory`    — the device-memory plane: the compile-time
+  HBM footprint audit (``_jax_compat.compiled_memory`` joined onto
+  ``CompileEvent``), the live ``device.memory_stats()`` sampler emitting
+  typed ``MemoryEvent`` records, and the OOM post-mortem builder behind
+  ``artifacts/oom_report.json``.
 
 ``scripts/report.py`` turns a JSONL run log back into a human report
 (step-time percentiles, bytes/step by tag, compression ratio,
@@ -68,6 +74,7 @@ from . import (  # noqa: F401
     fabric,
     health,
     live,
+    memory,
     mfu,
     runlog,
     spans,
@@ -84,6 +91,7 @@ from .events import (  # noqa: F401
     FailureEvent,
     LoaderEvent,
     MarkerEvent,
+    MemoryEvent,
     MfuEvent,
     NoteEvent,
     PolicyEvent,

@@ -151,6 +151,17 @@ class CompileEvent(Event):
     flops_source: Optional[str] = None
     device_kind: Optional[str] = None
     peak_flops_per_s: Optional[float] = None
+    # compile-time HBM footprint (observe.memory via
+    # _jax_compat.compiled_memory): XLA's buffer-assignment split for the
+    # compiled executable — exact per-executable, the predicted side of the
+    # report's predicted-vs-measured memory join. All None when the backend
+    # exposes no memory_analysis (the join then marks prediction
+    # unavailable instead of vanishing).
+    argument_bytes: Optional[float] = None
+    output_bytes: Optional[float] = None
+    temp_bytes: Optional[float] = None
+    generated_code_bytes: Optional[float] = None
+    peak_hbm_bytes: Optional[float] = None  # the split's sum (predicted peak)
     # the comm knobs the step was compiled with (``reducer``,
     # ``reducer_rank``, ``comm_chunks``, ``comm_strategy``,
     # ``bucket_bytes``) — what lets the offline cost model
@@ -553,6 +564,33 @@ class TrainHealthEvent(Event):
     ef_memory_norm: float = 0.0
     powersgd_rel_error: Optional[float] = None
     loss: Optional[float] = None
+    rank: Optional[int] = None
+    label: str = ""
+
+
+@dataclass
+class MemoryEvent(Event):
+    """Periodic device-memory sample (:mod:`observe.memory`): the
+    allocator's view of HBM occupancy read from ``device.memory_stats()``
+    every ``--health-every`` steps, riding the same off-hot-path cadence
+    as :class:`TrainHealthEvent`. ``bytes_in_use`` / ``peak_bytes_in_use``
+    / ``bytes_limit`` are allocator-level numbers (see DESIGN.md's
+    guarantee classes: never bitwise, merge-tolerance across ranks) — the
+    MEASURED side of the report's predicted-vs-measured memory join, and
+    the input to the EWMA headroom detector (:mod:`observe.health`) whose
+    warn/critical verdicts are the OOM-precursor alert the supervisor and
+    FallbackController act on. All-None fields mean the backend exposes no
+    ``memory_stats`` (CPU) — the sampler degrades to silence rather than
+    spam. Silent on stdout; the live aggregator turns these into
+    ``live_hbm_bytes{rank=}`` gauges."""
+
+    KIND: ClassVar[str] = "memory"
+
+    step: int
+    bytes_in_use: Optional[float] = None
+    peak_bytes_in_use: Optional[float] = None
+    bytes_limit: Optional[float] = None
+    device_kind: str = ""
     rank: Optional[int] = None
     label: str = ""
 

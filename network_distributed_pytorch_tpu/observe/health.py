@@ -32,6 +32,12 @@ Thresholds (see DESIGN.md "Live telemetry" for the rationale):
 - ``slo_burn``: the rolling serving p99 total latency exceeds
   ``slo_target_s`` (budget burn, not mean shift — p99 comes from the
   registry's ring-buffer histogram, computed by the aggregator).
+- ``hbm_headroom``: the EWMA of the device-memory occupancy fraction
+  (``bytes_in_use / bytes_limit`` from :class:`observe.events.MemoryEvent`)
+  crosses ``headroom_warn_frac`` (warn) or ``headroom_critical_frac``
+  (critical) — the OOM *precursor* the supervisor and the
+  FallbackController can act on (e.g. nudging to a lower PowerSGD rank)
+  before the allocator dies.
 """
 
 from __future__ import annotations
@@ -108,6 +114,12 @@ class DetectorConfig:
     # serving p99 burn rate
     slo_target_s: float = 2.0
     slo_sustain: int = 3
+    # hbm headroom (occupancy fraction = bytes_in_use / bytes_limit)
+    headroom_alpha: float = 0.3
+    headroom_warn_frac: float = 0.85
+    headroom_critical_frac: float = 0.95
+    headroom_sustain: int = 2
+    headroom_min_obs: int = 2
     # shared
     cooldown: int = 20  # observations of silence after a fired alert
 
@@ -308,6 +320,45 @@ class SloBurnRateDetector(_Detector):
         return None
 
 
+class HbmHeadroomDetector(_Detector):
+    """OOM precursor: the EWMA of the occupancy FRACTION (bytes_in_use /
+    bytes_limit) approaching 1.0. Smoothed so one transient allocator
+    high-water sample does not page, but with a short horizon
+    (``headroom_alpha``) — memory exhaustion is fast and the alert must
+    lead the OOM, not eulogize it."""
+
+    name = "hbm_headroom"
+
+    def __init__(self, cfg: DetectorConfig):
+        super().__init__(cfg.headroom_sustain, cfg.cooldown)
+        self._cfg = cfg
+        self._ewma = Ewma(cfg.headroom_alpha)
+
+    def _check(self, value: float):
+        cfg = self._cfg
+        if not math.isfinite(value) or value < 0.0:
+            return None
+        self._ewma.update(value)
+        if self._ewma.n < cfg.headroom_min_obs:
+            return None
+        frac = self._ewma.mean or 0.0
+        if frac >= cfg.headroom_critical_frac:
+            return (
+                "critical",
+                cfg.headroom_critical_frac,
+                f"HBM {100 * frac:.1f}% of limit in use "
+                f"(>= {100 * cfg.headroom_critical_frac:g}% — OOM imminent)",
+            )
+        if frac >= cfg.headroom_warn_frac:
+            return (
+                "warn",
+                cfg.headroom_warn_frac,
+                f"HBM {100 * frac:.1f}% of limit in use "
+                f"(>= {100 * cfg.headroom_warn_frac:g}% headroom floor)",
+            )
+        return None
+
+
 class HealthMonitor:
     """The detector bank, keyed by signal. The aggregator routes each
     derived signal to :meth:`observe_*` as events stream in; every call
@@ -327,6 +378,7 @@ class HealthMonitor:
             Optional[Tuple[int, int]], BandwidthCollapseDetector
         ] = {}
         self._slo = SloBurnRateDetector(self.config)
+        self._hbm: Dict[Optional[int], HbmHeadroomDetector] = {}
         self.alerts: List[AlertEvent] = []
 
     def _keep(self, alert: Optional[AlertEvent]) -> List[AlertEvent]:
@@ -369,6 +421,29 @@ class HealthMonitor:
 
     def observe_serving_p99(self, value: float) -> List[AlertEvent]:
         return self._keep(self._slo.observe(value))
+
+    def observe_hbm(
+        self,
+        bytes_in_use: float,
+        bytes_limit: float,
+        rank: Optional[int] = None,
+        step: Optional[int] = None,
+    ) -> List[AlertEvent]:
+        """Per-rank OOM-precursor watch on the occupancy fraction. A
+        sample without a positive limit (CPU backends report none) is
+        dropped silently — the detector never learns a fake baseline."""
+        if (
+            not isinstance(bytes_limit, (int, float))
+            or not math.isfinite(float(bytes_limit))
+            or float(bytes_limit) <= 0.0
+        ):
+            return []
+        det = self._hbm.setdefault(rank, HbmHeadroomDetector(self.config))
+        return self._keep(
+            det.observe(
+                float(bytes_in_use) / float(bytes_limit), rank=rank, step=step
+            )
+        )
 
     def fired_by_kind(self) -> Dict[str, int]:
         out: Dict[str, int] = {}

@@ -294,6 +294,7 @@ def audit_compiled_step(step, *args, label: str = "train_step", telemetry=None) 
     config's audit flag."""
     from ..utils.hlo_audit import hlo_text_of_compiled
     from ..utils.overlap import overlap_report
+    from .memory import memory_footprint_fields
     from .spans import span
 
     ledger = getattr(step, "ledger", None)
@@ -335,6 +336,10 @@ def audit_compiled_step(step, *args, label: str = "train_step", telemetry=None) 
         **device_cost_fields(
             compiled, getattr(step, "flops_per_step", None)
         ),
+        # the compile-time HBM footprint split (observe.memory) — empty
+        # kwargs on backends without memory_analysis, so the predicted
+        # side of the memory join degrades to absent, never crashes
+        **memory_footprint_fields(compiled),
     )
     if telemetry is not None:
         for ce in ledger.collective_events(label):

@@ -268,6 +268,19 @@ def ingest_record(
                 registry.gauge(
                     metric, v, help=f"last sampled {field}", rank=rlabel
                 )
+    elif kind == "memory":
+        for field, metric in (
+            ("bytes_in_use", "live_hbm_bytes"),
+            ("peak_bytes_in_use", "live_hbm_peak_bytes"),
+            ("bytes_limit", "live_hbm_limit_bytes"),
+        ):
+            v = rec.get(field)
+            if isinstance(v, (int, float)):
+                registry.gauge(
+                    metric, v,
+                    help=f"device memory {field} (allocator view)",
+                    rank=rlabel,
+                )
     elif kind == "request":
         registry.counter(
             "live_serving_requests_total",
@@ -602,6 +615,15 @@ class LiveAggregator:
             if isinstance(gn, (int, float)):
                 fired += self.monitor.observe_grad_norm(
                     float(gn), rank=r, step=rec.get("step")
+                )
+        elif kind == "memory":
+            in_use = rec.get("bytes_in_use")
+            limit = rec.get("bytes_limit")
+            if isinstance(in_use, (int, float)) and isinstance(
+                limit, (int, float)
+            ):
+                fired += self.monitor.observe_hbm(
+                    float(in_use), float(limit), rank=r, step=rec.get("step")
                 )
         return self._fire(fired)
 

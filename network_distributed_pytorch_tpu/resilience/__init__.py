@@ -42,6 +42,13 @@ quorum restart planner (``plan_mesh`` — classify deaths in a window as
 correlated vs independent, restart the survivors at the largest viable
 mesh), and the typed ``CheckpointUnwritableError`` fail-fast path.
 
+Memory observatory extensions: the ``oom`` chaos fault
+(``ChaosOutOfMemoryError``, shaped like the real ``RESOURCE_EXHAUSTED``),
+and ``GuardedStep``'s OOM forensics trap — detect by message, dump the
+ranked post-mortem to ``artifacts/oom_report.json`` via
+``observe.memory``, and re-raise as the non-retryable
+``OutOfMemoryError``.
+
 The whole package is jax-free at import time (the supervisor parent
 process never initializes a backend; workers do — reshard/guards import
 jax lazily inside the functions that touch pytrees).
@@ -56,9 +63,11 @@ from .chaos import (  # noqa: F401
     FAULT_KINDS,
     INJECTION_SITES,
     LOADER_FAULTS,
+    MEMORY_FAULTS,
     PREEMPT_EXIT_CODE,
     PROCESS_FAULTS,
     STEP_FAULTS,
+    ChaosOutOfMemoryError,
     ChaosPlan,
     ChaosStep,
     ChaosTransientError,
@@ -86,9 +95,11 @@ from .guards import (  # noqa: F401
     CommEscalationError,
     GuardedStep,
     NonFiniteLossError,
+    OutOfMemoryError,
     PreemptionGuard,
     derive_collective_deadline,
     guarded_batches,
+    is_oom_error,
 )
 from .reshard import (  # noqa: F401
     MESH_AXES,

@@ -47,6 +47,11 @@ Fault kinds and where they bite:
                        by ``factor`` (default 1000) — an optimizer blow-up
                        precursor the live plane's EWMA spike detector must
                        catch and alert on (observe.health)
+``oom``                the step dies with a ``RESOURCE_EXHAUSTED``-shaped
+                       allocator error (HBM exhausted mid-step) — the
+                       guarded step's OOM forensics path must dump
+                       ``artifacts/oom_report.json`` before the process
+                       exits (observe.memory)
 ==================  =========================================================
 
 Process- and step-level faults carry an ``incarnation`` filter (default 0)
@@ -90,9 +95,13 @@ CORRELATED_FAULTS = ("zone_outage", "host_flap")
 # / observe.fabric) can be verified end to end against a known-slow link.
 COMM_FAULTS = ("comm_throttle", "comm_stall", "comm_flap", "comm_slow_edge")
 HEALTH_FAULTS = ("grad_spike",)
+# memory faults bite at the step boundary like STEP_FAULTS, but are their
+# own group so jax-free workers (the toy game-day worker) can pop them
+# without also claiming the transient/NaN kinds
+MEMORY_FAULTS = ("oom",)
 FAULT_KINDS = (
     LOADER_FAULTS + STEP_FAULTS + CHECKPOINT_FAULTS + PROCESS_FAULTS
-    + CORRELATED_FAULTS + COMM_FAULTS + HEALTH_FAULTS
+    + CORRELATED_FAULTS + COMM_FAULTS + HEALTH_FAULTS + MEMORY_FAULTS
 )
 
 # The registry the satellite asks for: every fault kind names the ONE
@@ -121,6 +130,7 @@ INJECTION_SITES: Dict[str, str] = {
     "comm_flap": "comm-hook",           # CommFaultInjector fence hook
     "comm_slow_edge": "comm-hook",      # CommFaultInjector fence hook
     "grad_spike": "health-probe",       # health sampler (TrainHealthEvent)
+    "oom": "step",                      # ChaosStep (allocator-death branch)
 }
 
 
@@ -160,6 +170,14 @@ CKPT_UNWRITABLE_EXIT_CODE = 44
 class ChaosTransientError(RuntimeError):
     """The injected transient fault: a ``RuntimeError`` so the stock
     ``retry_transient`` path treats it exactly like a real blip."""
+
+
+class ChaosOutOfMemoryError(RuntimeError):
+    """The injected allocator death. A ``RuntimeError`` whose message is
+    ``RESOURCE_EXHAUSTED``-shaped so the guarded step's OOM detection
+    (which matches the real ``XlaRuntimeError`` by message, since jax's
+    OOM IS a RuntimeError) treats it exactly like the real thing — dump
+    forensics, then die, never retry."""
 
 
 @dataclass
@@ -330,7 +348,7 @@ class ChaosStep:
         i = self._step_index
         self._step_index += 1
         spec = self._plan.pop(
-            STEP_FAULTS + PROCESS_FAULTS + CORRELATED_FAULTS,
+            STEP_FAULTS + PROCESS_FAULTS + CORRELATED_FAULTS + MEMORY_FAULTS,
             i, self._rank, self._incarnation,
         )
         if spec is not None:
@@ -367,6 +385,13 @@ class ChaosStep:
                 # a NaN gradient burst as the guard sees it: the reported
                 # loss is non-finite and the state must not advance
                 return state, float("nan")
+            if spec.kind == "oom":
+                want = int(spec.payload.get("bytes", 1 << 30))
+                raise ChaosOutOfMemoryError(
+                    f"RESOURCE_EXHAUSTED: Out of memory while trying to "
+                    f"allocate {want} bytes (injected at step {i}, "
+                    f"rank {self._rank})"
+                )
         return self._inner(state, batch)
 
 

@@ -9,6 +9,14 @@ Two wrappers, both opt-in from ``resilient_train_loop``:
   non-finite (NaN gradient burst) WITHOUT advancing state, re-running it
   instead. Requires the wrapped step to have been built with
   ``donate_state=False`` — a donated input buffer cannot be replayed.
+  A ``RESOURCE_EXHAUSTED`` error is the one ``RuntimeError`` it does NOT
+  retry: replaying an allocation that just killed the allocator only
+  reproduces the corpse. Instead the guard dumps the OOM post-mortem
+  (``observe.memory.build_oom_report`` → ``artifacts/oom_report.json``:
+  last live memory sample, compile-time footprint split, ranked
+  buffer-class attribution) and re-raises as :class:`OutOfMemoryError`,
+  which is deliberately not a ``RuntimeError`` so ``retry_transient``
+  cannot swallow it.
 - :func:`guarded_batches` — drops loader output that would poison the run:
   non-finite values or a leading dim that disagrees with the expected
   global batch (a short batch would either recompile or silently skew the
@@ -68,6 +76,32 @@ class CommEscalationError(Exception):
     Deliberately NOT a ``RuntimeError``: :class:`GuardedStep` /
     ``retry_transient`` catch ``RuntimeError``, and an escalation must
     propagate past them to the worker's top level."""
+
+
+class OutOfMemoryError(Exception):
+    """The device allocator died (``RESOURCE_EXHAUSTED``) under the
+    guarded step. Deliberately NOT a ``RuntimeError`` — jax surfaces its
+    OOM as ``XlaRuntimeError`` (a ``RuntimeError``), which
+    ``retry_transient`` would happily replay, and replaying an allocation
+    that just exhausted the device reproduces the failure at best and
+    corrupts the run's timeline at worst. :class:`GuardedStep` detects
+    the OOM by message, writes the forensics report, then raises this so
+    the failure propagates straight to the worker's top level."""
+
+
+# the message shapes jax's allocator death arrives in — XlaRuntimeError
+# carries the XLA status name; some backends spell the prose form only
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory")
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    """Whether a raised exception is a device out-of-memory, by message:
+    jax's ``XlaRuntimeError`` IS a ``RuntimeError`` (no dedicated type to
+    ``isinstance`` against), so the status string is the only stable
+    signal — and the injected ``ChaosOutOfMemoryError`` is shaped to
+    match it exactly."""
+    text = str(exc)
+    return any(marker in text for marker in _OOM_MARKERS)
 
 
 class CheckpointUnwritableError(OSError):
@@ -424,7 +458,17 @@ class PreemptionGuard:
 
 class GuardedStep:
     """Retry-on-transient + non-finite-loss rejection around a compiled
-    step. Attribute access delegates to the wrapped step."""
+    step, plus the OOM forensics trap. Attribute access delegates to the
+    wrapped step.
+
+    The optional memory-observability hooks feed the post-mortem:
+    ``memory_sampler`` (an ``observe.memory.MemorySampler``; its last
+    sample becomes the report's live side), ``footprint`` (the
+    compile-time split dict from ``observe.memory.memory_footprint_fields``),
+    and ``buffers_fn`` (a zero-arg callable returning
+    ``{buffer_class: bytes}`` — params / EF memory / serving slots — so
+    the report names the top suspect). All default to None: the guard
+    still detects the OOM and writes a minimal report without them."""
 
     def __init__(
         self,
@@ -435,6 +479,11 @@ class GuardedStep:
         jitter: float = 0.1,
         telemetry: Any = None,
         label: str = "step",
+        rank: int = 0,
+        memory_sampler: Any = None,
+        footprint: Optional[Dict] = None,
+        buffers_fn: Optional[Callable[[], Dict[str, float]]] = None,
+        oom_report_path: Optional[str] = None,
     ):
         self._inner = step
         self.retries = retries
@@ -443,9 +492,63 @@ class GuardedStep:
         self.jitter = jitter
         self._telemetry = telemetry
         self._label = label
+        self._rank = rank
+        self.memory_sampler = memory_sampler
+        self.footprint = footprint
+        self._buffers_fn = buffers_fn
+        self._oom_report_path = oom_report_path
+        self._step_index = 0
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
+
+    def _oom(self, exc: BaseException) -> "OutOfMemoryError":
+        """Build + persist the post-mortem, emit the failure event, and
+        return the non-retryable exception for the caller to raise. Every
+        forensics step is best-effort — the process is dying either way,
+        and a broken report path must not mask the real OOM."""
+        from ..observe.memory import build_oom_report, write_oom_report
+
+        last = getattr(self.memory_sampler, "last", None)
+        buffers = None
+        if self._buffers_fn is not None:
+            try:
+                buffers = self._buffers_fn()
+            except Exception:
+                buffers = None
+        report = build_oom_report(
+            error=str(exc),
+            label=self._label,
+            rank=self._rank,
+            step=self._step_index,
+            last_memory=last.record() if last is not None else None,
+            footprint=self.footprint,
+            buffers=buffers,
+        )
+        try:
+            path = write_oom_report(report, self._oom_report_path)
+        except OSError:
+            path = None
+        if self._telemetry is not None:
+            from ..observe import FailureEvent
+
+            self._telemetry.emit(
+                FailureEvent(
+                    kind="oom",
+                    label=self._label,
+                    rank=self._rank,
+                    step=self._step_index,
+                    message=(
+                        f"device out of memory"
+                        f" (top buffer: {report['top_buffer'] or 'unknown'};"
+                        f" forensics: {path or 'unwritable'})"
+                    ),
+                )
+            )
+        return OutOfMemoryError(
+            f"{self._label}: device out of memory at step "
+            f"{self._step_index}; forensics at {path or '<unwritable>'}"
+        )
 
     def __call__(self, state, batch):
         import jax
@@ -455,27 +558,37 @@ class GuardedStep:
         from ..utils.failure import retry_transient
 
         def attempt():
-            new_state, loss = self._inner(state, batch)
-            # forces the step to completion; a non-finite loss means the
-            # update that produced it is poison — discard new_state and
-            # let retry re-run from the (non-donated) inputs
-            host_loss = float(jax.device_get(loss))
+            try:
+                new_state, loss = self._inner(state, batch)
+                # forces the step to completion; a non-finite loss means
+                # the update that produced it is poison — discard
+                # new_state and let retry re-run from the (non-donated)
+                # inputs. device_get is inside the try because async
+                # dispatch surfaces allocator deaths here, not at launch
+                host_loss = float(jax.device_get(loss))
+            except RuntimeError as err:
+                if is_oom_error(err):
+                    raise self._oom(err) from err
+                raise
             if not math.isfinite(host_loss):
                 raise NonFiniteLossError(
                     f"{self._label}: non-finite loss {host_loss}"
                 )
             return new_state, loss
 
-        return retry_transient(
-            attempt,
-            retries=self.retries,
-            backoff_seconds=self.backoff_seconds,
-            max_backoff_seconds=self.max_backoff_seconds,
-            jitter=self.jitter,
-            exceptions=(RuntimeError,),
-            telemetry=self._telemetry,
-            label=self._label,
-        )
+        try:
+            return retry_transient(
+                attempt,
+                retries=self.retries,
+                backoff_seconds=self.backoff_seconds,
+                max_backoff_seconds=self.max_backoff_seconds,
+                jitter=self.jitter,
+                exceptions=(RuntimeError,),
+                telemetry=self._telemetry,
+                label=self._label,
+            )
+        finally:
+            self._step_index += 1
 
 
 def guarded_batches(

@@ -284,6 +284,24 @@ class SlotEngine:
             self.slots[s] = None
         return evicted
 
+    @property
+    def cache_bytes(self) -> int:
+        """Device bytes of the whole slot-batched KV cache — allocated up
+        front for the engine's lifetime, independent of occupancy."""
+        from ..observe.memory import tree_bytes
+
+        return tree_bytes(self.cache)
+
+    @property
+    def occupied_cache_bytes(self) -> int:
+        """The occupancy-weighted share of the KV cache: the bytes the
+        ACTIVE slots pin (the rest is pre-allocated headroom a smaller
+        ``n_slots`` would return to the allocator) — the serving entry in
+        the memory observatory's buffer-class attribution."""
+        if self.n_slots == 0:
+            return 0
+        return (self.cache_bytes * self.n_active) // self.n_slots
+
     def stats(self) -> Dict:
         return {
             "n_slots": self.n_slots,
@@ -291,4 +309,8 @@ class SlotEngine:
             "prefills": self.prefills,
             "active": self.n_active,
             "queued": self.queue_len,
+            # device-memory attribution (observe.memory): total KV-cache
+            # allocation and the active slots' share of it
+            "kv_cache_bytes": self.cache_bytes,
+            "kv_occupied_bytes": self.occupied_cache_bytes,
         }

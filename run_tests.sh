@@ -62,6 +62,10 @@ set -e
 # fabric toy runs calibrate scripts/plan.py's offline cost model, the
 # predicted-best config must beat the measured default when replayed, and
 # the gate reads the model's own costmodel_error against its 25% ceiling.
+# The ninth phase is the memory game day: a headroom precursor alert must
+# fire before a chaos oom, the rank's post-mortem must name the top
+# buffer class in artifacts/oom_report.json, and a doubled-footprint
+# rerun must trip the hbm_peak_bytes gate.
 # Advisory because shared CI boxes have
 # noisy step times; run gate.py without --advisory on dedicated perf
 # hardware to make it blocking.

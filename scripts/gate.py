@@ -40,11 +40,23 @@ Metrics compared (only those present in BOTH report and baseline):
 - ``critpath_comm_share``    lower is better (report ``critpath`` section —
   share of the cross-rank critical path spent blocked in collective-wait,
   from the observe.critpath analyzer)
+- ``hbm_peak_bytes``         lower is better (report ``memory`` section —
+  the memory observatory's peak device-memory scalar: the live sampler's
+  measured peak when ``memory_stats`` exists, the compile-time predicted
+  peak otherwise; a fatter footprint is a regression even when throughput
+  holds)
 
 A metric the current report carries but a stale baseline does not gets a
 clearly-labeled ``missing_baseline`` ADVISORY verdict (never a
 regression): adding a gate metric must never brick CI on an older
 ``GATE_BASELINE.json``.
+
+Device provenance: a report produced on ``cpu`` must not silently satisfy
+a baseline recorded on a real chip (every relative comparison would be
+noise). When both sides carry a platform (bench attestation ``platform``,
+or a report's compile-time ``device_kind``) and they differ, the gate
+emits a loud ``device_mismatch`` verdict — advisory by default so local
+CPU probes keep passing, a real regression under ``--strict-device``.
 
 Span time shares (report ``spans.by_name[*].share``) are compared
 separately when both sides carry them: a span name whose share of run
@@ -122,6 +134,12 @@ METRICS: Dict[str, str] = {
     # value (fully compute-bound run), so 0 records like alerts_fired; a
     # growing share means stragglers/slow edges started gating steps
     "critpath_comm_share": "lower",
+    # peak device memory (report ``memory.hbm_peak_bytes``: measured
+    # allocator peak when the live sampler ran, compile-time predicted
+    # peak otherwise) — a model/config change that fattens the footprint
+    # is a regression even while throughput metrics hold (the OOM you
+    # haven't hit yet)
+    "hbm_peak_bytes": "lower",
 }
 
 # the calibration bound DESIGN.md states for cost-model predictions: a
@@ -219,6 +237,17 @@ def extract_metrics(doc: Dict) -> Dict[str, float]:
     v = doc.get("critpath_comm_share")
     if isinstance(v, (int, float)) and v == v and v >= 0:
         out.setdefault("critpath_comm_share", float(v))
+    # peak device memory: nested under the report's "memory" section
+    # (measured peak when the sampler ran, predicted peak otherwise —
+    # memory_summary picks), flat in bench baselines
+    mem = doc.get("memory")
+    if isinstance(mem, dict):
+        v = mem.get("hbm_peak_bytes")
+        if isinstance(v, (int, float)) and v == v and v > 0:
+            out["hbm_peak_bytes"] = float(v)
+    v = doc.get("hbm_peak_bytes")
+    if isinstance(v, (int, float)) and v == v and v > 0:
+        out.setdefault("hbm_peak_bytes", float(v))
     return out
 
 
@@ -456,6 +485,49 @@ def costmodel_target_verdict(
     ]
 
 
+def _platform_of(doc: Dict) -> Optional[str]:
+    """Best-effort device provenance of a report/baseline: the bench
+    attestation ``platform`` (or a hand-recorded ``device``) wins; a run
+    report falls back to the compile-time ``device_kind`` its MFU records
+    carry. None when nothing attests — provenance is then unknowable and
+    the mismatch check stays silent."""
+    for key in ("platform", "device"):
+        v = doc.get(key)
+        if isinstance(v, str) and v.strip():
+            return v.strip().lower()
+    mfu = doc.get("mfu")
+    if isinstance(mfu, list):
+        for m in mfu:
+            dk = m.get("device_kind") if isinstance(m, dict) else None
+            if isinstance(dk, str) and dk.strip():
+                return dk.strip().lower()
+    return None
+
+
+def device_mismatch_verdict(
+    report: Dict, baseline_doc: Dict, strict: bool
+) -> List[Dict]:
+    """The provenance guard: a ``cpu`` report quietly 'passing' a chip
+    baseline is the gate lying to CI — every relative comparison crosses
+    hardware. Loud advisory verdict when the attested platforms differ;
+    ``--strict-device`` promotes it to a real regression."""
+    rp, bp = _platform_of(report), _platform_of(baseline_doc)
+    if rp is None or bp is None or rp == bp:
+        return []
+    return [
+        {
+            "metric": "device_mismatch",
+            "direction": "match",
+            "current": rp,
+            "baseline": bp,
+            "limit": None,
+            "ratio": None,
+            "regressed": bool(strict),
+            "device_mismatch": True,
+        }
+    ]
+
+
 def compare_span_shares(
     current: Dict[str, float], baseline: Dict[str, float], tolerance: float
 ) -> List[Dict]:
@@ -506,6 +578,11 @@ def main(argv=None) -> int:
         help="report regressions but always exit 0 (CI-on-shared-hardware mode)",
     )
     parser.add_argument(
+        "--strict-device", action="store_true",
+        help="fail (not just warn) when the report and baseline attest"
+             " different device platforms",
+    )
+    parser.add_argument(
         "--root", default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         help="repo root for baseline discovery (BENCH_r*.json, artifacts/)",
     )
@@ -533,6 +610,9 @@ def main(argv=None) -> int:
     verdicts.extend(data_load_share_verdict(current, report, baseline_doc))
     verdicts.extend(costmodel_target_verdict(current, report, baseline_doc))
     verdicts.extend(
+        device_mismatch_verdict(report, baseline_doc, args.strict_device)
+    )
+    verdicts.extend(
         compare_span_shares(
             extract_span_shares(report),
             extract_span_shares(baseline_doc),
@@ -551,6 +631,17 @@ def main(argv=None) -> int:
 
     regressions = [v for v in verdicts if v["regressed"]]
     for v in verdicts:
+        if v.get("device_mismatch"):
+            # current/baseline are platform STRINGS here — must not reach
+            # the numeric formatting below
+            status = "REGRESSED" if v["regressed"] else "advisory"
+            _say(
+                f"device_mismatch: report ran on '{v['current']}' but the"
+                f" baseline attests '{v['baseline']}' — every relative"
+                f" comparison above crosses hardware -> {status}"
+                + ("" if v["regressed"] else " (pass --strict-device to fail)")
+            )
+            continue
         if v.get("missing_baseline"):
             _say(
                 f"{v['metric']}: current {v['current']:.6g} has no entry in"

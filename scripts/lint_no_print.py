@@ -47,6 +47,11 @@ SCRIPT_ALLOWED = {
 # - telemetry.py: Telemetry.emit stamps ``ts`` — the cross-rank join key
 #   the runlog merger aligns shards by, which MUST be wall clock
 # - runlog.py: the manifest's ``created_unix`` provenance stamp
+# Every other observe/ module is covered by the path rule below with NO
+# carve-out — observe/memory.py in particular is deliberately clock-free
+# (MemoryEvents are stamped by Telemetry.emit like everything else, and
+# the sampler keys off step indices, not timers), so adding a timer there
+# fails this lint by design.
 MONO_ALLOWED = {"telemetry.py", "runlog.py"}
 
 # function-scoped allowances: files covered by the clock lint where ONE

@@ -653,6 +653,20 @@ def render_report(events: List[Dict], name: str = "", skipped_lines: int = 0) ->
                 f"({comp.get('flops_source', '?')}) on "
                 f"{comp.get('device_kind') or 'unknown device'}{peak_txt}{ba_txt}"
             )
+        if comp.get("peak_hbm_bytes") is not None:
+            split = ", ".join(
+                f"{name[: -len('_bytes')]} {_fmt_bytes(comp[name])}"
+                for name in (
+                    "argument_bytes", "output_bytes", "temp_bytes",
+                    "generated_code_bytes",
+                )
+                if comp.get(name) is not None
+            )
+            lines.append(
+                f"  HBM footprint: predicted peak "
+                f"{_fmt_bytes(comp['peak_hbm_bytes'])}"
+                + (f" ({split})" if split else "")
+            )
         ov = comp.get("overlap") or {}
         if ov:
             if ov.get("scheduled"):
@@ -1120,9 +1134,166 @@ def render_critpath_section(
     return lines
 
 
+# the compile-time HBM footprint fields the memory join reads off the
+# last CompileEvent (observe.memory attaches them on real backends; the
+# toy worker stamps them by fiat)
+_FOOTPRINT_KEYS = (
+    "argument_bytes",
+    "output_bytes",
+    "temp_bytes",
+    "generated_code_bytes",
+    "peak_hbm_bytes",
+)
+
+
+def memory_summary(
+    compile_events: List[Dict], memory_events: List[Dict]
+) -> Dict:
+    """The report's memory section: compile-time predicted peak joined
+    with the live measured peak per rank. ALWAYS returns a section —
+    a CPU run degrades to predicted-present / measured-unavailable, it
+    never vanishes (the gate and bench read ``hbm_peak_bytes`` from
+    here: measured when the sampler ran, predicted otherwise)."""
+    predicted = None
+    if compile_events:
+        last = compile_events[-1]
+        fields = {
+            k: float(last[k])
+            for k in _FOOTPRINT_KEYS
+            if isinstance(last.get(k), (int, float))
+        }
+        if fields:
+            predicted = fields
+    per_rank: Dict[int, Dict] = {}
+    for e in memory_events:
+        r = e.get("rank")
+        r = int(r) if isinstance(r, (int, float)) else -1
+        cur = per_rank.setdefault(
+            r,
+            {
+                "samples": 0,
+                "last_bytes_in_use": None,
+                "peak_bytes_in_use": None,
+                "bytes_limit": None,
+                "device_kind": "",
+            },
+        )
+        cur["samples"] += 1
+        in_use = e.get("bytes_in_use")
+        if isinstance(in_use, (int, float)):
+            cur["last_bytes_in_use"] = float(in_use)
+        peak = e.get("peak_bytes_in_use")
+        peak = peak if isinstance(peak, (int, float)) else in_use
+        if isinstance(peak, (int, float)):
+            cur["peak_bytes_in_use"] = max(
+                cur["peak_bytes_in_use"] or 0.0, float(peak)
+            )
+        limit = e.get("bytes_limit")
+        if isinstance(limit, (int, float)):
+            cur["bytes_limit"] = float(limit)
+        if e.get("device_kind"):
+            cur["device_kind"] = str(e["device_kind"])
+    measured = None
+    if per_rank:
+        peaks = [
+            v["peak_bytes_in_use"]
+            for v in per_rank.values()
+            if v["peak_bytes_in_use"] is not None
+        ]
+        limits = [
+            v["bytes_limit"]
+            for v in per_rank.values()
+            if v["bytes_limit"] is not None
+        ]
+        peak = max(peaks) if peaks else None
+        limit = max(limits) if limits else None
+        measured = {
+            "per_rank": {str(r): per_rank[r] for r in sorted(per_rank)},
+            "peak_bytes_in_use": peak,
+            "bytes_limit": limit,
+            "headroom_frac": (
+                1.0 - peak / limit if peak is not None and limit else None
+            ),
+        }
+    hbm_peak = (
+        measured["peak_bytes_in_use"]
+        if measured and measured["peak_bytes_in_use"] is not None
+        else (predicted or {}).get("peak_hbm_bytes")
+    )
+    return {
+        "predicted": predicted,
+        "measured": measured,
+        "measured_available": measured is not None,
+        "hbm_peak_bytes": hbm_peak,
+        "hbm_peak_source": (
+            "measured" if measured else ("predicted" if predicted else None)
+        ),
+    }
+
+
+def render_memory_section(memory: Dict) -> List[str]:
+    """The human face of :func:`memory_summary` — rendered even when both
+    planes are empty, so a missing memory plane is visible, not silent."""
+    lines = ["", "memory", "------"]
+    predicted = memory.get("predicted")
+    if predicted:
+        split = ", ".join(
+            f"{k[: -len('_bytes')]} {_fmt_bytes(predicted[k])}"
+            for k in _FOOTPRINT_KEYS[:-1]
+            if predicted.get(k) is not None
+        )
+        peak = predicted.get("peak_hbm_bytes")
+        lines.append(
+            "  predicted peak (compile-time footprint): "
+            + (_fmt_bytes(peak) if peak is not None else "n/a")
+            + (f"  ({split})" if split else "")
+        )
+    else:
+        lines.append(
+            "  predicted peak: unavailable (backend exposes no"
+            " memory_analysis)"
+        )
+    measured = memory.get("measured")
+    if measured:
+        for r, v in sorted(
+            measured["per_rank"].items(), key=lambda kv: int(kv[0])
+        ):
+            peak = v.get("peak_bytes_in_use")
+            limit = v.get("bytes_limit")
+            frac = (
+                f"  ({100 * peak / limit:.1f}% of"
+                f" {_fmt_bytes(limit)} limit)"
+                if peak is not None and limit
+                else ""
+            )
+            lines.append(
+                f"  rank {r}: peak "
+                + (_fmt_bytes(peak) if peak is not None else "n/a")
+                + f" over {v.get('samples', 0)} samples"
+                + (f" on {v['device_kind']}" if v.get("device_kind") else "")
+                + frac
+            )
+        hf = measured.get("headroom_frac")
+        if hf is not None:
+            lines.append(f"  headroom at peak: {100 * hf:.1f}%")
+    else:
+        lines.append(
+            "  measured: unavailable (no memory_stats on this backend —"
+            " the sampler no-ops on CPU)"
+        )
+    src = memory.get("hbm_peak_source")
+    peak = memory.get("hbm_peak_bytes")
+    if peak is not None:
+        lines.append(
+            f"  hbm_peak_bytes (gate scalar): {_fmt_bytes(peak)} [{src}]"
+        )
+    return lines
+
+
 # Chrome-trace lanes, one pid per rank (Perfetto renders pid -1, the
 # supervisor, as its own process track)
 _TID_SPANS, _TID_STEPS, _TID_COLLECTIVES, _TID_FAILURES = 0, 1, 2, 3
+_TID_MEMORY = 4
 
 
 def chrome_trace(events: List[Dict]) -> Dict:
@@ -1193,6 +1364,21 @@ def chrome_trace(events: List[Dict]) -> Dict:
                 "pid": pid, "tid": _TID_FAILURES, "ts": us(e["t_run"]),
                 "args": {"message": e.get("message")},
             })
+        elif kind == "memory" and isinstance(
+            e.get("bytes_in_use"), (int, float)
+        ):
+            # a Perfetto counter track per rank: device bytes in use over
+            # run time (the limit rides along as a second series so the
+            # headroom squeeze is visible on the same track)
+            pids[pid] = "supervisor" if pid < 0 else f"rank {pid}"
+            args = {"bytes_in_use": e["bytes_in_use"]}
+            if isinstance(e.get("bytes_limit"), (int, float)):
+                args["bytes_limit"] = e["bytes_limit"]
+            trace_events.append({
+                "ph": "C", "cat": "memory", "name": "HBM bytes",
+                "pid": pid, "tid": _TID_MEMORY, "ts": us(e["t_run"]),
+                "args": args,
+            })
     # Perfetto flow arrows across rank tracks at each collective: every
     # step's exposed-comm slices are ring-chained rank r -> rank r+1 (the
     # same (src, dst) charging the fabric matrix uses), so the UI draws
@@ -1244,6 +1430,7 @@ def chrome_trace(events: List[Dict]) -> Dict:
         for tid, tname in (
             (_TID_SPANS, "spans"), (_TID_STEPS, "steps"),
             (_TID_COLLECTIVES, "collectives"), (_TID_FAILURES, "failures"),
+            (_TID_MEMORY, "memory"),
         ):
             meta.append({
                 "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
@@ -1339,6 +1526,9 @@ def run_report(
         )
     )
     sections.extend(render_mfu_section(mfu_records))
+    memory_events = [e for e in merged.events if e.get("event") == "memory"]
+    memory = memory_summary(compile_events, memory_events)
+    sections.extend(render_memory_section(memory))
     comm_buckets = bucket_attribution(bandwidth, overlap)
     sections.extend(render_bucket_section(comm_buckets))
     sections.extend(
@@ -1474,6 +1664,11 @@ def run_report(
         # per-request serving SLOs (None when the run served nothing);
         # the gate's serving scalar is slo.p99_decode_ms_per_token
         "slo": slo_summary_from_events(merged.events),
+        # the memory observatory's join: compile-time predicted peak vs
+        # the live sampler's measured peak per rank — ALWAYS present (a
+        # CPU run keeps predicted and marks measured unavailable); the
+        # gate's scalar is memory.hbm_peak_bytes (lower = leaner)
+        "memory": memory,
     }
     return text, report
 
@@ -1495,6 +1690,7 @@ _COMPARE_ROWS = (
     ("bandwidth.total.payload_bytes", "bytes/step", _fmt_bytes),
     ("bandwidth.total.achieved_bytes_per_s", "achieved bw", _fmt_rate),
     ("mfu_headline", "MFU headline", lambda v: f"{v:.4f}"),
+    ("memory.hbm_peak_bytes", "HBM peak", _fmt_bytes),
     ("alerts.fired", "alerts fired", lambda v: f"{v:.0f}"),
     ("policy.descends", "policy descends", lambda v: f"{v:.0f}"),
     ("recovery_latency_s", "recovery latency", lambda v: f"{v:.2f} s"),

@@ -50,6 +50,16 @@ gating edge and per-step (rank, phase) verdicts, the straggler record's
 bottleneck — while the exported trace carries cross-rank
 collective-flow arrows and ``gate.py`` reads ``critpath_comm_share``.
 
+A ninth phase is the MEMORY game day: a 2-rank run with the health
+sampler on ramps synthetic device-memory occupancy toward the toy HBM
+limit; the supervisor-side headroom detector must fire an
+``hbm_headroom`` precursor alert BEFORE a chaos ``oom`` kills rank 1,
+the rank's post-mortem (``artifacts/oom_report.json``) must rank the
+buffer classes and name the top one, the merged report must carry the
+memory section with a MEASURED peak, and a rerun with the footprint
+doubled (``--hbm-mult 2.0``) gated against the first run's peak must
+make ``gate.py`` exit nonzero on ``hbm_peak_bytes``.
+
 A third phase supervises a 2-rank spool-SERVING fleet
 (``tests/toy_serving_worker.py`` over the real ``serving/`` request
 lifecycle + FileSpool) into ``artifacts/toy_run_serve/``: rank 1 kills
@@ -1173,6 +1183,197 @@ def main(argv=None) -> int:
         f" analyzer, straggler record, and matrix bottleneck;"
         f" measured {slow / 1e6:.1f} MB/s vs healthy {healthy / 1e6:.1f}"
         f" MB/s; comm share {share:.0%}) report -> {crit_json}\n"
+    )
+
+    # --- phase 9: the memory game day ------------------------------------
+    # A 2-rank run with the health sampler on: synthetic MemoryEvents ramp
+    # toward the toy HBM limit, so the supervisor-side HbmHeadroomDetector
+    # must fire an ``hbm_headroom`` precursor alert BEFORE a chaos ``oom``
+    # kills rank 1 at step 12 — then the rank's post-mortem
+    # (artifacts/oom_report.json) must name the top buffer class, the
+    # merged report must carry the memory section with a MEASURED peak,
+    # and a second run with ``--hbm-mult 2.0`` (the model doubled) gated
+    # against the first run's peak must make gate.py exit NONZERO on
+    # hbm_peak_bytes — the whole precursor -> forensics -> gate loop.
+    from network_distributed_pytorch_tpu.observe.memory import (
+        OOM_REPORT_NAME,
+    )
+
+    mem_dir = run_dir + "_memory"
+    shutil.rmtree(mem_dir, ignore_errors=True)
+    os.makedirs(mem_dir, exist_ok=True)
+    mem_steps = 16
+    oom_step = 12  # the EWMA warn precursor lands around sample 6
+    mem_step_s = max(args.step_seconds, 0.03)  # alert must land mid-run
+    mem_plan = os.path.join(mem_dir, "chaos_plan.json")
+    ChaosPlan([FaultSpec(kind="oom", step=oom_step, rank=1)]).save(mem_plan)
+
+    def mem_argv_for_rank(rank, world_size, incarnation):
+        return [
+            sys.executable, worker,
+            "--rank", str(rank),
+            "--world", str(world_size),
+            "--steps", str(mem_steps),
+            "--state-dir", os.path.join(mem_dir, "state"),
+            "--result-dir", os.path.join(mem_dir, "results"),
+            "--step-seconds", str(mem_step_s),
+            "--health-every", "1",
+            "--chaos-plan", mem_plan,
+        ]
+
+    mem_telemetry = telemetry_for_run(
+        event_log=os.path.join(mem_dir, SUPERVISOR_LOG), stdout=False
+    )
+    mem_result = Supervisor(
+        argv_for_rank=mem_argv_for_rank,
+        world_size=args.world,
+        config=SupervisorConfig(
+            max_restarts=1, backoff_base_s=0.05, poll_interval_s=0.05,
+            metrics_port=0,  # arms the aggregator (the headroom detector)
+        ),
+        telemetry=mem_telemetry,
+        run_dir=mem_dir,
+    ).run()
+    mem_telemetry.close()
+    problems = []
+    if not mem_result.success:
+        problems.append(f"memory game-day run failed: {mem_result}")
+
+    # the OOM post-mortem: well-formed, buffers ranked, top class named
+    oom_path = os.path.join(mem_dir, "artifacts", OOM_REPORT_NAME)
+    try:
+        with open(oom_path) as f:
+            oom_doc = json.load(f)
+    except (OSError, ValueError) as exc:
+        oom_doc = None
+        problems.append(f"no readable {OOM_REPORT_NAME}: {exc}")
+    if oom_doc is not None:
+        if oom_doc.get("top_buffer") != "params":
+            problems.append(
+                f"oom report top_buffer is {oom_doc.get('top_buffer')!r},"
+                " expected 'params' (the largest toy buffer class)"
+            )
+        ranked = [b.get("bytes") for b in oom_doc.get("buffers") or []]
+        if not ranked or ranked != sorted(ranked, reverse=True):
+            problems.append(f"oom report buffers not ranked desc: {ranked}")
+        if "RESOURCE_EXHAUSTED" not in str(oom_doc.get("error", "")):
+            problems.append("oom report error lost the allocator marker")
+        if oom_doc.get("last_memory") is None:
+            problems.append("oom report carries no last memory sample")
+
+    mem_json = os.path.join(mem_dir, "report.json")
+    if report.main(["--run-dir", mem_dir, "--json-out", mem_json]) != 0:
+        return 1
+    with open(mem_json) as f:
+        mem_doc = json.load(f)
+
+    # the precursor: an hbm_headroom alert, fired BEFORE the oom step
+    mem_alerts = (mem_doc.get("alerts") or {}).get("by_kind") or {}
+    if not mem_alerts.get("hbm_headroom"):
+        problems.append(
+            f"no hbm_headroom precursor alert (alerts: {mem_alerts})"
+        )
+    alert_steps = []
+    try:
+        with open(os.path.join(mem_dir, SUPERVISOR_LOG)) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if (
+                    rec.get("event") == "alert"
+                    and rec.get("alert") == "hbm_headroom"
+                    and isinstance(rec.get("step"), int)
+                ):
+                    alert_steps.append(rec["step"])
+    except OSError:
+        pass
+    if not alert_steps or min(alert_steps) >= oom_step:
+        problems.append(
+            f"headroom alert did not precede the oom at step {oom_step}"
+            f" (alert steps: {sorted(alert_steps)[:5]})"
+        )
+
+    # the memory section: measured peak present (the sampler ran), and
+    # the gate can read the metric off this report
+    memory = mem_doc.get("memory") or {}
+    if not memory.get("measured_available"):
+        problems.append(f"report memory section has no measured side: {memory}")
+    if memory.get("hbm_peak_source") != "measured":
+        problems.append(
+            f"hbm_peak_source is {memory.get('hbm_peak_source')!r},"
+            " expected 'measured'"
+        )
+    base_peak = memory.get("hbm_peak_bytes")
+    if not (isinstance(base_peak, (int, float)) and base_peak > 0):
+        problems.append(f"hbm_peak_bytes not finite-positive: {base_peak!r}")
+    if "hbm_peak_bytes" not in gate.extract_metrics(mem_doc):
+        problems.append(f"gate cannot extract hbm_peak_bytes from {mem_json}")
+    if problems:
+        for prob in problems:
+            sys.stderr.write(f"# run_probe: FAIL: {prob}\n")
+        return 1
+    gate.main(["--report", mem_json, "--advisory", "--root", REPO])
+
+    # the regression leg: double the model (--hbm-mult 2.0), gate against
+    # the first run's measured peak — gate.py must exit NONZERO
+    mem2_dir = run_dir + "_memory2x"
+    shutil.rmtree(mem2_dir, ignore_errors=True)
+    os.makedirs(mem2_dir, exist_ok=True)
+
+    def mem2_argv_for_rank(rank, world_size, incarnation):
+        return [
+            sys.executable, worker,
+            "--rank", str(rank),
+            "--world", str(world_size),
+            "--steps", "8",
+            "--state-dir", os.path.join(mem2_dir, "state"),
+            "--result-dir", os.path.join(mem2_dir, "results"),
+            "--step-seconds", str(args.step_seconds),
+            "--health-every", "1",
+            "--hbm-mult", "2.0",
+        ]
+
+    mem2_telemetry = telemetry_for_run(
+        event_log=os.path.join(mem2_dir, SUPERVISOR_LOG), stdout=False
+    )
+    mem2_result = Supervisor(
+        argv_for_rank=mem2_argv_for_rank,
+        world_size=args.world,
+        config=SupervisorConfig(
+            max_restarts=1, backoff_base_s=0.05, poll_interval_s=0.05
+        ),
+        telemetry=mem2_telemetry,
+        run_dir=mem2_dir,
+    ).run()
+    mem2_telemetry.close()
+    if not mem2_result.success:
+        sys.stderr.write(
+            f"# run_probe: FAIL: doubled-footprint run failed: {mem2_result}\n"
+        )
+        return 1
+    mem2_json = os.path.join(mem2_dir, "report.json")
+    if report.main(["--run-dir", mem2_dir, "--json-out", mem2_json]) != 0:
+        return 1
+    mem_baseline = os.path.join(mem_dir, "gate_baseline.json")
+    with open(mem_baseline, "w") as f:
+        json.dump({"hbm_peak_bytes": float(base_peak)}, f)
+    gate_rc = gate.main([
+        "--report", mem2_json, "--baseline", mem_baseline, "--root", REPO,
+    ])
+    if gate_rc == 0:
+        sys.stderr.write(
+            "# run_probe: FAIL: gate passed a doubled HBM footprint"
+            f" ({mem2_json} vs baseline {base_peak:.3g} B)\n"
+        )
+        return 1
+    sys.stderr.write(
+        f"# run_probe: memory game day ok (headroom alert at step"
+        f" {min(alert_steps)} preceded the oom at {oom_step}; post-mortem"
+        f" blames '{oom_doc['top_buffer']}'; measured peak"
+        f" {base_peak / 1e6:.0f} MB; doubled footprint tripped the gate)"
+        f" report -> {mem_json}\n"
     )
     return 0
 

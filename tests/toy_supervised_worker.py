@@ -49,10 +49,12 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from network_distributed_pytorch_tpu.resilience.chaos import (  # noqa: E402
+    CHAOS_EXIT_CODE,
     CKPT_UNWRITABLE_EXIT_CODE,
     CORRELATED_FAULTS,
     HEALTH_FAULTS,
     LOADER_FAULTS,
+    MEMORY_FAULTS,
     PREEMPT_EXIT_CODE,
     PROCESS_FAULTS,
     ChaosPlan,
@@ -62,11 +64,17 @@ from network_distributed_pytorch_tpu.observe import (  # noqa: E402
     CollectiveEvent,
     CompileEvent,
     FailureEvent,
+    MemoryEvent,
     StepEvent,
     TrainHealthEvent,
     recording,
     span,
     telemetry_for_run,
+)
+from network_distributed_pytorch_tpu.observe.memory import (  # noqa: E402
+    OOM_REPORT_NAME,
+    build_oom_report,
+    write_oom_report,
 )
 from network_distributed_pytorch_tpu.observe.live import AlertFeed  # noqa: E402
 from network_distributed_pytorch_tpu.observe.runlog import (  # noqa: E402
@@ -116,6 +124,29 @@ TOY_RUNG_SPECS = {
 # live plane's EWMA spike detector has an almost-zero-variance envelope and
 # a chaos ``grad_spike`` (factor 1000 by default) is unambiguously critical
 TOY_GRAD_NORM = 1.0
+# the toy memory plane: a made-up HBM limit and a compile-time footprint
+# split (the CompileEvent fields observe.memory would attach on a real
+# backend), both scaled by --hbm-mult so a probe can "double the model" and
+# watch the hbm_peak_bytes gate trip. Synthetic MemoryEvents ramp
+# bytes_in_use from 50% of the limit toward 97% per health sample, so the
+# supervisor-side HbmHeadroomDetector's EWMA crosses its warn threshold
+# within ~7 samples — the OOM-precursor alert the memory game day asserts
+# fires BEFORE the injected ``oom`` fault kills the rank
+TOY_HBM_LIMIT = float(1 << 30)
+TOY_FOOTPRINT = {
+    "argument_bytes": 0.30 * TOY_HBM_LIMIT,
+    "output_bytes": 0.05 * TOY_HBM_LIMIT,
+    "temp_bytes": 0.25 * TOY_HBM_LIMIT,
+    "generated_code_bytes": 0.02 * TOY_HBM_LIMIT,
+}
+# the OOM post-mortem's toy buffer-class attribution (fractions of the
+# limit): params dominate, so the report's top_buffer names "params"
+TOY_BUFFER_FRACS = {
+    "params": 0.45,
+    "ef_memory": 0.20,
+    "activations_temp": 0.15,
+    "serving_slots": 0.10,
+}
 
 
 def _load_state(path):
@@ -201,13 +232,23 @@ def main() -> int:
              " overrides it per-step",
     )
     p.add_argument(
+        "--hbm-mult", type=float, default=1.0, metavar="X",
+        help="scale the toy HBM limit, compile-time footprint, and live"
+             " memory ramp by X — the memory observatory's \"double the"
+             " model\" knob: a 2.0 run against a 1.0 baseline must trip"
+             " the hbm_peak_bytes gate",
+    )
+    p.add_argument(
         "--health-every", type=int, default=0, metavar="N",
         help="emit a synthetic TrainHealthEvent every N steps (0 = never);"
              " a chaos grad_spike fault multiplies the reading by its"
              " factor payload, and under a supervisor run dir the worker"
              " also tails alerts.jsonl each step and feeds every alert to"
              " a real FallbackController.nudge — the live plane's"
-             " detector -> supervisor -> worker round-trip, jax-free",
+             " detector -> supervisor -> worker round-trip, jax-free."
+             " The same cadence emits a synthetic MemoryEvent whose"
+             " bytes_in_use ramps toward the toy HBM limit (the headroom"
+             " detector's OOM-precursor feed)",
     )
     args = p.parse_args()
 
@@ -227,6 +268,16 @@ def main() -> int:
     payload_bytes = TOY_PAYLOAD_BYTES * max(1, args.payload_mult)
     divisor, sync_every, n_coll, comm_config = TOY_RUNG_SPECS[args.rung]
     rung_bytes_now = payload_bytes // divisor
+
+    # the toy memory plane, scaled as one unit: limit, footprint, and the
+    # live ramp all follow --hbm-mult (occupancy FRACTIONS are invariant,
+    # so the headroom detector behaves identically at any scale)
+    hbm_mult = max(args.hbm_mult, 1e-9)
+    hbm_limit = TOY_HBM_LIMIT * hbm_mult
+    footprint = {k: v * hbm_mult for k, v in TOY_FOOTPRINT.items()}
+    footprint["peak_hbm_bytes"] = sum(footprint.values())
+    last_memory = None
+    peak_in_use = 0.0
 
     # per-rank telemetry shard: explicit --event-log wins, else the
     # supervisor-exported run dir (run_start marker auto-emitted from env)
@@ -265,6 +316,11 @@ def main() -> int:
                 flops_source="analytic",
                 device_kind=TOY_DEVICE_KIND,
                 peak_flops_per_s=TOY_PEAK_FLOPS,
+                # the toy compile-time HBM footprint: what
+                # observe.memory.memory_footprint_fields attaches on a
+                # real backend, byte-exact by fiat — the predicted side of
+                # the report's memory join, jax-free
+                **footprint,
                 comm_config=dict(comm_config),
             )
         )
@@ -372,6 +428,49 @@ def main() -> int:
                     time.sleep(float(spec.payload.get("hang_seconds", 3600.0)))
                 if spec.kind == "proc_preempt":
                     os.kill(os.getpid(), signal.SIGTERM)
+            spec = plan.pop(MEMORY_FAULTS, i, args.rank, incarnation)
+            if spec is not None and spec.kind == "oom":
+                # the toy allocator death, forensics-first like the real
+                # GuardedStep trap: write the ranked post-mortem (into the
+                # supervised run dir's artifacts/ when present), emit the
+                # detection event, then die with the chaos sentinel — an
+                # OOM is never retried in place
+                want = int(spec.payload.get("bytes", hbm_limit))
+                report = build_oom_report(
+                    error=(
+                        f"RESOURCE_EXHAUSTED: Out of memory while trying"
+                        f" to allocate {want} bytes (injected at step {i},"
+                        f" rank {args.rank})"
+                    ),
+                    label="toy",
+                    rank=args.rank,
+                    step=i,
+                    last_memory=(
+                        last_memory.record() if last_memory else None
+                    ),
+                    footprint=footprint,
+                    buffers={
+                        name: frac * hbm_limit
+                        for name, frac in TOY_BUFFER_FRACS.items()
+                    },
+                )
+                base_dir = run_dir or args.result_dir
+                path = os.path.join(base_dir, "artifacts", OOM_REPORT_NAME)
+                write_oom_report(report, path)
+                if telemetry is not None:
+                    telemetry.emit(
+                        FailureEvent(
+                            kind="oom", label="toy", rank=args.rank,
+                            step=i, incarnation=incarnation,
+                            message=(
+                                f"device out of memory (top buffer:"
+                                f" {report['top_buffer']}; forensics:"
+                                f" {path})"
+                            ),
+                        )
+                    )
+                    telemetry.close()
+                os._exit(CHAOS_EXIT_CODE)
             in_flap = flap is not None and flap <= i < flap + FLAP_LEN
             if flap is not None and telemetry is not None:
                 if i == flap:
@@ -471,6 +570,23 @@ def main() -> int:
                         loss=1.0 / (i + 1), rank=args.rank, label="toy",
                     )
                 )
+                # the synthetic memory ramp: occupancy climbs 50% -> 97%
+                # of the toy limit, one rung per health sample, so the
+                # supervisor's HbmHeadroomDetector EWMA crosses warn
+                # within ~7 samples — the OOM precursor
+                k = i // args.health_every
+                in_use = hbm_limit * min(0.97, 0.5 + 0.2 * k)
+                peak_in_use = max(peak_in_use, in_use)
+                last_memory = MemoryEvent(
+                    step=i,
+                    bytes_in_use=in_use,
+                    peak_bytes_in_use=peak_in_use,
+                    bytes_limit=hbm_limit,
+                    device_kind=TOY_DEVICE_KIND,
+                    rank=args.rank,
+                    label="toy",
+                )
+                telemetry.emit(last_memory)
             if alert_feed is not None and controller is not None:
                 # the return leg of the live plane: detector alerts the
                 # supervisor appended to alerts.jsonl nudge the controller
