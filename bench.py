@@ -173,11 +173,12 @@ def _child_emit(phase: str, ok: bool, data: dict) -> None:
 
 class _InitTimeout(BaseException):
     """Backend init hang (probe thread still blocked after the deadline).
-    BaseException-derived so ``retry_transient`` (which retries
-    ``Exception``) never waits out a SECOND in-process hang: a hang must
+    BaseException-derived so generic ``except Exception`` recovery paths
+    never swallow it and wait out a SECOND in-process hang: a hang must
     reach the parent as an ``__init__`` failure within the probe budget
-    (240 s < 300 s), or the parent would misclassify it as a per-phase
-    timeout and the 2-init-failure CPU fallback would never engage."""
+    (240 s < 300 s, matching ``backend_preflight``'s no-retry-on-timeout
+    default), or the parent would misclassify it as a per-phase timeout
+    and the 2-init-failure CPU fallback would never engage."""
 
 
 def _init_backend():
@@ -185,14 +186,17 @@ def _init_backend():
 
     ``jax.devices()`` against the one-shot TPU tunnel either works quickly,
     fails with a transient UNAVAILABLE, or hangs forever inside the PJRT
-    C++ client where no signal handler runs — so the probe runs in a daemon
-    worker thread and the main thread joins with a deadline. A blown
-    deadline or error is reported on stdout for the parent (which owns the
-    retry/fallback policy) and exits the child.
+    C++ client where no signal handler runs. The probe itself lives in
+    ``hostenv.backend_preflight`` (daemon worker thread joined with a
+    deadline, bounded-backoff retry on raised errors, NO retry on a hang
+    — a hang must reach the parent as an ``__init__`` failure within one
+    probe budget, 240 s < 300 s, or the 2-init-failure CPU fallback never
+    engages); this wrapper translates its verdict back into the exception
+    taxonomy the parent's retry/fallback policy keys on.
     """
     import jax
 
-    from network_distributed_pytorch_tpu.utils.failure import retry_transient
+    from network_distributed_pytorch_tpu import hostenv
 
     # the environment may pin an accelerator platform by config (the axon
     # sitecustomize sets jax_platforms itself, so the env var alone is not
@@ -201,33 +205,23 @@ def _init_backend():
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 
     timeout_s = int(os.environ.get("BENCH_INIT_TIMEOUT_S", "240"))
-
-    def _probe():
-        box = {}
-
-        def worker():
-            try:
-                box["devices"] = jax.devices()
-            except BaseException as e:  # noqa: BLE001 — relayed to main thread
-                box["error"] = e
-
-        t = threading.Thread(target=worker, daemon=True)
-        t.start()
-        t.join(timeout_s)
-        if t.is_alive():
-            raise _InitTimeout(f"jax backend init exceeded {timeout_s}s")
-        if "error" in box:
-            e = box["error"]
-            raise e if isinstance(e, Exception) else RuntimeError(repr(e))
-        return box["devices"]
-
-    devices = retry_transient(
-        _probe, retries=1, backoff_seconds=1.0,
-        exceptions=(Exception,), on_retry=lambda i, e: print(
-            f"# bench: backend init retry {i}: {type(e).__name__}: {e}",
-            file=sys.stderr, flush=True,
-        ),
+    verdict = hostenv.backend_preflight(
+        timeout_s=timeout_s, attempts=2, backoff_s=1.0,
+        force=True, retry_on_timeout=False,
     )
+    if verdict["attempts"] > 1:
+        print(
+            f"# bench: backend init retried ({verdict['attempts']} attempts)",
+            file=sys.stderr, flush=True,
+        )
+    if not verdict["ok"]:
+        cause = str(verdict.get("cause") or "backend init failed")
+        if cause.startswith("init_timeout"):
+            raise _InitTimeout(cause)
+        raise RuntimeError(cause)
+    # the probe thread already paid backend init in THIS process, so this
+    # second call returns the live client instantly
+    devices = jax.devices()
     if devices[0].platform == "tpu":
         # persistent compilation cache — TPU only: big-model compiles
         # through the tunnel are minutes-slow, and a warmed cache turns the
@@ -1102,7 +1096,12 @@ def child_main(phase_list: list) -> int:
     try:
         _init_backend()
     except BaseException as e:  # noqa: BLE001 — parent owns retry policy
-        _child_emit("__init__", False, {"error": f"{type(e).__name__}: {e}"[:400]})
+        _child_emit("__init__", False, {
+            "error": f"{type(e).__name__}: {e}"[:400],
+            # the preflight verdict's cause string, free of exception-type
+            # prefix noise — the parent records it as init_timeout_cause
+            "cause": str(e)[:400],
+        })
         return 1
     # the parent's ABSOLUTE deadline (unix seconds): the child must finish —
     # or abandon — each phase before the parent's own budget math
@@ -1370,7 +1369,7 @@ _SUMMARY_PRIORITY = (
     "flagship_imgs_per_sec_max", "baseline_imgs_per_sec",
     "baseline_imgs_per_sec_min", "baseline_imgs_per_sec_max", "mfu",
     "mfu_target", "fp32_scanned_imgs_per_sec", "tpu_error", "init_retries",
-    "orchestrator_error", "flops_chunk_ratio",
+    "init_timeout_cause", "orchestrator_error", "flops_chunk_ratio",
 )
 
 
@@ -1513,6 +1512,14 @@ def orchestrate() -> int:
                             # the retry visible in the published record
                             out["init_retries"] = out.get("init_retries", 0) + 1
                         out["tpu_error"] = err
+                        # the preflight verdict's cause (hostenv
+                        # .backend_preflight) rides into the bounded summary
+                        # so the driver can tell a wedged runtime
+                        # ("init_timeout: ...") from a missing one
+                        # ("RuntimeError: ... UNAVAILABLE") without the logs
+                        out["init_timeout_cause"] = str(
+                            ev["data"].get("cause") or err
+                        )[:200]
                         break
                     if ev["phase"] == "__drain__":
                         # the child's end-of-run report on abandoned-compile
@@ -1727,6 +1734,17 @@ def _record_gate_baseline(out: dict, status: dict) -> None:
             mttr = json.load(f).get("recovery_time_s")
         if isinstance(mttr, (int, float)) and mttr > 0:
             rec["recovery_time_s"] = float(mttr)
+    except (OSError, ValueError):
+        pass
+    # fleet goodput from the newest multi-job game day (run_probe
+    # phase 10): higher-is-better weighted work per chip-second, so a
+    # later round whose scheduler burns more chips for the same work —
+    # or strands jobs unfinished — regresses against this reference
+    try:
+        with open(os.path.join(HERE, "artifacts", "fleet_report.json")) as f:
+            goodput = json.load(f).get("fleet_goodput")
+        if isinstance(goodput, (int, float)) and goodput > 0:
+            rec["fleet_goodput"] = float(goodput)
     except (OSError, ValueError):
         pass
     # cost-model observatory (run_probe phase 7): the planner replay

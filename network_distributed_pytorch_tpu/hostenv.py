@@ -13,7 +13,9 @@ from __future__ import annotations
 import os
 import re
 import sys
-from typing import Optional
+import threading
+import time
+from typing import Dict, Optional
 
 _COUNT_FLAG = r"--xla_force_host_platform_device_count=\d+\s*"
 _TIMEOUT_FLAGS = (
@@ -95,3 +97,90 @@ def force_cpu_devices(
     os.environ["XLA_FLAGS"] = flags.strip()
     if "jax" in sys.modules:
         sys.modules["jax"].config.update("jax_platforms", "cpu")
+
+
+# one preflight verdict per process: backend init is exactly the thing
+# that hangs on a contended pod, so a second caller must never pay it
+# again (and a thread stuck inside jax.devices() can't be cancelled —
+# re-probing would just stack zombie threads)
+_PREFLIGHT: Optional[Dict] = None
+
+
+def backend_preflight(
+    timeout_s: float = 60.0,
+    attempts: int = 2,
+    backoff_s: float = 2.0,
+    backoff_max_s: float = 30.0,
+    force: bool = False,
+    retry_on_timeout: bool = False,
+) -> Dict:
+    """Probe the backend ONCE per process: ``jax.devices()`` in a daemon
+    thread with a wall deadline, retried with bounded exponential backoff
+    (a TPU runtime that lost a grant often recovers within seconds; one
+    that is truly wedged should fail fast, not hang the driver).
+
+    Returns (and caches) a verdict dict::
+
+        {"ok": bool, "platform": str|None, "n_devices": int|None,
+         "cause": str|None, "attempts": int, "elapsed_s": float}
+
+    ``cause`` names WHY the probe failed (``init_timeout: ...`` for a
+    deadline overrun, ``SomeError: ...`` for a raised init error) — the
+    string bench.py surfaces as ``init_timeout_cause`` in its bounded
+    summary so a driver can tell a wedged runtime from a missing one.
+    ``force=True`` discards the cached verdict and probes again.
+
+    ``retry_on_timeout=False`` (the default) stops retrying after the
+    FIRST deadline overrun: a raised init error is often transient (a
+    lost grant re-acquires in seconds) but a silent hang rarely heals,
+    and a caller with its own outer deadline — bench.py's parent gives a
+    child INIT_GRACE_S before declaring it wedged — needs the hang
+    verdict escalated within one probe budget, not ``attempts`` of them.
+    """
+    global _PREFLIGHT
+    if _PREFLIGHT is not None and not force:
+        return _PREFLIGHT
+    t0 = time.monotonic()
+    verdict: Dict = {
+        "ok": False, "platform": None, "n_devices": None,
+        "cause": None, "attempts": 0, "elapsed_s": 0.0,
+    }
+    for attempt in range(max(1, attempts)):
+        verdict["attempts"] = attempt + 1
+        box: Dict = {}
+
+        def _probe() -> None:
+            try:
+                import jax
+
+                devices = jax.devices()
+                box["platform"] = devices[0].platform if devices else None
+                box["n"] = len(devices)
+            except BaseException as e:  # noqa: BLE001 — verdict, not crash
+                box["error"] = f"{type(e).__name__}: {e}"[:400]
+
+        t = threading.Thread(
+            target=_probe, name="backend-preflight", daemon=True
+        )
+        t.start()
+        t.join(timeout_s)
+        if t.is_alive():
+            verdict["cause"] = (
+                f"init_timeout: jax.devices() still hung after"
+                f" {timeout_s:.0f}s (attempt {attempt + 1}/{max(1, attempts)})"
+            )
+            if not retry_on_timeout:
+                break
+        elif "error" in box:
+            verdict["cause"] = box["error"]
+        else:
+            verdict.update(
+                ok=True, platform=box.get("platform"),
+                n_devices=box.get("n"), cause=None,
+            )
+            break
+        if attempt + 1 < max(1, attempts):
+            time.sleep(min(backoff_s * (2 ** attempt), backoff_max_s))
+    verdict["elapsed_s"] = time.monotonic() - t0
+    _PREFLIGHT = verdict
+    return verdict

@@ -595,6 +595,15 @@ def _supervise(args, argv) -> dict:
 
 
 def main(argv=None) -> dict:
+    raw = argv if argv is not None else sys.argv[1:]
+    if raw and raw[0] == "fleet":
+        # the fleet control plane: gang-schedule spooled job manifests
+        # over a fixed chip inventory (resilience.scheduler owns the CLI;
+        # jax-free, so intercept BEFORE the experiment parser and its
+        # choices= validation)
+        from .resilience import scheduler as _scheduler
+
+        return {"fleet_rc": _scheduler.main(raw[1:])}
     args = build_parser().parse_args(argv)
     if args.metrics_port is not None and not (args.supervise and args.run_dir):
         raise ValueError("--metrics-port requires --supervise and --run-dir")

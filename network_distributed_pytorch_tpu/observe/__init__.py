@@ -89,6 +89,8 @@ from .events import (  # noqa: F401
     EpochEvent,
     Event,
     FailureEvent,
+    JobEvent,
+    JobFailedEvent,
     LoaderEvent,
     MarkerEvent,
     MemoryEvent,
@@ -96,9 +98,11 @@ from .events import (  # noqa: F401
     NoteEvent,
     PolicyEvent,
     PredictionEvent,
+    PreemptEvent,
     RawEvent,
     RequestEvent,
     ReshapeEvent,
+    ScheduleEvent,
     SpanEvent,
     StepEvent,
     StragglerEvent,

@@ -48,7 +48,7 @@ falsifiable bound.
 from __future__ import annotations
 
 import math
-from dataclasses import asdict, dataclass
+from dataclasses import asdict, dataclass, replace
 from typing import Dict, List, Optional
 
 from .analytics import _load_utils_module
@@ -324,6 +324,97 @@ def predict(
             if model.per_edge else None
         ),
     }
+
+
+def slice_calibration(calib: CostCalibration, world: int) -> CostCalibration:
+    """The calibration re-anchored at a different worker count: per-worker
+    compute and the dense gradient are invariant (data parallelism keeps
+    the per-worker batch fixed), only the ring term's ``2(W-1)/W`` factor
+    and the collective fan-in change. This is what lets one calibrated
+    toy run price every viable mesh SLICE of the fleet's inventory."""
+    return replace(calib, n_workers=max(1, int(world)))
+
+
+def price_slice(
+    calib: CostCalibration,
+    world: int,
+    fabric: str,
+    config: Optional[Dict] = None,
+    steps: Optional[float] = None,
+    deadline_s: Optional[float] = None,
+    matrix: Optional[Dict] = None,
+) -> Dict:
+    """Price one mesh slice: the calibrated job executed on ``world`` of
+    the inventory's chips instead of the ``calib.n_workers`` it was
+    measured at.
+
+    ``steps`` is the job's remaining work in steps AT THE CALIBRATED
+    world; a slice of ``world`` workers processes the same global work in
+    ``steps * n_workers / world`` steps (data-parallel scaling of the
+    global batch), so a bigger slice finishes sooner but burns more
+    chip-seconds per wall second — exactly the tradeoff the scheduler's
+    deadline-cheapest admission resolves. ``predicted_chip_seconds`` is
+    the slice's total cost (world x predicted wall); ``meets_deadline``
+    is set when both ``steps`` and ``deadline_s`` were given."""
+    c = canonical_config(config or calib.source_config or {})
+    p = predict(slice_calibration(calib, world), c, fabric, matrix=matrix)
+    out: Dict = {
+        "world": int(world),
+        "fabric": fabric,
+        "config": c,
+        "config_key": p["config_key"],
+        "predicted_step_s": p["predicted_step_s"],
+        "exposed_comm_s": p["exposed_comm_s"],
+        "compute_s": p["compute_s"],
+    }
+    if steps is not None and steps > 0:
+        scaled_steps = steps * max(1, calib.n_workers) / max(1, world)
+        wall = scaled_steps * p["predicted_step_s"]
+        out["steps"] = scaled_steps
+        out["predicted_wall_s"] = wall
+        out["predicted_chip_seconds"] = wall * max(1, world)
+        if deadline_s is not None:
+            out["deadline_s"] = float(deadline_s)
+            out["meets_deadline"] = wall <= deadline_s
+    return out
+
+
+def search_slices(
+    calib: CostCalibration,
+    worlds: List[int],
+    fabric: str,
+    config: Optional[Dict] = None,
+    steps: Optional[float] = None,
+    deadline_s: Optional[float] = None,
+    matrix: Optional[Dict] = None,
+) -> List[Dict]:
+    """Rank candidate slice sizes for one job: deadline-meeting slices
+    first, cheapest chip-seconds among them (the admission policy — never
+    grant more chips than the deadline needs); slices that miss the
+    deadline sort after, fastest wall first (the least-bad overflow
+    order). Without ``steps``/``deadline_s`` it degrades to cheapest
+    predicted step time, largest world breaking ties (pure throughput)."""
+    priced = [
+        price_slice(
+            calib, w, fabric, config=config, steps=steps,
+            deadline_s=deadline_s, matrix=matrix,
+        )
+        for w in sorted(set(int(w) for w in worlds if int(w) >= 1))
+    ]
+
+    def rank_key(p: Dict):
+        if "meets_deadline" in p:
+            return (
+                0 if p["meets_deadline"] else 1,
+                p.get("predicted_chip_seconds")
+                if p["meets_deadline"]
+                else p.get("predicted_wall_s", float("inf")),
+            )
+        if "predicted_wall_s" in p:
+            return (0, p["predicted_chip_seconds"])
+        return (0, (p["predicted_step_s"], -p["world"]))
+
+    return sorted(priced, key=rank_key)
 
 
 def ladder_configs(ladder=None) -> List[Dict]:

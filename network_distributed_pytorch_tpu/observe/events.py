@@ -628,6 +628,116 @@ class AlertEvent(Event):
 
 
 @dataclass
+class JobEvent(Event):
+    """One fleet-job lifecycle transition through
+    :class:`resilience.scheduler.FleetScheduler`: ``state`` ∈ ``submitted``
+    (manifest claimed off the job spool) / ``started`` (a per-job
+    Supervisor spawned over the granted ranks) / ``preempting`` (SIGTERM
+    storm in flight) / ``parked`` (exit-75 drain landed, job re-queued) /
+    ``resumed`` (re-admitted after a park) / ``completed`` / ``failed``.
+    ``chip_seconds`` is world x wall seconds the slice was held for the
+    segment ending at this transition; ``work_done`` counts the job's own
+    progress units (train steps, served requests) so the fleet report can
+    compute deadline-weighted goodput without re-reading worker state.
+    The banner is the record as JSON, like :class:`FailureEvent`."""
+
+    KIND: ClassVar[str] = "job"
+
+    job_id: str
+    state: str  # submitted|started|preempting|parked|resumed|completed|failed
+    kind: str = ""  # train | serve
+    priority: int = 0
+    world: Optional[int] = None
+    device_ranks: Optional[List[int]] = None
+    deadline_s: Optional[float] = None
+    chip_seconds: Optional[float] = None
+    work_done: Optional[float] = None
+    met_deadline: Optional[bool] = None
+    preemptions: int = 0
+    reason: str = ""
+
+    def banner(self) -> str:
+        rec = {k: v for k, v in self.record().items() if v is not None}
+        return json.dumps(rec, default=str)
+
+
+@dataclass
+class PreemptEvent(Event):
+    """The scheduler reclaimed chips from a running job: ``victim`` (the
+    lower-priority job whose Supervisor got the SIGTERM → committed
+    end-of-step checkpoint → exit-75 drain) and ``beneficiary`` (the job —
+    typically a serving pool under SLO burn — the freed ranks go to).
+    ``reason`` names the trigger (``slo_burn`` for the live-plane alert
+    escalation, ``priority`` for plain queue-order preemption);
+    ``budget_left`` is the victim's remaining preemption budget AFTER this
+    preemption so a repeatedly-bullied job's exhaustion is auditable. The
+    banner is the record as JSON, like :class:`FailureEvent`."""
+
+    KIND: ClassVar[str] = "preempt"
+
+    victim: str
+    beneficiary: str = ""
+    reason: str = ""
+    device_ranks: Optional[List[int]] = None
+    victim_priority: Optional[int] = None
+    beneficiary_priority: Optional[int] = None
+    budget_left: Optional[int] = None
+
+    def banner(self) -> str:
+        rec = {k: v for k, v in self.record().items() if v is not None}
+        return json.dumps(rec, default=str)
+
+
+@dataclass
+class ScheduleEvent(Event):
+    """One admission decision: the scheduler asked the offline cost model
+    (:mod:`observe.costmodel`) which viable mesh slice hits the job's
+    deadline cheapest and granted it. ``world``/``mesh`` are the chosen
+    slice (mesh factored by ``plan_mesh``'s divisor discipline),
+    ``device_ranks`` the concrete inventory ranks granted,
+    ``predicted_step_s``/``predicted_chip_seconds`` the planner's price
+    for the slice (None when no calibration exists and the scheduler fell
+    back to smallest-viable). The banner is the record as JSON."""
+
+    KIND: ClassVar[str] = "schedule"
+
+    job_id: str
+    world: int
+    device_ranks: List[int] = field(default_factory=list)
+    mesh: Optional[Dict[str, int]] = None
+    predicted_step_s: Optional[float] = None
+    predicted_chip_seconds: Optional[float] = None
+    planner: str = ""  # "costmodel" | "fallback"
+    reason: str = ""
+
+    def banner(self) -> str:
+        rec = {k: v for k, v in self.record().items() if v is not None}
+        return json.dumps(rec, default=str)
+
+
+@dataclass
+class JobFailedEvent(Event):
+    """A job exhausted its K-strike hard-failure budget and was quarantined:
+    its manifest moved to the spool's ``quarantine/`` directory so the
+    queue never wedges behind a crash-looper. ``strikes`` is the count of
+    hard (non-preempt, non-zero) supervisor failures; ``last_rc`` the final
+    exit code observed. The banner is the record as JSON."""
+
+    KIND: ClassVar[str] = "job_failed"
+
+    job_id: str
+    strikes: int
+    last_rc: Optional[int] = None
+    kind: str = ""
+    priority: int = 0
+    reason: str = ""
+
+    def banner(self) -> str:
+        rec = {k: v for k, v in self.record().items() if v is not None}
+        return json.dumps(rec, default=str)
+
+
+@dataclass
 class NoteEvent(Event):
     """A free-form human banner (init lifecycle, dropped-batch notes,
     study tables) that should also land in the structured log."""

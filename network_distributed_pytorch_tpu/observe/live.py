@@ -321,6 +321,40 @@ def ingest_record(
             help="failure-domain events observed",
             kind=str(rec.get("kind", "?")),
         )
+    elif kind == "job":
+        registry.counter(
+            "live_fleet_jobs_total",
+            help="fleet job lifecycle transitions",
+            state=str(rec.get("state", "?")),
+            job_kind=str(rec.get("kind", "?")),
+        )
+        world = rec.get("world")
+        if rec.get("state") in ("started", "resumed") and isinstance(
+            world, (int, float)
+        ):
+            registry.gauge(
+                "live_fleet_job_world", world,
+                help="chips currently granted to the job",
+                job=str(rec.get("job_id", "?")),
+            )
+        elif rec.get("state") in ("parked", "completed", "failed"):
+            registry.gauge(
+                "live_fleet_job_world", 0,
+                help="chips currently granted to the job",
+                job=str(rec.get("job_id", "?")),
+            )
+    elif kind == "preempt":
+        registry.counter(
+            "live_fleet_preemptions_total",
+            help="scheduler preemptions (victim chips reclaimed)",
+            reason=str(rec.get("reason", "?")),
+        )
+    elif kind == "job_failed":
+        registry.counter(
+            "live_fleet_quarantines_total",
+            help="jobs quarantined after exhausting their strike budget",
+            job_kind=str(rec.get("kind", "?")),
+        )
 
 
 class MetricSink(Sink):

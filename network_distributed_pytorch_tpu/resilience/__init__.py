@@ -123,10 +123,17 @@ from .reshard import (  # noqa: F401
     widen_model_state,
     widen_template,
 )
+from .scheduler import (  # noqa: F401
+    FleetConfig,
+    FleetScheduler,
+    JobManifest,
+    JobSpool,
+)
 from .supervisor import (  # noqa: F401
     Supervisor,
     SupervisorConfig,
     SupervisorResult,
+    device_ranks_from_env,
     incarnation_from_env,
     mesh_from_env,
     plan_mesh,

@@ -64,6 +64,12 @@ ENV_WORLD = "RESILIENCE_WORLD"
 # JSON mesh-axes dict ({"data": D, "fsdp": F, "tensor": T}), exported only
 # for mesh-shaped runs — a replanned worker reads its NEW shape from here
 ENV_MESH = "RESILIENCE_MESH"
+# JSON list of FLEET device ranks granted to this job (rank-subset mode):
+# worker rank r of a scheduled job sits on fleet chip device_ranks[r].
+# Exported only when the supervisor was constructed with a device grant —
+# an exclusive-ownership launch (the pre-fleet default) omits it and
+# workers assume chips 0..W-1.
+ENV_DEVICE_RANKS = "RESILIENCE_DEVICE_RANKS"
 
 
 def incarnation_from_env(default: int = 0) -> int:
@@ -88,6 +94,21 @@ def mesh_from_env() -> Optional[Dict[str, int]]:
     if not isinstance(axes, dict):
         return None
     return {str(k): int(v) for k, v in axes.items()}
+
+
+def device_ranks_from_env() -> Optional[List[int]]:
+    """The fleet chip ranks this worker's job was granted, or None for an
+    exclusive-ownership launch (workers then assume chips 0..W-1)."""
+    raw = os.environ.get(ENV_DEVICE_RANKS)
+    if not raw:
+        return None
+    try:
+        ranks = json.loads(raw)
+    except ValueError:
+        return None
+    if not isinstance(ranks, list):
+        return None
+    return [int(r) for r in ranks]
 
 
 def _divisors(n: int) -> List[int]:
@@ -170,6 +191,12 @@ class SupervisorConfig:
     # rank's restart budget independently.
     correlation_window_s: float = 2.0
     correlated_threshold: int = 2
+    # fleet preemption budget: how many times this run will accept a
+    # scheduler preemption request (:meth:`Supervisor.request_preempt`)
+    # before refusing — a repeatedly-bullied low-priority job eventually
+    # gets to keep its chips and finish. The fleet scheduler threads the
+    # job's REMAINING budget through here on every (re)admission.
+    preemption_budget: int = 3
 
 
 @dataclass
@@ -181,6 +208,11 @@ class SupervisorResult:
     exit_codes: Dict[int, int] = field(default_factory=dict)
     reason: str = ""
     final_mesh: Optional[Dict[str, int]] = None  # None for pure-DP runs
+    # the run ended because the fleet scheduler reclaimed its chips (a
+    # graceful SIGTERM -> committed-checkpoint -> exit-75 drain), not
+    # because the workload failed — the scheduler parks, never quarantines,
+    # a preempted job
+    preempted: bool = False
 
 
 @dataclass
@@ -211,6 +243,7 @@ class Supervisor:
         log_dir: Optional[str] = None,
         run_dir: Optional[str] = None,
         run_id: Optional[str] = None,
+        device_ranks: Optional[List[int]] = None,
     ):
         self.argv_for_rank = argv_for_rank
         self.world_size = world_size
@@ -220,6 +253,22 @@ class Supervisor:
         self.log_dir = log_dir
         self.total_restarts = 0
         self.degraded = False
+        # rank-subset mode: the fleet chip ids granted to this job (worker
+        # rank r sits on device_ranks[r]); None = exclusive ownership.
+        # A degraded replan trims the grant to the surviving world — the
+        # scheduler reads the trimmed list back to reclaim the freed chips.
+        if device_ranks is not None and len(device_ranks) != world_size:
+            raise ValueError(
+                f"device_ranks has {len(device_ranks)} entries for"
+                f" world_size={world_size}"
+            )
+        self.device_ranks = list(device_ranks) if device_ranks else None
+        # fleet preemption: request_preempt() arms this from the scheduler
+        # thread; the run loop observes it and drains gracefully. Plain
+        # attribute assignment is the synchronization (GIL-atomic), and the
+        # loop only ever reads it once per iteration.
+        self._preempt_reason: Optional[str] = None
+        self.preempt_count = 0
         self._incarnations: Dict[int, int] = {}  # next incarnation per rank
         self._rng = random.Random(self.config.seed)
         # current mesh shape (validated against the world) — None = pure DP
@@ -282,6 +331,8 @@ class Supervisor:
         env[ENV_WORLD] = str(world_size)
         if self.mesh is not None:
             env[ENV_MESH] = json.dumps(self.mesh)
+        if self.device_ranks is not None:
+            env[ENV_DEVICE_RANKS] = json.dumps(self.device_ranks)
         if self._manifest is not None:
             from ..observe import runlog
 
@@ -352,6 +403,22 @@ class Supervisor:
         except (OSError, subprocess.TimeoutExpired):
             pass
         return "hard"
+
+    def request_preempt(self, reason: str = "") -> bool:
+        """Ask this run to yield its chips: the run loop answers with a
+        graceful SIGTERM drain (``PreemptionGuard`` commits an end-of-step
+        checkpoint and exits ``PREEMPT_EXIT_CODE``) and returns a
+        ``preempted=True`` result the scheduler parks the job on. Returns
+        False — and does nothing — when the run's preemption budget is
+        already spent (the scheduler must pick another victim). Safe to
+        call from another thread; idempotent while a drain is pending."""
+        if self._preempt_reason is not None:
+            return True
+        if self.preempt_count >= max(0, self.config.preemption_budget):
+            return False
+        self.preempt_count += 1
+        self._preempt_reason = reason or "preempted"
+        return True
 
     @staticmethod
     def _death(rc: Optional[int]) -> str:
@@ -513,6 +580,10 @@ class Supervisor:
                     )
             if self.mesh is not None:
                 self.mesh = new_mesh
+            if self.device_ranks is not None:
+                # the survivors renumber 0..W'-1 onto the FIRST W' chips of
+                # the grant; the tail is freed for the scheduler to reclaim
+                self.device_ranks = self.device_ranks[:new_world]
             return new_world
 
         while True:
@@ -521,6 +592,31 @@ class Supervisor:
                 and time.monotonic() - started > cfg.deadline_s
             ):
                 return fail(f"deadline {cfg.deadline_s}s exceeded")
+
+            preempt = self._preempt_reason
+            if preempt is not None:
+                # fleet preemption drain: graceful-first kill of every live
+                # worker (SIGTERM -> PreemptionGuard committed checkpoint ->
+                # exit 75 inside term_grace_s), then report preempted so the
+                # scheduler parks the job instead of counting a failure
+                for w in workers.values():
+                    if w.done or w.proc.poll() is not None:
+                        continue
+                    how = self._kill(w)
+                    rc = w.proc.returncode
+                    exit_codes[w.rank] = rc if rc is not None else -1
+                    self._emit(
+                        "worker_term", rank=w.rank, incarnation=w.incarnation,
+                        message=f"{how} shutdown for preemption ({preempt})",
+                    )
+                self._emit("run_preempted", message=preempt)
+                return SupervisorResult(
+                    success=False, world_size=world,
+                    total_restarts=self.total_restarts,
+                    degraded=self.degraded, exit_codes=exit_codes,
+                    reason=f"preempted: {preempt}", final_mesh=self.mesh,
+                    preempted=True,
+                )
 
             # live plane first: alerts should reach the feedback channel
             # (and possibly recycle a sick rank) before this iteration's

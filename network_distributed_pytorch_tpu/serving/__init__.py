@@ -31,6 +31,7 @@ worker) can drive the spool protocol without a backend init; import
 """
 
 from .frontend import (  # noqa: F401
+    BurnEscalator,
     FileSpool,
     WorkloadConfig,
     poisson_workload,

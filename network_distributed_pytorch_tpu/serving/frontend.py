@@ -28,6 +28,22 @@ up to the engine's appetite, step, complete what finishes, and exit only
 when the whole workload manifest is done — a worker whose peers died
 keeps polling until orphan re-queueing (its own on restart, or anyone's
 after a world shrink) lets it finish the stragglers.
+
+The spool's claim protocol is deliberately entry-agnostic: the typed
+``Request`` methods (:meth:`FileSpool.claim` / ``ensure`` / ``complete``)
+are thin wrappers over doc-level primitives (``claim_doc`` /
+``ensure_docs`` / ``complete_doc`` / ``release_doc``) that move opaque
+JSON documents through the same ``queue/ -> claimed/ -> done/`` rename
+dance. That is what lets :mod:`resilience.scheduler` reuse the exact
+atomic-claim semantics for JOB MANIFESTS (priority, deadline, mesh
+bounds) without a second queue implementation — one protocol, audited
+once, shared by the request plane and the fleet control plane.
+
+:class:`BurnEscalator` is the serving side's hook into that control
+plane: a stateful, jax-free filter over live-plane alert records
+(``observe.health`` verdicts tailed from ``alerts.jsonl``) that turns a
+sustained ``slo_burn`` into a single rate-limited scale-up escalation
+the fleet scheduler answers by preempting lower-priority work.
 """
 
 from __future__ import annotations
@@ -165,14 +181,13 @@ class FileSpool:
                 return True
         return False
 
-    def ensure(self, requests: Iterable[Request]) -> int:
-        """Idempotently enqueue a workload: requests already queued,
-        claimed, or done are skipped (a restarted rank re-running the
-        deterministic workload generator enqueues nothing twice). Also
-        (re)writes the workload manifest — the id set :meth:`drained`
-        checks completion against."""
-        requests = list(requests)
-        ids = sorted({r.request_id for r in requests})
+    def ensure_docs(self, docs: Dict[str, Dict]) -> int:
+        """Doc-level idempotent enqueue: entries already queued, claimed,
+        or done are skipped, and the workload manifest — the id set
+        :meth:`drained` checks completion against — is (re)written as the
+        union of everything ever manifested. The generic primitive behind
+        :meth:`ensure`; the job spool enqueues manifests through it."""
+        ids = sorted(docs)
         known = set()
         manifest_path = os.path.join(self.root, MANIFEST)
         try:
@@ -184,15 +199,23 @@ class FileSpool:
             manifest_path, {"request_ids": sorted(known | set(ids))}
         )
         added = 0
-        for r in requests:
-            if self._exists_anywhere(r.request_id):
+        for entry_id in ids:
+            if self._exists_anywhere(entry_id):
                 continue
             _atomic_write(
-                os.path.join(self.queue_dir, f"{r.request_id}.json"),
-                r.to_wire(),
+                os.path.join(self.queue_dir, f"{entry_id}.json"),
+                docs[entry_id],
             )
             added += 1
         return added
+
+    def ensure(self, requests: Iterable[Request]) -> int:
+        """Idempotently enqueue a workload: requests already queued,
+        claimed, or done are skipped (a restarted rank re-running the
+        deterministic workload generator enqueues nothing twice)."""
+        return self.ensure_docs(
+            {r.request_id: r.to_wire() for r in requests}
+        )
 
     def manifest_ids(self) -> List[str]:
         try:
@@ -217,10 +240,11 @@ class FileSpool:
             os.path.join(self.done_dir, f"{request_id}.json")
         )
 
-    def claim(self) -> Optional[Request]:
-        """Claim the oldest queued request via atomic rename into this
-        worker's claim dir; None when the queue is empty (or every race
-        was lost — the caller just polls again)."""
+    def claim_doc(self) -> Optional[Tuple[str, Dict]]:
+        """Claim the oldest queued entry via atomic rename into this
+        worker's claim dir and return ``(entry_id, doc)``; None when the
+        queue is empty (or every race was lost — the caller just polls
+        again). The generic primitive behind :meth:`claim`."""
         if self.claim_dir is None:
             raise ValueError("claim() needs a worker FileSpool (rank=...)")
         try:
@@ -230,11 +254,11 @@ class FileSpool:
         for name in names:
             if not name.endswith(".json"):
                 continue
-            request_id = name[: -len(".json")]
+            entry_id = name[: -len(".json")]
             src = os.path.join(self.queue_dir, name)
-            if self._is_done(request_id):
+            if self._is_done(entry_id):
                 # post-crash duplicate (requeued after completion landed):
-                # drop it rather than serve the same request twice
+                # drop it rather than serve the same entry twice
                 try:
                     os.unlink(src)
                 except OSError:
@@ -245,9 +269,47 @@ class FileSpool:
                 os.rename(src, dst)
             except OSError:
                 continue  # lost the race; try the next file
-            with open(dst) as f:
-                return Request.from_wire(json.load(f))
+            try:
+                with open(dst) as f:
+                    return entry_id, json.load(f)
+            except (OSError, ValueError):
+                continue  # torn claim file — leave it for requeue
         return None
+
+    def claim(self) -> Optional[Request]:
+        """Claim the oldest queued request; None when the queue is empty
+        (or every race was lost — the caller just polls again)."""
+        got = self.claim_doc()
+        return None if got is None else Request.from_wire(got[1])
+
+    def complete_doc(self, entry_id: str, doc: Dict) -> None:
+        """Record a completion document (idempotent: last writer wins with
+        identical semantics) and release this worker's claim, if any."""
+        _atomic_write(
+            os.path.join(self.done_dir, f"{entry_id}.json"), doc
+        )
+        if self.claim_dir is not None:
+            try:
+                os.unlink(os.path.join(self.claim_dir, f"{entry_id}.json"))
+            except OSError:
+                pass
+
+    def release_doc(self, entry_id: str, doc: Dict) -> None:
+        """Voluntarily park a LIVE claim back onto the queue with an
+        updated document — the claim holder's own act, never a peer's
+        (peers only take provably-dead claims via
+        :meth:`requeue_orphans`). The fleet scheduler parks a preempted
+        job's manifest through this so the job re-enters queue order with
+        its resume state attached."""
+        if self.claim_dir is None:
+            raise ValueError("release_doc() needs a worker FileSpool")
+        _atomic_write(
+            os.path.join(self.queue_dir, f"{entry_id}.json"), doc
+        )
+        try:
+            os.unlink(os.path.join(self.claim_dir, f"{entry_id}.json"))
+        except OSError:
+            pass
 
     def complete(self, request: Request, extra: Optional[Dict] = None) -> None:
         """Record completion (idempotent: last writer wins with identical
@@ -263,16 +325,7 @@ class FileSpool:
         }
         if extra:
             doc.update(extra)
-        _atomic_write(
-            os.path.join(self.done_dir, f"{request.request_id}.json"), doc
-        )
-        if self.claim_dir is not None:
-            try:
-                os.unlink(
-                    os.path.join(self.claim_dir, f"{request.request_id}.json")
-                )
-            except OSError:
-                pass
+        self.complete_doc(request.request_id, doc)
 
     def requeue_orphans(self, world: int) -> int:
         """Move provably-dead identities' claims back to the queue.
@@ -453,3 +506,60 @@ def slo_summary(requests: Sequence[Request]) -> Dict:
         out["tokens_per_s"] = None
     out["total_tokens"] = total_tokens
     return out
+
+
+class BurnEscalator:
+    """Turns a stream of live-plane alert records into rate-limited
+    scale-up escalations.
+
+    The serving pool's supervisor already appends every fired detector
+    verdict to ``alerts.jsonl`` (tailed with ``observe.live.AlertFeed``);
+    this filter watches that stream for the SLO-burn detector and decides
+    when the pool should ask the fleet scheduler for more chips. A single
+    transient burn alert is noise — the detector itself requires a
+    sustained breach, and this adds a second sustain window at the
+    escalation layer plus a cooldown so a continuously-burning pool asks
+    once per ``cooldown_s``, not once per alert. Jax-free and clock-
+    injectable for tests.
+    """
+
+    def __init__(
+        self,
+        alert: str = "slo_burn",
+        sustain: int = 1,
+        cooldown_s: float = 30.0,
+        clock=time.monotonic,
+    ):
+        self.alert = alert
+        self.sustain = max(1, sustain)
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._streak = 0
+        self._last_escalation: Optional[float] = None
+        self.escalations = 0
+
+    def observe(self, record: Dict) -> Optional[Dict]:
+        """Feed one alert record; returns an escalation decision dict when
+        the sustained-burn + cooldown conditions are met, else None."""
+        if record.get("alert") != self.alert:
+            return None
+        self._streak += 1
+        if self._streak < self.sustain:
+            return None
+        now = self._clock()
+        if (
+            self._last_escalation is not None
+            and now - self._last_escalation < self.cooldown_s
+        ):
+            return None
+        self._last_escalation = now
+        self._streak = 0
+        self.escalations += 1
+        return {
+            "action": "scale_up",
+            "alert": self.alert,
+            "severity": record.get("severity", "warn"),
+            "value": record.get("value"),
+            "threshold": record.get("threshold"),
+            "escalation": self.escalations,
+        }

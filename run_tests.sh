@@ -24,6 +24,13 @@ python scripts/lint_no_print.py
 # un-donated train step doubles peak params+optimizer memory
 python scripts/lint_donation.py
 
+# jax-free lint: the fleet control plane (scheduler, supervisor, serving
+# frontend, live health plane) must import and run without jax — a wedged
+# PJRT client must never be able to stall the process that kills and
+# reschedules workers. Runs before any jax import so the transitive
+# (import-time) check is meaningful.
+python scripts/lint_jax_free.py
+
 mkdir -p artifacts
 
 # Round-6 schedule smoke: AOT-compile (CPU, no execution) one chunked step

@@ -40,6 +40,9 @@ Metrics compared (only those present in BOTH report and baseline):
 - ``critpath_comm_share``    lower is better (report ``critpath`` section —
   share of the cross-rank critical path spent blocked in collective-wait,
   from the observe.critpath analyzer)
+- ``fleet_goodput``          higher is better (report ``fleet`` section —
+  the gang scheduler's deadline-weighted completed work per chip-second
+  over a multi-job game day, from ``resilience.scheduler``)
 - ``hbm_peak_bytes``         lower is better (report ``memory`` section —
   the memory observatory's peak device-memory scalar: the live sampler's
   measured peak when ``memory_stats`` exists, the compile-time predicted
@@ -140,6 +143,12 @@ METRICS: Dict[str, str] = {
     # is a regression even while throughput metrics hold (the OOM you
     # haven't hit yet)
     "hbm_peak_bytes": "lower",
+    # fleet control-plane goodput (report ``fleet.goodput``, from the
+    # resilience.scheduler game day): deadline-weighted completed work per
+    # chip-second across every job the scheduler ran — fewer completions,
+    # more missed deadlines, or more chip-seconds burned by quarantined
+    # crash-loopers all push it down
+    "fleet_goodput": "higher",
 }
 
 # the calibration bound DESIGN.md states for cost-model predictions: a
@@ -248,6 +257,17 @@ def extract_metrics(doc: Dict) -> Dict[str, float]:
     v = doc.get("hbm_peak_bytes")
     if isinstance(v, (int, float)) and v == v and v > 0:
         out.setdefault("hbm_peak_bytes", float(v))
+    # fleet goodput: nested under the report's "fleet" section
+    # (scripts/report.py fleet_summary_from_events), flat in bench
+    # baselines (bench.py reads it from artifacts/fleet_report.json)
+    fleet = doc.get("fleet")
+    if isinstance(fleet, dict):
+        v = fleet.get("goodput")
+        if isinstance(v, (int, float)) and v == v and v > 0:
+            out["fleet_goodput"] = float(v)
+    v = doc.get("fleet_goodput")
+    if isinstance(v, (int, float)) and v == v and v > 0:
+        out.setdefault("fleet_goodput", float(v))
     return out
 
 

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Lint: the fleet control plane must stay importable without jax.
+
+The gang scheduler (``resilience/scheduler.py``), the per-job supervisor
+(``resilience/supervisor.py``), the serving frontend's spool/detector
+plumbing (``serving/frontend.py``), and the live health plane
+(``observe/live.py``, ``observe/health.py``) run in the DRIVER process —
+the one process that must keep making decisions while every worker's jax
+runtime is hung, OOM-killed, or mid-preemption. One ``import jax`` in
+that path and a wedged PJRT client can stall the scheduler at module
+import, exactly when it is supposed to be killing and resharding the
+workers. The contract is structural, so it is enforced structurally:
+
+1. **Direct check** — walk each contract file's AST and fail on any
+   ``import jax``/``import jaxlib``/``from jax ... import`` at ANY
+   scope. Function-local imports are no safer here: the scheduler calls
+   into every helper on its decision path, so a lazy import still puts
+   backend init on the control path.
+2. **Transitive check** — install a meta-path hook that raises on any
+   attempt to import jax/jaxlib, then import each contract MODULE. This
+   catches the regression the per-file walk cannot: a contract file
+   importing a sibling that imports jax at module scope.
+
+Usage::
+
+    python scripts/lint_jax_free.py          # lint the contract set
+    python scripts/lint_jax_free.py path [..]  # AST-lint specific files
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = "network_distributed_pytorch_tpu"
+
+# the jax-free contract set: (repo-relative file, importable module name).
+# Additions to the control plane belong here; removals need a DESIGN.md
+# edit explaining why the file may now touch the accelerator runtime.
+CONTRACT = [
+    ("resilience/scheduler.py", f"{PACKAGE}.resilience.scheduler"),
+    ("resilience/supervisor.py", f"{PACKAGE}.resilience.supervisor"),
+    ("serving/frontend.py", f"{PACKAGE}.serving.frontend"),
+    ("observe/live.py", f"{PACKAGE}.observe.live"),
+    ("observe/health.py", f"{PACKAGE}.observe.health"),
+]
+
+BANNED_ROOTS = ("jax", "jaxlib")
+
+
+def _banned(name: str) -> bool:
+    root = name.split(".", 1)[0]
+    return root in BANNED_ROOTS
+
+
+def banned_imports(path: str):
+    """``(lineno, description)`` for every jax/jaxlib import in the file,
+    at any scope (module, function, conditional)."""
+    with open(path, "rb") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if _banned(alias.name):
+                    yield node.lineno, f"import {alias.name}"
+        elif isinstance(node, ast.ImportFrom):
+            # level>0 (relative imports) can never resolve to jax
+            if node.level == 0 and node.module and _banned(node.module):
+                yield node.lineno, f"from {node.module} import ..."
+
+
+class _JaxBlocker:
+    """Meta-path hook that turns any jax/jaxlib import into an error."""
+
+    class Blocked(ImportError):
+        pass
+
+    def find_spec(self, fullname, path=None, target=None):
+        if _banned(fullname):
+            raise self.Blocked(
+                f"jax-free contract module pulled in {fullname!r}"
+            )
+        return None
+
+
+def transitive_violations():
+    """Import each contract module with jax imports blocked; yields a
+    description per module whose import graph reaches jax. Runs in THIS
+    process — jax must not already be imported (the blocker only fires
+    on fresh imports), so the runner keeps this script jax-free too."""
+    if any(_banned(m) for m in sys.modules):
+        yield (
+            "lint harness error: jax already imported before the "
+            "transitive check — run this script in a fresh process"
+        )
+        return
+    blocker = _JaxBlocker()
+    sys.meta_path.insert(0, blocker)
+    try:
+        for rel, module in CONTRACT:
+            try:
+                importlib.import_module(module)
+            except _JaxBlocker.Blocked as e:
+                yield f"{rel}: transitive {e}"
+    finally:
+        sys.meta_path.remove(blocker)
+
+
+def lint(paths) -> int:
+    violations = []
+    if paths:
+        targets = [(p, None) for p in paths]
+    else:
+        targets = [
+            (os.path.join(REPO, PACKAGE, rel), module)
+            for rel, module in CONTRACT
+        ]
+    for path, _module in targets:
+        for lineno, desc in banned_imports(path):
+            violations.append(f"{path}:{lineno} {desc}")
+    if not paths:
+        sys.path.insert(0, REPO)
+        violations.extend(transitive_violations())
+    if violations:
+        sys.stderr.write(
+            "jax-free contract violations (the fleet control plane must "
+            "import and run without jax — see DESIGN.md):\n"
+        )
+        for v in violations:
+            sys.stderr.write(f"  {v}\n")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(lint(sys.argv[1:]))

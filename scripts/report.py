@@ -386,6 +386,113 @@ def render_request_section(slo: Dict) -> List[str]:
     return lines
 
 
+def fleet_summary_from_events(events: List[Dict]) -> Optional[Dict]:
+    """Fleet control-plane aggregate over the scheduler's typed events
+    (``job`` lifecycle, ``preempt``, ``schedule``, ``job_failed``): per-job
+    outcome rows plus the deadline-weighted goodput scalar the gate
+    compares — completed work weighted 1.0 when the deadline was met (or
+    none was set), 0.5 when missed, divided by every chip-second any
+    terminal job held. None when the run scheduled nothing."""
+    job_events = [e for e in events if e.get("event") == "job"]
+    if not job_events:
+        return None
+    jobs: Dict[str, Dict] = {}
+    for e in job_events:
+        j = jobs.setdefault(
+            str(e.get("job_id", "?")),
+            {
+                "kind": e.get("kind", ""),
+                "priority": e.get("priority", 0),
+                "state": "unfinished",
+                "transitions": [],
+                "preemptions": 0,
+                "chip_seconds": None,
+                "work_done": None,
+                "met_deadline": None,
+            },
+        )
+        state = e.get("state")
+        j["transitions"].append(state)
+        j["preemptions"] = max(
+            j["preemptions"], int(e.get("preemptions", 0) or 0)
+        )
+        if state in ("completed", "failed"):
+            j["state"] = "quarantined" if state == "failed" else state
+            j["chip_seconds"] = e.get("chip_seconds")
+            j["work_done"] = e.get("work_done")
+            j["met_deadline"] = e.get("met_deadline")
+    schedules = [e for e in events if e.get("event") == "schedule"]
+    terminal = [j for j in jobs.values() if j["state"] != "unfinished"]
+    total_chip_s = sum(
+        j["chip_seconds"] for j in terminal
+        if isinstance(j["chip_seconds"], (int, float))
+    )
+    weighted = sum(
+        (0.5 if j["met_deadline"] is False else 1.0) * j["work_done"]
+        for j in terminal
+        if j["state"] == "completed"
+        and isinstance(j["work_done"], (int, float))
+    )
+    return {
+        "n_jobs": len(jobs),
+        "jobs": jobs,
+        "completed": sorted(
+            k for k, j in jobs.items() if j["state"] == "completed"
+        ),
+        "quarantined": sorted(
+            k for k, j in jobs.items() if j["state"] == "quarantined"
+        ),
+        "unfinished": sorted(
+            k for k, j in jobs.items() if j["state"] == "unfinished"
+        ),
+        "preemptions": sum(
+            1 for e in events if e.get("event") == "preempt"
+        ),
+        "admissions": len(schedules),
+        "planner_priced": sum(
+            1 for e in schedules if e.get("planner") == "costmodel"
+        ),
+        "total_chip_seconds": total_chip_s,
+        "weighted_work": weighted,
+        "goodput": (weighted / total_chip_s) if total_chip_s else None,
+    }
+
+
+def render_fleet_section(fleet: Dict) -> List[str]:
+    lines = ["", "fleet control plane (gang scheduler)",
+             "------------------------------------"]
+    lines.append(
+        f"  {fleet['n_jobs']} job(s): {len(fleet['completed'])} completed, "
+        f"{len(fleet['quarantined'])} quarantined, "
+        f"{len(fleet['unfinished'])} unfinished; "
+        f"{fleet['preemptions']} preemption(s) over "
+        f"{fleet['admissions']} admission(s) "
+        f"({fleet['planner_priced']} planner-priced)"
+    )
+    for name, j in sorted(fleet["jobs"].items()):
+        chip = j.get("chip_seconds")
+        chip_txt = f"{chip:8.1f} chip-s" if chip is not None else "     n/a      "
+        met = j.get("met_deadline")
+        met_txt = (
+            "deadline met" if met
+            else "deadline MISSED" if met is False
+            else "no deadline"
+        )
+        lines.append(
+            f"  {name:<12} {j.get('kind', '?'):<5} prio {j['priority']:>3}  "
+            f"{j['state']:<12} {chip_txt}  {met_txt}  "
+            f"{j['preemptions']} preemption(s)"
+        )
+    gp = fleet.get("goodput")
+    if gp is not None:
+        lines.append(
+            f"  goodput  {gp:.4f} weighted-work/chip-s over "
+            f"{fleet['total_chip_seconds']:.1f} chip-s (the gate's fleet"
+            " scalar, higher = better)"
+        )
+    return lines
+
+
 def recovery_latency_s(events: List[Dict]) -> Optional[float]:
     """Seconds from the FIRST injected comm fault to the first healthy
     step after it — a step whose window (previous step's close, its close]
@@ -739,6 +846,10 @@ def render_report(events: List[Dict], name: str = "", skipped_lines: int = 0) ->
     slo = slo_summary_from_events(events)
     if slo:
         lines.extend(render_request_section(slo))
+
+    fleet = fleet_summary_from_events(events)
+    if fleet:
+        lines.extend(render_fleet_section(fleet))
 
     notes = by_kind.get("note", [])
     if notes:
@@ -1664,6 +1775,9 @@ def run_report(
         # per-request serving SLOs (None when the run served nothing);
         # the gate's serving scalar is slo.p99_decode_ms_per_token
         "slo": slo_summary_from_events(merged.events),
+        # fleet control-plane aggregate (None when the run scheduled no
+        # jobs); the gate's fleet scalar is fleet.goodput (higher = better)
+        "fleet": fleet_summary_from_events(merged.events),
         # the memory observatory's join: compile-time predicted peak vs
         # the live sampler's measured peak per rank — ALWAYS present (a
         # CPU run keeps predicted and marks measured unavailable); the
@@ -1694,6 +1808,7 @@ _COMPARE_ROWS = (
     ("alerts.fired", "alerts fired", lambda v: f"{v:.0f}"),
     ("policy.descends", "policy descends", lambda v: f"{v:.0f}"),
     ("recovery_latency_s", "recovery latency", lambda v: f"{v:.2f} s"),
+    ("fleet.goodput", "fleet goodput", lambda v: f"{v:.4f}/chip-s"),
 )
 _COMPARE_TOP_SPANS = 5
 

@@ -1375,6 +1375,231 @@ def main(argv=None) -> int:
         f" {base_peak / 1e6:.0f} MB; doubled footprint tripped the gate)"
         f" report -> {mem_json}\n"
     )
+
+    # --- phase 10: the fleet game day ------------------------------------
+    # The gang scheduler (resilience.scheduler) runs a MULTI-JOB survival
+    # scenario on a 4-chip inventory: a high-priority serving pool (2
+    # chips), a low-priority training job (2 chips), and a crash-looping
+    # job (1 chip) that must end in quarantine without ever wedging the
+    # queue. The serving pool's live plane fires a (deliberately
+    # hair-trigger) slo_burn; the scheduler must preempt the training job
+    # through the graceful SIGTERM -> committed-state -> exit-75 drain,
+    # park it, reserve the freed chips for the burning pool, resume the
+    # job when the pool finishes — and the resumed job's final state must
+    # match an UNINTERRUPTED oracle run bit-for-bit. The merged fleet
+    # report must carry the fleet section with a finite-positive goodput
+    # the gate reads in both directions.
+    from network_distributed_pytorch_tpu.observe.health import (
+        DetectorConfig,
+    )
+    from network_distributed_pytorch_tpu.resilience.scheduler import (
+        FleetConfig,
+        FleetScheduler,
+        JobManifest,
+        JobSpool,
+    )
+
+    fleet_dir = run_dir + "_fleet"
+    shutil.rmtree(fleet_dir, ignore_errors=True)
+    os.makedirs(fleet_dir, exist_ok=True)
+    req_dir = os.path.join(fleet_dir, "requests")
+    FileSpool(req_dir).ensure(
+        poisson_workload(
+            WorkloadConfig(n_requests=48, rate_rps=0.0, seed=7)
+        )
+    )
+    fleet_state = os.path.join(fleet_dir, "train_state")
+    serve_job = JobManifest(
+        job_id="svc", kind="serve", priority=10,
+        min_world=2, max_world=2, steps=48, deadline_s=60.0,
+        argv=[
+            sys.executable, serve_worker,
+            "--rank", "{rank}", "--world", "{world}",
+            "--spool-dir", req_dir,
+            "--result-dir", os.path.join(fleet_dir, "serve_results"),
+            "--slots", "2", "--step-seconds", "0.02",
+            "--max-wall-s", "45",
+        ],
+    )
+    # min_world == max_world: the toy worker's state update is
+    # world-sensitive, and the bitwise oracle match below REQUIRES the
+    # post-preemption resume to land at the same world it was parked at
+    train_fleet_job = JobManifest(
+        job_id="train", kind="train", priority=1,
+        min_world=2, max_world=2, steps=40, deadline_s=120.0,
+        argv=[
+            sys.executable, worker,
+            "--rank", "{rank}", "--world", "{world}",
+            "--steps", "40", "--step-seconds", "0.12",
+            "--graceful-term",
+            "--state-dir", fleet_state,
+            "--result-dir", os.path.join(fleet_dir, "train_results"),
+        ],
+    )
+    crash_fleet_job = JobManifest(
+        job_id="looper", kind="train", priority=0,
+        min_world=1, max_world=1, max_strikes=3, max_restarts=0,
+        argv=[sys.executable, "-c", "raise SystemExit(43)"],
+    )
+    fleet_spool = JobSpool(os.path.join(fleet_dir, "jobs"))
+    fleet_spool.submit([serve_job, train_fleet_job, crash_fleet_job])
+    fleet_summary = FleetScheduler(
+        fleet_spool,
+        config=FleetConfig(
+            n_devices=4, max_wall_s=120.0, term_grace_s=3.0,
+            escalation_sustain=1, escalation_cooldown_s=5.0,
+            serve_detector=DetectorConfig(
+                slo_target_s=1e-3, slo_sustain=1, cooldown=2
+            ),
+        ),
+        run_dir=fleet_dir,
+    ).run()
+
+    problems = []
+    if len(fleet_summary["jobs"]) != 3:
+        problems.append(f"expected 3 fleet jobs: {fleet_summary['jobs']}")
+    if set(fleet_summary["completed"]) != {"svc", "train"}:
+        problems.append(
+            f"completed {fleet_summary['completed']}, expected svc+train"
+        )
+    if fleet_summary["quarantined"] != ["looper"]:
+        problems.append(
+            f"quarantined {fleet_summary['quarantined']}, expected looper"
+        )
+    if fleet_summary["unfinished"]:
+        problems.append(
+            f"unfinished jobs {fleet_summary['unfinished']} — the"
+            " crash-looper blocked the queue"
+        )
+    if fleet_summary["preemptions"] < 1:
+        problems.append("no SLO-burn preemption happened")
+    train_rec = fleet_summary["jobs"].get("train", {})
+    if train_rec.get("preemptions", 0) < 1:
+        problems.append(f"training job was never preempted: {train_rec}")
+    if fleet_spool.quarantined_ids() != ["looper"]:
+        problems.append(
+            f"quarantine dir holds {fleet_spool.quarantined_ids()}"
+        )
+
+    # the preemption rode the typed event stream: a preempt record naming
+    # victim + slo_burn, and the victim's parked -> resumed round trip
+    preempts, train_states = [], []
+    try:
+        with open(os.path.join(fleet_dir, SUPERVISOR_LOG)) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("event") == "preempt":
+                    preempts.append(rec)
+                if (
+                    rec.get("event") == "job"
+                    and rec.get("job_id") == "train"
+                ):
+                    train_states.append(rec.get("state"))
+    except OSError:
+        pass
+    if not any(
+        p.get("victim") == "train" and p.get("reason") == "slo_burn"
+        for p in preempts
+    ):
+        problems.append(f"no slo_burn preempt event for train: {preempts}")
+    for want in ("preempting", "parked", "resumed", "completed"):
+        if want not in train_states:
+            problems.append(
+                f"train lifecycle missing {want!r}: {train_states}"
+            )
+
+    # bitwise oracle: an uninterrupted run of the same job must land the
+    # exact same per-rank state (the preemption drained through the
+    # committed-checkpoint path, so resume lost nothing)
+    oracle_state = os.path.join(fleet_dir, "oracle_state")
+    oracle_procs = [
+        subprocess.Popen([
+            sys.executable, worker,
+            "--rank", str(r), "--world", "2",
+            "--steps", "40", "--step-seconds", "0.005",
+            "--state-dir", oracle_state,
+            "--result-dir", os.path.join(fleet_dir, "oracle_results"),
+        ])
+        for r in range(2)
+    ]
+    if any(p.wait() != 0 for p in oracle_procs):
+        problems.append("oracle train run failed")
+    else:
+        for r in range(2):
+            try:
+                with open(
+                    os.path.join(fleet_state, f"rank{r}.json")
+                ) as f:
+                    got = json.load(f)
+                with open(
+                    os.path.join(oracle_state, f"rank{r}.json")
+                ) as f:
+                    want = json.load(f)
+            except (OSError, ValueError) as exc:
+                problems.append(f"oracle compare unreadable: {exc}")
+                continue
+            if got != want:
+                problems.append(
+                    f"rank {r} resumed state diverged from the"
+                    f" uninterrupted oracle: {got} != {want}"
+                )
+
+    fleet_json = os.path.join(fleet_dir, "report.json")
+    if report.main(["--run-dir", fleet_dir, "--json-out", fleet_json]) != 0:
+        return 1
+    with open(fleet_json) as f:
+        fleet_doc = json.load(f)
+    fleet_section = fleet_doc.get("fleet") or {}
+    goodput = fleet_section.get("goodput")
+    if not (isinstance(goodput, (int, float)) and goodput > 0):
+        problems.append(f"fleet goodput not finite-positive: {goodput!r}")
+    if "fleet_goodput" not in gate.extract_metrics(fleet_doc):
+        problems.append(f"gate cannot extract fleet_goodput from {fleet_json}")
+    if problems:
+        for prob in problems:
+            sys.stderr.write(f"# run_probe: FAIL: {prob}\n")
+        return 1
+
+    # publish the fleet scalar where bench.py records baselines from
+    artifacts = os.path.join(REPO, "artifacts")
+    os.makedirs(artifacts, exist_ok=True)
+    with open(os.path.join(artifacts, "fleet_report.json"), "w") as f:
+        json.dump(
+            {"fleet_goodput": float(goodput), **fleet_summary}, f, indent=1
+        )
+
+    # gate directionality: today's goodput holds against a worse baseline
+    # (PASS) and trips against an unreachably better one (NONZERO)
+    fleet_baseline = os.path.join(fleet_dir, "gate_baseline.json")
+    with open(fleet_baseline, "w") as f:
+        json.dump({"fleet_goodput": float(goodput) * 0.5}, f)
+    if gate.main([
+        "--report", fleet_json, "--baseline", fleet_baseline, "--root", REPO,
+    ]) != 0:
+        sys.stderr.write(
+            "# run_probe: FAIL: gate rejected a HELD fleet_goodput\n"
+        )
+        return 1
+    with open(fleet_baseline, "w") as f:
+        json.dump({"fleet_goodput": float(goodput) * 10.0}, f)
+    if gate.main([
+        "--report", fleet_json, "--baseline", fleet_baseline, "--root", REPO,
+    ]) == 0:
+        sys.stderr.write(
+            "# run_probe: FAIL: gate passed a collapsed fleet_goodput\n"
+        )
+        return 1
+    sys.stderr.write(
+        "# run_probe: fleet game day ok (3 jobs on 4 chips;"
+        f" {fleet_summary['preemptions']} slo_burn preemption(s); train"
+        " parked + resumed with a bitwise oracle match; crash-looper"
+        f" quarantined after {fleet_summary['jobs']['looper']['strikes']}"
+        f" strikes without blocking; goodput {goodput:.3f}/chip-s)"
+        f" report -> {fleet_json}\n"
+    )
     return 0
 
 

@@ -1,0 +1,167 @@
+"""resilience/scheduler.py: the fleet control plane's jax-free mechanics.
+
+The fast twin of run_probe's phase-10 game day. The full multi-job storm
+(SLO-burn preemption, bitwise resume oracle) lives in the probe; these
+tests pin the pieces it rides on:
+
+- **Manifests**: wire round-trip is lossless, argv placeholder tokens
+  substitute per worker, and malformed submissions are rejected at
+  construction.
+- **Job spool**: a malformed queue doc is quarantined on claim (never
+  crash-loops or wedges the control plane), and a parked job's mutable
+  bookkeeping (preemptions, strikes, chip-seconds) survives the
+  park/re-claim round-trip — a restarted scheduler sees history intact.
+- **Admission math**: viable worlds honor plan_mesh's divisor
+  discipline, and chips reserved for a burning pool are invisible to
+  every OTHER job's admission.
+- **End to end**: a real (subprocess-spawning) two-job fleet completes
+  the good job, quarantines the crash-looper after max_strikes without
+  blocking the queue, and reports a positive goodput.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from network_distributed_pytorch_tpu.resilience.scheduler import (
+    FleetConfig,
+    FleetScheduler,
+    JobManifest,
+    JobSpool,
+)
+from network_distributed_pytorch_tpu.resilience.supervisor import plan_mesh
+
+
+def test_manifest_wire_roundtrip_and_argv_tokens():
+    job = JobManifest(
+        job_id="svc",
+        argv=[
+            "python", "-u", "w.py", "--rank", "{rank}", "--world",
+            "{world}", "--dev", "{device_rank}", "--gen", "{incarnation}",
+        ],
+        kind="serve",
+        priority=5,
+        deadline_s=30.0,
+        min_world=2,
+        max_world=4,
+        steps=10.0,
+        env={"A": "1"},
+        preemptions=1,
+        strikes=1,
+        chip_seconds=2.5,
+    )
+    clone = JobManifest.from_wire(json.loads(json.dumps(job.to_wire())))
+    assert clone == job  # lossless, bookkeeping included
+    argv = clone.worker_argv(rank=1, world=2, incarnation=3, device_rank=7)
+    assert argv == [
+        "python", "-u", "w.py", "--rank", "1", "--world", "2",
+        "--dev", "7", "--gen", "3",
+    ]
+    with pytest.raises(ValueError):
+        JobManifest(job_id="x", argv=["p"], kind="batch")
+    with pytest.raises(ValueError):
+        JobManifest(job_id="x", argv=["p"], min_world=3, max_world=2)
+    with pytest.raises(ValueError):
+        JobManifest(job_id="x", argv=[])
+
+
+def test_jobspool_quarantines_malformed_and_keeps_queue_moving(tmp_path):
+    spool = JobSpool(str(tmp_path / "jobs"))
+    assert spool.submit([JobManifest(job_id="good", argv=["x"])]) == 1
+    # a bad submission lands straight on the queue (sorts before "good",
+    # so the claim loop hits it first)
+    bad_path = os.path.join(spool._spool.queue_dir, "bad.json")
+    with open(bad_path, "w") as f:
+        json.dump({"job_id": "bad", "argv": ["x"], "kind": "gpu-hours"}, f)
+    claimed = []
+    while True:
+        j = spool.claim()
+        if j is None:
+            break
+        claimed.append(j.job_id)
+    assert claimed == ["good"]  # bad never surfaced, never wedged
+    assert spool.quarantined_ids() == ["bad"]
+    # the forensics copy names why
+    with open(os.path.join(spool.quarantine_dir, "bad.json")) as f:
+        assert "malformed manifest" in json.load(f)["quarantine_reason"]
+
+
+def test_jobspool_park_carries_bookkeeping(tmp_path):
+    spool = JobSpool(str(tmp_path / "jobs"))
+    spool.submit([JobManifest(job_id="j", argv=["x"], preemption_budget=2)])
+    job = spool.claim()
+    job.preemptions += 1
+    job.strikes = 1
+    job.chip_seconds = 12.5
+    job.work_done = 3.0
+    job.last_rc = 75
+    spool.park(job)
+    # re-submitting a parked id is a no-op (idempotent enqueue)
+    assert spool.submit([JobManifest(job_id="j", argv=["x"])]) == 0
+    again = spool.claim()
+    assert (
+        again.preemptions, again.strikes, again.chip_seconds,
+        again.work_done, again.last_rc,
+    ) == (1, 1, 12.5, 3.0, 75)
+
+
+def test_viable_worlds_and_reservations(tmp_path):
+    sched = FleetScheduler(
+        JobSpool(str(tmp_path / "jobs")), FleetConfig(n_devices=8)
+    )
+    # pure DP: every world in [min_world, cap]
+    dp = JobManifest(job_id="dp", argv=["x"], min_world=2, max_world=6)
+    assert sched._viable_worlds(dp, cap=5) == [2, 3, 4, 5]
+    # meshed: only worlds plan_mesh can realize under divisor discipline
+    axes = {"data": 2, "fsdp": 2, "tensor": 2}
+    meshy = JobManifest(
+        job_id="m", argv=["x"], min_world=2, max_world=8, mesh_axes=axes
+    )
+    worlds = sched._viable_worlds(meshy, cap=8)
+    assert worlds and 8 in worlds
+    for w in worlds:
+        mesh = plan_mesh(axes, w, 2)
+        assert mesh is not None
+        assert mesh["data"] * mesh["fsdp"] * mesh["tensor"] == w
+    # chips reserved for another job are invisible to this job's
+    # admission; the reservation holder still sees them
+    sched._reserved["svc"] = [0, 1]
+    assert sched._grantable(dp) == [2, 3, 4, 5, 6, 7]
+    svc = JobManifest(job_id="svc", argv=["x"])
+    assert sched._grantable(svc) == list(range(8))
+
+
+def test_fleet_completes_and_quarantines_crash_looper(tmp_path):
+    spool = JobSpool(str(tmp_path / "jobs"))
+    spool.submit([
+        JobManifest(
+            job_id="ok",
+            argv=[sys.executable, "-c", "pass"],
+            steps=2.0,
+        ),
+        JobManifest(
+            job_id="boom",
+            argv=[sys.executable, "-c", "raise SystemExit(43)"],
+            priority=1,  # outranks ok — still must not wedge the fleet
+            max_restarts=0,
+            max_strikes=2,
+        ),
+    ])
+    sched = FleetScheduler(
+        spool,
+        FleetConfig(n_devices=2, max_wall_s=60.0, term_grace_s=2.0),
+        run_dir=str(tmp_path / "fleet"),
+    )
+    summary = sched.run()
+    assert summary["completed"] == ["ok"]
+    assert summary["quarantined"] == ["boom"]
+    assert summary["unfinished"] == []
+    assert spool.quarantined_ids() == ["boom"]
+    assert summary["jobs"]["boom"]["last_rc"] == 43
+    assert summary["jobs"]["boom"]["strikes"] == 2
+    # goodput counts ok's work against EVERY chip-second, boom's included
+    assert summary["goodput"] > 0.0
+    assert summary["total_chip_seconds"] > 0.0
+    assert summary["weighted_work"] == 2.0

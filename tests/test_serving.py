@@ -25,6 +25,8 @@ import importlib.util
 import json
 import os
 import sys
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -190,6 +192,97 @@ def test_spool_requeue_skips_completed_orphans(tmp_path):
     survivor = FileSpool(root, rank=0, incarnation=0)
     assert survivor.requeue_orphans(world=1) == 0
     assert survivor.claim() is None and survivor.drained()
+
+
+# --- doc-primitive contention (the job spool rides on these) --------------
+
+
+def test_spool_doc_contention_exactly_once(tmp_path):
+    """N concurrent claimers (plus a scavenger hammering requeue_orphans
+    with everyone alive) drain a doc workload with zero double-claims and
+    zero lost entries. The claim path is one atomic os.rename per entry —
+    this drives the actual race, not a serialized approximation, because
+    the fleet scheduler's job admission rides on exactly these
+    primitives."""
+    root = str(tmp_path / "spool")
+    n_docs, n_workers = 48, 8
+    docs = {f"job-{i:03d}": {"doc_id": f"job-{i:03d}", "n": i}
+            for i in range(n_docs)}
+    assert FileSpool(root).ensure_docs(docs) == n_docs
+    assert FileSpool(root).ensure_docs(docs) == 0  # idempotent
+
+    claims = []  # (worker, entry_id) — append is atomic under the GIL
+    stop = threading.Event()
+
+    def claimer(idx):
+        spool = FileSpool(root, rank=idx, incarnation=0)
+        while not stop.is_set():
+            got = spool.claim_doc()
+            if got is None:
+                # empty OR every rename race lost this pass — poll again
+                # until the drain flag says the workload is done
+                time.sleep(0.001)
+                continue
+            entry_id, doc = got
+            claims.append((idx, entry_id))
+            spool.complete_doc(entry_id, dict(doc, state="done", by=idx))
+
+    def scavenger():
+        # all ranks < world and at their live incarnation: every
+        # requeue_orphans call must find nothing to steal, even racing
+        # against in-flight renames
+        spool = FileSpool(root, rank=0, incarnation=0)
+        while not stop.is_set():
+            assert spool.requeue_orphans(world=n_workers) == 0
+            time.sleep(0.001)
+
+    threads = [
+        threading.Thread(target=claimer, args=(i,)) for i in range(n_workers)
+    ] + [threading.Thread(target=scavenger)]
+    for t in threads:
+        t.start()
+    check = FileSpool(root)
+    deadline = time.monotonic() + 60.0
+    while not check.drained():
+        assert time.monotonic() < deadline, "spool failed to drain"
+        time.sleep(0.005)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+
+    claimed_ids = [entry_id for _, entry_id in claims]
+    assert len(claimed_ids) == n_docs, "an entry was claimed twice or lost"
+    assert set(claimed_ids) == set(docs)
+    done = check.done_records()
+    assert set(done) == set(docs)
+    # every completion names the worker whose claim produced it
+    by_worker = {e: w for w, e in claims}
+    for entry_id, doc in done.items():
+        assert doc["by"] == by_worker[entry_id]
+
+
+def test_spool_doc_release_reclaim_roundtrip(tmp_path):
+    """release_doc parks a live claim back onto the queue with an updated
+    document — the fleet scheduler's preempt/park path. The re-claimed doc
+    carries the update, the manifest never changes, and drained() stays
+    False until the entry actually completes."""
+    root = str(tmp_path / "spool")
+    docs = {"only": {"doc_id": "only", "steps_done": 0}}
+    FileSpool(root).ensure_docs(docs)
+    first = FileSpool(root, rank=0, incarnation=0)
+    entry_id, doc = first.claim_doc()
+    assert entry_id == "only"
+    first.release_doc(entry_id, dict(doc, steps_done=7))  # park
+    assert not first.drained()
+    # parked entries are invisible to requeue_orphans (already queued)
+    assert first.requeue_orphans(world=1) == 0
+    second = FileSpool(root, rank=0, incarnation=1)
+    entry_id2, doc2 = second.claim_doc()
+    assert entry_id2 == "only" and doc2["steps_done"] == 7  # resume state
+    assert first.manifest_ids() == ["only"]
+    second.complete_doc(entry_id2, dict(doc2, state="done"))
+    assert second.drained()
 
 
 # --- toy-engine fail-over (jax-free, the probe's fast twin) ---------------
