@@ -1584,6 +1584,8 @@ def orchestrate() -> int:
             f"{type(crashed).__name__}: {crashed}"[:300]
         )
     out["wall_s"] = round(time.time() - t_start, 1)
+    if crashed is None:  # a crashed round has nothing worth gating
+        _run_perf_gate(out, status)
     _persist_midround(out, status)
     _record_gate_baseline(out, status)
     _emit(out)
@@ -1644,6 +1646,38 @@ def _persist_midround(out: dict, status: dict) -> None:
         os.replace(tmp, path)
     except OSError:  # persistence is best-effort; the line already printed
         pass
+
+
+def _run_perf_gate(out: dict, status: dict) -> None:
+    """Gate the round's freshest run report against the PREVIOUS round's
+    recorded baseline, before ``_record_gate_baseline`` overwrites it.
+
+    The chip tier runs ``scripts/gate.py --strict-device`` (ROADMAP item 4
+    leftover): a ``device=cpu`` fallback record must FAIL against a chip
+    baseline instead of silently satisfying it — cross-hardware ratios are
+    not regressions, they are provenance errors. The CPU smoke tier stays
+    advisory: shared CI boxes gate like-for-like drift informationally and
+    never block on hardware they do not have. The verdict rides the
+    published record (``gate`` in phases, ``gate_strict_device`` on the
+    line) either way."""
+    report_path = os.path.join(HERE, "artifacts", "run_report.json")
+    baseline_path = os.path.join(HERE, "artifacts", "GATE_BASELINE.json")
+    if not (os.path.exists(report_path) and os.path.exists(baseline_path)):
+        status["gate"] = "skipped: no report/baseline pair"
+        return
+    chip_tier = out.get("platform") == "tpu"
+    argv = [
+        sys.executable, os.path.join(HERE, "scripts", "gate.py"),
+        "--report", report_path, "--root", HERE,
+        "--strict-device" if chip_tier else "--advisory",
+    ]
+    try:
+        rc = subprocess.run(argv, timeout=120).returncode
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        status["gate"] = f"error: {type(exc).__name__}"[:60]
+        return
+    out["gate_strict_device"] = chip_tier
+    status["gate"] = "ok" if rc == 0 else f"regressed (exit {rc})"
 
 
 def _record_gate_baseline(out: dict, status: dict) -> None:

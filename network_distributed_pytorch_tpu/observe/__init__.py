@@ -96,6 +96,7 @@ from .events import (  # noqa: F401
     MemoryEvent,
     MfuEvent,
     NoteEvent,
+    PartitionEvent,
     PolicyEvent,
     PredictionEvent,
     PreemptEvent,

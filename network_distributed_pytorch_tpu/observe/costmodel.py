@@ -75,8 +75,14 @@ MIN_COMPUTE_FRACTION = 0.05
 
 KNOBS = (
     "reducer", "reducer_rank", "comm_chunks", "comm_strategy",
-    "bucket_bytes", "sync_every",
+    "bucket_bytes", "sync_every", "outer_async", "sites",
 )
+
+# hierarchical pricing: the inner level reduces over the fast in-node
+# fabric, so it is priced on this scalar table entry and never on a
+# measured cross-site matrix (whose bottleneck edge is the slow link)
+INNER_FABRIC = "ICI(v5e)"
+DEFAULT_SITES = 2
 
 
 def canonical_config(config: Optional[Dict], name: str = "") -> Dict:
@@ -87,6 +93,8 @@ def canonical_config(config: Optional[Dict], name: str = "") -> Dict:
     reducer = str(config.get("reducer") or "exact").lower()
     if "powersgd" in reducer:
         reducer = "powersgd"
+    elif "hier" in reducer:
+        reducer = "hierarchical"
     elif reducer not in ("exact",):
         reducer = "exact" if "exact" in reducer else reducer
     rank = config.get("reducer_rank")
@@ -98,6 +106,10 @@ def canonical_config(config: Optional[Dict], name: str = "") -> Dict:
         "comm_strategy": str(config.get("comm_strategy") or "interleave"),
         "bucket_bytes": int(config.get("bucket_bytes") or 0),
         "sync_every": max(1, int(config.get("sync_every") or 1)),
+        # two-level knobs: meaningful only for reducer="hierarchical"
+        # (config_key omits them elsewhere so historical keys are stable)
+        "outer_async": 1 if config.get("outer_async") else 0,
+        "sites": int(config.get("sites") or 0),
     }
     if out["reducer"] == "powersgd" and out["reducer_rank"] == 0:
         out["reducer_rank"] = 1
@@ -107,11 +119,14 @@ def canonical_config(config: Optional[Dict], name: str = "") -> Dict:
 def config_key(config: Dict) -> str:
     """The canonical join key: knob values only, never the display name."""
     c = canonical_config(config)
-    return (
+    key = (
         f"reducer={c['reducer']},rank={c['reducer_rank']},"
         f"chunks={c['comm_chunks']},strategy={c['comm_strategy']},"
         f"bucket={c['bucket_bytes']},sync={c['sync_every']}"
     )
+    if c["reducer"] == "hierarchical":
+        key += f",async={c['outer_async']},sites={c['sites']}"
+    return key
 
 
 @dataclass
@@ -198,6 +213,11 @@ def calibrate(report: Dict, source_fabric: Optional[str] = None) -> CostCalibrat
             frac_per_rank = (
                 (bytes_per_step / dense_bytes) / source_config["reducer_rank"]
             )
+    elif source_config["reducer"] == "hierarchical" and dense_rec:
+        # a two-level source run's wire total folds in the amortized
+        # inner sync phase and the compressed outer round; the recorded
+        # dense gradient size is the honest per-level baseline
+        dense_bytes = dense_rec
 
     # FLOPs from the report's MFU join (first record carrying them)
     flops = peak = 0.0
@@ -265,6 +285,8 @@ def predict(
     beta = model.ring_beta(fabric)
     lat = model.ring_latency_s(fabric)
     c = canonical_config(config)
+    if c["reducer"] == "hierarchical":
+        return _predict_hierarchical(calib, c, fabric, model)
     w = max(1, calib.n_workers)
 
     # bytes on the wire per sync round
@@ -318,6 +340,106 @@ def predict(
         "n_collectives": n_coll,
         # provenance: scalar table vs measured per-edge matrix, and which
         # edge gated the ring when a matrix was supplied
+        "per_edge": model.per_edge,
+        "bottleneck_edge": (
+            {"src": model.bottleneck().src, "dst": model.bottleneck().dst}
+            if model.per_edge else None
+        ),
+    }
+
+
+def _predict_hierarchical(
+    calib: CostCalibration, c: Dict, fabric: str, model
+) -> Dict:
+    """Price a two-level hierarchical config: dense per-step reduction on
+    the fast in-node fabric plus a compressed (or exact, rank=0) outer
+    reduction over site leaders every ``sync_every`` steps on the slow
+    ``fabric``. With ``outer_async`` the outer collective overlaps the
+    next round's inner steps, so only the overflow past that compute
+    window stays exposed — the whole point of the async outer loop.
+
+    The inner level is priced on :data:`INNER_FABRIC`'s scalar even when
+    a measured matrix gates the outer ring: the inner all-reduce never
+    crosses the measured bottleneck edge."""
+    w = max(1, calib.n_workers)
+    sites = c["sites"] or DEFAULT_SITES
+    sites = max(2, min(sites, w)) if w > 1 else 1
+    inner_w = max(1, w // sites)
+    sync = c["sync_every"]
+
+    # inner level: one dense DDP all-reduce per step plus the sync
+    # round's dense inner reduction, on the fast fabric
+    inner_beta = model.fabrics.get(INNER_FABRIC) or max(model.fabrics.values())
+    inner_wire_s = (
+        (2.0 * (inner_w - 1) / inner_w) * (calib.dense_bytes / inner_beta)
+        if inner_w > 1 and inner_beta > 0 else 0.0
+    )
+    inner_per_step_s = (
+        calib.exposed_fraction * inner_wire_s * (1.0 + 1.0 / sync)
+    )
+
+    # outer level: the cross-site ring on the slow edge (matrix
+    # bottleneck when measured), compressed when an outer rank is set
+    beta = model.ring_beta(fabric)
+    lat = model.ring_latency_s(fabric)
+    rank = c["reducer_rank"]
+    if rank > 0:
+        frac = min(1.0, rank * calib.bytes_fraction_per_rank)
+        outer_bytes = calib.dense_bytes * frac
+        n_coll = 2 * calib.n_collectives  # the P and Q round trips
+    else:
+        outer_bytes = calib.dense_bytes
+        n_coll = calib.n_collectives
+    outer_wire_s = (
+        (2.0 * (sites - 1) / sites) * (outer_bytes / beta)
+        if sites > 1 and beta > 0 else 0.0
+    )
+    compress_s = 0.0
+    if rank > 0:
+        eff = calib.effective_flops_per_s
+        if eff > 0:
+            n_elems = calib.dense_bytes / 4.0  # fp32 gradient elements
+            compress_s = (
+                POWERSGD_FLOPS_PER_ELEM_PER_RANK * rank * n_elems
+            ) / eff
+    outer_total_s = outer_wire_s + lat * n_coll + compress_s
+    if c["outer_async"]:
+        # a whole round of inner compute to hide the outer sync in;
+        # only the overflow past that window is exposed
+        window_s = sync * (calib.compute_s + inner_per_step_s)
+        exposed_outer_s = max(0.0, outer_total_s - window_s)
+    else:
+        exposed_outer_s = (
+            calib.exposed_fraction * outer_wire_s + lat * n_coll + compress_s
+        )
+
+    inner_bytes_per_step = calib.dense_bytes * (1.0 + 1.0 / sync)
+    outer_bytes_per_step = outer_bytes / sync
+    per_step_comm_s = inner_per_step_s + exposed_outer_s / sync
+    return {
+        "config": c,
+        "config_key": config_key(c),
+        "fabric": fabric,
+        "predicted_step_s": calib.compute_s + per_step_comm_s,
+        "predicted_bytes_per_step": (
+            inner_bytes_per_step + outer_bytes_per_step
+        ),
+        # per-level breakdown: the cross-site shrinkage claim is
+        # falsifiable against the ledger's outer.*/inner.* tags
+        "predicted_inner_bytes_per_step": inner_bytes_per_step,
+        "predicted_outer_bytes_per_step": outer_bytes_per_step,
+        "compute_s": calib.compute_s,
+        "wire_s": outer_wire_s,
+        # exposed_comm_s here is the full exposed per-step comm (inner +
+        # outer overflow); under async the latency/compress components
+        # may be wholly hidden, so they are reported informationally
+        "exposed_comm_s": per_step_comm_s,
+        "latency_s": lat * n_coll / sync,
+        "compress_s": compress_s / sync,
+        "pipeline_depth": 1,
+        "n_collectives": n_coll,
+        "sites": sites,
+        "outer_async": bool(c["outer_async"]),
         "per_edge": model.per_edge,
         "bottleneck_edge": (
             {"src": model.bottleneck().src, "dst": model.bottleneck().dst}
@@ -453,6 +575,38 @@ def default_configs(calib: Optional[CostCalibration] = None) -> List[Dict]:
             seen.add(config_key(c))
             configs.append(c)
     return configs
+
+
+def hierarchical_configs(
+    calib: Optional[CostCalibration] = None,
+    sync_everys=(4, 8, 16),
+    ranks=(0, 1, 4),
+    asyncs=(0, 1),
+    sites: int = 0,
+) -> List[Dict]:
+    """The hierarchical what-if grid ``scripts/plan.py --hierarchical``
+    prices: sync period H x outer rank (0 = exact outer) x sync/async,
+    over ``sites`` sites (0 = the model's two-site default). This is the
+    planner-side search the issue's site-cut question routes through —
+    the matrix's bottleneck edge prices the outer ring of every entry."""
+    out: List[Dict] = []
+    for sync in sync_everys:
+        for rank in ranks:
+            for a in asyncs:
+                name = f"hier-H{sync}-r{rank}" + ("-async" if a else "")
+                out.append(
+                    canonical_config(
+                        {
+                            "name": name,
+                            "reducer": "hierarchical",
+                            "reducer_rank": rank,
+                            "sync_every": sync,
+                            "outer_async": a,
+                            "sites": sites,
+                        }
+                    )
+                )
+    return out
 
 
 def search(

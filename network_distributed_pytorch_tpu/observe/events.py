@@ -244,6 +244,40 @@ class ReshapeEvent(Event):
 
 
 @dataclass
+class PartitionEvent(Event):
+    """One transition of the geo-resilient outer loop's partition state
+    machine (:mod:`parallel.hierarchical` / ``resilience.guards.
+    PartitionPolicy``): the cross-site edge was declared dead
+    (``phase="partitioned"`` — outer-deadline expiry or an injected
+    ``comm_partition`` fault), training continued site-local
+    (``phase="local"``, one event per local-only outer round, with the
+    running ``local_steps`` against the ``max_local_steps`` divergence
+    budget), or the edge healed and the EF-corrected catch-up reduction
+    merged the sites back (``phase="rejoin"``). ``outer_staleness`` is the
+    number of outer rounds since the last completed cross-site sync — the
+    live plane's staleness gauge reads it straight off this record.
+    ``scripts/report.py`` orders these into the run's partition timeline
+    next to the failure timeline. The banner is the record as JSON, like
+    :class:`FailureEvent`."""
+
+    KIND: ClassVar[str] = "partition"
+
+    phase: str  # "partitioned" | "local" | "rejoin"
+    edge: Optional[List[int]] = None  # (src, dst) rank pair, None = unknown
+    local_steps: int = 0
+    max_local_steps: Optional[int] = None
+    outer_staleness: int = 0
+    reason: str = ""
+    rank: Optional[int] = None
+    step: Optional[int] = None
+    incarnation: Optional[int] = None
+
+    def banner(self) -> str:
+        rec = {k: v for k, v in self.record().items() if v is not None}
+        return json.dumps(rec, default=str)
+
+
+@dataclass
 class MarkerEvent(Event):
     """A run-lifecycle marker. The ``run_start`` marker is the shared
     alignment anchor of :mod:`observe.runlog`: emitted as the FIRST record

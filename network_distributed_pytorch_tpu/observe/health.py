@@ -120,6 +120,12 @@ class DetectorConfig:
     headroom_critical_frac: float = 0.95
     headroom_sustain: int = 2
     headroom_min_obs: int = 2
+    # outer staleness (site-local steps / divergence budget during a
+    # cross-site partition) — thresholdy, not statistical: the budget is
+    # a hard contract, so the detector fires on fractions of it
+    staleness_warn_frac: float = 0.5
+    staleness_critical_frac: float = 0.9
+    staleness_sustain: int = 1  # budget burn must page on the first obs
     # shared
     cooldown: int = 20  # observations of silence after a fired alert
 
@@ -359,6 +365,43 @@ class HbmHeadroomDetector(_Detector):
         return None
 
 
+class OuterStalenessDetector(_Detector):
+    """Divergence-budget burn during a cross-site partition: the value is
+    the staleness FRACTION (site-local steps / ``--max-local-steps``).
+    Unlike the statistical detectors there is no baseline to learn — the
+    budget is the contract :class:`resilience.guards.PartitionPolicy`
+    escalates on, so the detector pages at fixed fractions of it: warn at
+    ``staleness_warn_frac`` (partition persisting), critical at
+    ``staleness_critical_frac`` (escalation imminent)."""
+
+    name = "outer_staleness"
+
+    def __init__(self, cfg: DetectorConfig):
+        super().__init__(cfg.staleness_sustain, cfg.cooldown)
+        self._cfg = cfg
+
+    def _check(self, value: float):
+        cfg = self._cfg
+        if not math.isfinite(value) or value < 0.0:
+            return None
+        if value >= cfg.staleness_critical_frac:
+            return (
+                "critical",
+                cfg.staleness_critical_frac,
+                f"outer staleness {100 * value:.0f}% of divergence budget"
+                f" (>= {100 * cfg.staleness_critical_frac:g}% —"
+                " escalation imminent)",
+            )
+        if value >= cfg.staleness_warn_frac:
+            return (
+                "warn",
+                cfg.staleness_warn_frac,
+                f"outer staleness {100 * value:.0f}% of divergence budget"
+                f" (partition persisting)",
+            )
+        return None
+
+
 class HealthMonitor:
     """The detector bank, keyed by signal. The aggregator routes each
     derived signal to :meth:`observe_*` as events stream in; every call
@@ -379,6 +422,7 @@ class HealthMonitor:
         ] = {}
         self._slo = SloBurnRateDetector(self.config)
         self._hbm: Dict[Optional[int], HbmHeadroomDetector] = {}
+        self._staleness: Dict[Optional[int], OuterStalenessDetector] = {}
         self.alerts: List[AlertEvent] = []
 
     def _keep(self, alert: Optional[AlertEvent]) -> List[AlertEvent]:
@@ -442,6 +486,32 @@ class HealthMonitor:
         return self._keep(
             det.observe(
                 float(bytes_in_use) / float(bytes_limit), rank=rank, step=step
+            )
+        )
+
+    def observe_outer_staleness(
+        self,
+        local_steps: float,
+        max_local_steps: float,
+        rank: Optional[int] = None,
+        step: Optional[int] = None,
+    ) -> List[AlertEvent]:
+        """Budget-burn watch on a partition's site-local stretch. A
+        sample without a positive budget is dropped silently — no budget
+        means no escalation contract to page against."""
+        if (
+            not isinstance(max_local_steps, (int, float))
+            or not math.isfinite(float(max_local_steps))
+            or float(max_local_steps) <= 0.0
+        ):
+            return []
+        det = self._staleness.setdefault(
+            rank, OuterStalenessDetector(self.config)
+        )
+        return self._keep(
+            det.observe(
+                float(local_steps) / float(max_local_steps),
+                rank=rank, step=step,
             )
         )
 

@@ -343,6 +343,38 @@ def ingest_record(
                 help="chips currently granted to the job",
                 job=str(rec.get("job_id", "?")),
             )
+    elif kind == "partition":
+        # the geo plane: cross-site partition lifecycle. partition_active
+        # flips 1 on "partitioned"/"local" and back to 0 on "rejoin";
+        # outer staleness (site-local steps accrued against the
+        # divergence budget) is the gauge HealthMonitor's staleness
+        # detector consumes.
+        phase = str(rec.get("phase", "?"))
+        registry.counter(
+            "live_partition_events_total",
+            help="typed cross-site partition events",
+            phase=phase,
+        )
+        registry.gauge(
+            "live_partition_active", 0.0 if phase == "rejoin" else 1.0,
+            help="1 while training is degraded to site-local steps",
+            rank=rlabel,
+        )
+        steps_local = rec.get("local_steps")
+        if isinstance(steps_local, (int, float)):
+            registry.gauge(
+                "live_outer_staleness_steps", float(steps_local),
+                help="site-local steps accrued since the last applied"
+                     " outer sync (the divergence budget's numerator)",
+                rank=rlabel,
+            )
+        budget = rec.get("max_local_steps")
+        if isinstance(budget, (int, float)):
+            registry.gauge(
+                "live_outer_staleness_budget_steps", float(budget),
+                help="site-local divergence budget (--max-local-steps)",
+                rank=rlabel,
+            )
     elif kind == "preempt":
         registry.counter(
             "live_fleet_preemptions_total",
@@ -658,6 +690,21 @@ class LiveAggregator:
             ):
                 fired += self.monitor.observe_hbm(
                     float(in_use), float(limit), rank=r, step=rec.get("step")
+                )
+        elif kind == "partition":
+            # the outer-staleness gauge feeds the budget-burn detector;
+            # a rejoin resets the stretch to zero observations naturally
+            # (local_steps drops back) — only live burn is observed here
+            steps_local = rec.get("local_steps")
+            budget = rec.get("max_local_steps")
+            if (
+                rec.get("phase") in ("partitioned", "local")
+                and isinstance(steps_local, (int, float))
+                and isinstance(budget, (int, float))
+            ):
+                fired += self.monitor.observe_outer_staleness(
+                    float(steps_local), float(budget),
+                    rank=r, step=rec.get("step"),
                 )
         return self._fire(fired)
 

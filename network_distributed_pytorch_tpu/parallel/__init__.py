@@ -21,7 +21,12 @@ from .comm import (  # noqa: F401
     ring_all_reduce_mean,
 )
 from .packing import TensorPacker  # noqa: F401
-from .hierarchical import HierarchicalReducer  # noqa: F401
+from .hierarchical import (  # noqa: F401
+    CompiledHierarchical,
+    HierarchicalReducer,
+    HierarchicalState,
+    make_hierarchical_train_fn,
+)
 from .localsgd import (  # noqa: F401
     CompiledDiLoCo,
     CompiledLocalSGD,

@@ -37,12 +37,42 @@ see DESIGN.md Round-6 for why both exist.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# Trace-time tag-prefix stack (hierarchical reduction levels): reducers
+# hardcode their payload tags ("grads", "powersgd.P", ...) because they are
+# topology-blind; the hierarchical reducer runs the SAME reducer code per
+# fabric level and needs the level visible in every fence-hook info dict
+# and ledger line. ``tag_scope("outer")`` prefixes every tag that
+# :func:`chunked_all_reduce_mean` burns into its callbacks while the scope
+# is active — at TRACE time, like the hook-presence gate, so the compiled
+# program carries "outer.powersgd.P" etc. and watchdogs/chaos injectors can
+# filter by level without the reducer knowing it was nested.
+_TAG_SCOPE: List[str] = []
+
+
+@contextlib.contextmanager
+def tag_scope(prefix: str):
+    """Prefix every collective tag traced inside the ``with`` body with
+    ``prefix + "."`` (nestable; prefixes compose outermost-first)."""
+    _TAG_SCOPE.append(str(prefix))
+    try:
+        yield
+    finally:
+        _TAG_SCOPE.pop()
+
+
+def scoped_tag(tag: str) -> str:
+    """``tag`` under the currently active :func:`tag_scope` prefixes."""
+    if not _TAG_SCOPE:
+        return tag
+    return ".".join(_TAG_SCOPE + [tag])
 
 # Host-side chunk fence hooks (degraded-fabric survival, DESIGN.md): a hook
 # is a plain Python callable invoked ON THE HOST at every chunk fence point
@@ -294,6 +324,7 @@ def chunked_all_reduce_mean(
     deadline watchdogs bite even at the un-chunked baseline rung.
     """
     assert strategy in ("interleave", "ring"), strategy
+    tag = scoped_tag(tag)
     reduce_one = ring_all_reduce_mean if strategy == "ring" else all_reduce_mean
     bounds = chunk_bounds(flat.size, n_chunks if n_chunks is not None else 1)
     hooked = fence_hooks_active()

@@ -43,6 +43,17 @@ Fault kinds and where they bite:
 ``comm_flap``          a transient throttle that clears by itself after
                        ``clears_after`` steps — the flaky-link case the
                        watchdog must survive WITHOUT a world restart
+``comm_partition``     the cross-site edge DIES: every collective launch on
+                       the target rank blocks for ``max_sleep_s`` (enough to
+                       trip the outer-deadline watchdog), and jax-free hosts
+                       see ``partitioned`` — the geo-resilient outer loop
+                       must degrade to site-local training, not crash.
+                       Clears after ``duration_steps`` if set, else only on
+                       an explicit ``comm_heal``
+``comm_heal``          the partitioned edge comes back: clears an active
+                       ``comm_partition`` (and any throttle) so the outer
+                       loop's EF-corrected catch-up reduction can rejoin the
+                       sites
 ``grad_spike``         the health sampler's grad-norm reading is multiplied
                        by ``factor`` (default 1000) — an optimizer blow-up
                        precursor the live plane's EWMA spike detector must
@@ -93,7 +104,16 @@ CORRELATED_FAULTS = ("zone_outage", "host_flap")
 # throttle (payload {"edge": [src, dst], "bytes_per_s": ...}) that only
 # the edge's SRC rank pays, so a per-edge blame pipeline (observe.critpath
 # / observe.fabric) can be verified end to end against a known-slow link.
-COMM_FAULTS = ("comm_throttle", "comm_stall", "comm_flap", "comm_slow_edge")
+# ``comm_partition`` / ``comm_heal`` are the geo-resilience pair: a
+# partition (payload {"edge": [src, dst], "max_sleep_s": ..., optional
+# "duration_steps": ...}) makes every collective launch on the target rank
+# block long enough to trip the outer-deadline watchdog AND flips the
+# host-visible ``partitioned`` flag jax-free workers poll; a heal clears it
+# (emitting ``comm_fault_cleared``) so the rejoin path can run.
+COMM_FAULTS = (
+    "comm_throttle", "comm_stall", "comm_flap", "comm_slow_edge",
+    "comm_partition", "comm_heal",
+)
 HEALTH_FAULTS = ("grad_spike",)
 # memory faults bite at the step boundary like STEP_FAULTS, but are their
 # own group so jax-free workers (the toy game-day worker) can pop them
@@ -129,6 +149,8 @@ INJECTION_SITES: Dict[str, str] = {
     "comm_stall": "comm-hook",          # CommFaultInjector fence hook
     "comm_flap": "comm-hook",           # CommFaultInjector fence hook
     "comm_slow_edge": "comm-hook",      # CommFaultInjector fence hook
+    "comm_partition": "comm-hook",      # CommFaultInjector fence hook
+    "comm_heal": "comm-hook",           # CommFaultInjector fence hook
     "grad_spike": "health-probe",       # health sampler (TrainHealthEvent)
     "oom": "step",                      # ChaosStep (allocator-death branch)
 }
@@ -503,6 +525,7 @@ class CommFaultInjector:
         self._step_index = -1
         self._throttle: Optional[Dict[str, Any]] = None
         self._stall: Optional[Dict[str, Any]] = None
+        self._partition: Optional[Dict[str, Any]] = None
 
     # -- host-side plan bookkeeping (training loop, once per step) ----------
     @property
@@ -512,6 +535,24 @@ class CommFaultInjector:
     @property
     def stall_pending(self) -> bool:
         return self._stall is not None
+
+    @property
+    def partitioned(self) -> bool:
+        """True while a ``comm_partition`` fault holds the edge down — the
+        host-side signal jax-free workers (and the jax path's outer-sync
+        driver) poll to decide site-local degradation without waiting for
+        a watchdog expiry."""
+        return self._partition is not None
+
+    @property
+    def partition_edge(self) -> Optional[Tuple[int, int]]:
+        """The (src, dst) rank pair of the active partition (None when no
+        partition is active or the spec carried no edge)."""
+        p = self._partition
+        if p is None or not p.get("edge"):
+            return None
+        src, dst = p["edge"][0], p["edge"][1]
+        return (int(src), int(dst))
 
     @property
     def throttle_edge(self) -> Optional[Tuple[int, int]]:
@@ -539,9 +580,24 @@ class CommFaultInjector:
             float(payload_bytes) / t["bytes_per_s"], t["max_sleep_s"]
         )
 
+    def _emit_cleared(self, kind: str, step_index: int) -> None:
+        if self._telemetry is None:
+            return
+        from ..observe import FailureEvent
+
+        self._telemetry.emit(
+            FailureEvent(
+                kind="comm_fault_cleared",
+                label=kind,
+                rank=self._rank,
+                step=step_index,
+                incarnation=self._incarnation,
+            )
+        )
+
     def advance(self, step_index: int) -> None:
         """Pop any comm fault scheduled for ``step_index`` and retire an
-        expiring flap/throttle. Call BEFORE running the step."""
+        expiring flap/throttle/partition. Call BEFORE running the step."""
         self._step_index = step_index
         t = self._throttle
         if (
@@ -550,18 +606,15 @@ class CommFaultInjector:
             and step_index >= t["until_step"]
         ):
             self._throttle = None
-            if self._telemetry is not None:
-                from ..observe import FailureEvent
-
-                self._telemetry.emit(
-                    FailureEvent(
-                        kind="comm_fault_cleared",
-                        label=t["kind"],
-                        rank=self._rank,
-                        step=step_index,
-                        incarnation=self._incarnation,
-                    )
-                )
+            self._emit_cleared(t["kind"], step_index)
+        part = self._partition
+        if (
+            part is not None
+            and part["until_step"] is not None
+            and step_index >= part["until_step"]
+        ):
+            self._partition = None
+            self._emit_cleared("comm_partition", step_index)
         spec = self._plan.pop(
             COMM_FAULTS, step_index, self._rank, self._incarnation
         )
@@ -571,7 +624,29 @@ class CommFaultInjector:
             self._telemetry, spec, step_index, self._rank, self._incarnation
         )
         p = spec.payload
-        if spec.kind in ("comm_throttle", "comm_flap", "comm_slow_edge"):
+        if spec.kind == "comm_partition":
+            duration = p.get("duration_steps")
+            self._partition = {
+                "edge": (
+                    [int(x) for x in p["edge"]] if p.get("edge") else None
+                ),
+                # the per-launch block: long enough to blow any sane outer
+                # deadline, short enough that a run without a watchdog (the
+                # CPU test mesh) still finishes
+                "max_sleep_s": float(p.get("max_sleep_s", 0.5)),
+                "until_step": (
+                    step_index + int(duration) if duration is not None else None
+                ),
+            }
+        elif spec.kind == "comm_heal":
+            if self._partition is not None:
+                self._partition = None
+                self._emit_cleared("comm_partition", step_index)
+            if self._throttle is not None:
+                t = self._throttle
+                self._throttle = None
+                self._emit_cleared(t["kind"], step_index)
+        elif spec.kind in ("comm_throttle", "comm_flap", "comm_slow_edge"):
             clears = p.get("clears_after", 3 if spec.kind == "comm_flap" else None)
             if clears is None:
                 clears = p.get("duration_steps")
@@ -603,6 +678,15 @@ class CommFaultInjector:
         if info.get("device_index") != self._rank:
             return
         if info.get("phase") != "launch":
+            return
+        part = self._partition
+        if part is not None:
+            # the edge is DOWN, not slow: block the launch for the clamp so
+            # a watchdog deadline (derived from the healthy fabric) expires
+            # deterministically, then let the collective through — on the
+            # single-controller CPU test mesh the peers are in-process, so
+            # "blocks forever" must be simulated, not enacted
+            time.sleep(part["max_sleep_s"])
             return
         st = self._stall
         if st is not None and info.get("chunk") == st["chunk"]:

@@ -74,6 +74,23 @@ DEFAULT_LADDER: List[Rung] = [
         "localsgd",
         {"reducer": "powersgd", "reducer_rank": 1, "sync_every": 8},
     ),
+    # two-level geo rungs (parallel.hierarchical): exact on the fast
+    # in-node axis every step, compressed outer reduction across the
+    # fabric matrix's slow edges every ``sync_every`` inner steps —
+    # synchronous first, then the async variant whose outer sync overlaps
+    # the next window (``outer_async``), the last refuge before a slow
+    # cross-site edge must gate step time at all
+    Rung(
+        "hierarchical",
+        {"reducer": "hierarchical", "reducer_rank": 4, "sync_every": 4},
+    ),
+    Rung(
+        "hierarchical-async",
+        {
+            "reducer": "hierarchical", "reducer_rank": 1, "sync_every": 8,
+            "outer_async": 1,
+        },
+    ),
 ]
 
 

@@ -46,6 +46,21 @@ And the degraded-fabric side (DESIGN.md):
   deliberately not a ``RuntimeError`` so the transient-retry machinery
   cannot swallow it) — a transient flap recovers with zero restarts.
 
+And the geo-resilient (hierarchical outer loop) side:
+
+- :func:`derive_outer_deadline` — the cross-site twin of
+  :func:`derive_collective_deadline`: a time budget for the OUTER
+  (slow-fabric) reduction of ``parallel.hierarchical``, modeled at the
+  cross-site fabric's line rate over the site count.
+- :class:`PartitionPolicy` — the host-side partition state machine: on an
+  outer-deadline expiry or an injected ``comm_partition``, training
+  degrades to site-local rounds (typed ``observe.PartitionEvent``), the
+  site-local step count is charged against a ``max_local_steps``
+  divergence budget, and when the edge heals the next completed sync is
+  recorded as the rejoin. Budget exhaustion raises
+  :class:`CommEscalationError` — the supervisor takes over only when the
+  merge-tolerance story has genuinely run out.
+
 Every recovery action is a ``FailureEvent`` through telemetry, so the run
 log shows fault → detection → recovery with timestamps.
 """
@@ -140,6 +155,206 @@ def derive_collective_deadline(
     modeled = bw.allreduce_time_s(int(payload_bytes), int(n_workers), fabric)
     budget = max(modeled, measured_p50_s or 0.0) * slack
     return max(budget, floor_s)
+
+
+def derive_outer_deadline(
+    outer_payload_bytes: int,
+    n_sites: int,
+    fabric: str = "1GbE",
+    measured_p50_s: Optional[float] = None,
+    slack: float = 6.0,
+    floor_s: float = 0.25,
+) -> float:
+    """Deadline for ONE cross-site outer reduction of the hierarchical
+    loop: :func:`derive_collective_deadline` re-parameterized for the slow
+    fabric.
+
+    ``outer_payload_bytes`` is the COMPRESSED outer payload (the
+    hierarchical reducer's ``bits_by_fabric()['outer'] // 8``), ``n_sites``
+    the outer-axis world, ``fabric`` the cross-site link class from the
+    fabric matrix's bottleneck edge. The defaults are deliberately looser
+    than the inner deadline's: a WAN edge has orders-of-magnitude more
+    natural jitter than ICI, and the async overlap means a late outer sync
+    costs nothing until the NEXT round needs its result — the deadline
+    exists to declare the edge dead, not merely slow."""
+    return derive_collective_deadline(
+        outer_payload_bytes, n_sites, fabric,
+        measured_p50_s=measured_p50_s, slack=slack, floor_s=floor_s,
+    )
+
+
+class PartitionPolicy:
+    """The host-side partition state machine of the geo-resilient outer
+    loop (``parallel.hierarchical`` / the toy game-day worker).
+
+    Transitions, each a typed ``observe.PartitionEvent``:
+
+    - :meth:`note_partition` — the cross-site edge was declared dead (an
+      outer watchdog expiry, or ``CommFaultInjector.partitioned``):
+      ``phase="partitioned"``. Idempotent while already partitioned.
+    - :meth:`note_local_round` — one outer round ran site-local (inner
+      steps only, no cross-site collective): ``phase="local"``, the
+      round's inner steps charged against the ``max_local_steps``
+      divergence budget and ``outer_staleness`` incremented. Raises
+      :class:`CommEscalationError` when the budget is exhausted — the
+      point where site-local drift exceeds what the EF-corrected catch-up
+      reduction is documented to absorb, so the supervisor must decide.
+    - :meth:`note_sync` — a cross-site sync COMPLETED: staleness resets;
+      if it ends a partition it is the rejoin (``phase="rejoin"``, the
+      catch-up reduction having folded the accumulated site-local deltas
+      through error feedback).
+
+    jax-free and clock-free: the policy counts steps and rounds, never
+    reads a clock, so tests replay it exactly."""
+
+    def __init__(
+        self,
+        max_local_steps: int,
+        telemetry: Any = None,
+        rank: int = 0,
+        incarnation: int = 0,
+    ):
+        self.max_local_steps = int(max_local_steps)
+        self._telemetry = telemetry
+        self._rank = rank
+        self._incarnation = incarnation
+        self.partitioned = False
+        self.edge: Optional[tuple] = None
+        self.local_steps = 0
+        self.outer_staleness = 0
+        self.events: list = []  # every PartitionEvent, in order (tests/report)
+
+    def _emit(self, phase: str, step: Optional[int], reason: str = ""):
+        from ..observe import PartitionEvent
+
+        ev = PartitionEvent(
+            phase=phase,
+            edge=list(self.edge) if self.edge is not None else None,
+            local_steps=self.local_steps,
+            max_local_steps=self.max_local_steps,
+            outer_staleness=self.outer_staleness,
+            reason=reason,
+            rank=self._rank,
+            step=step,
+            incarnation=self._incarnation,
+        )
+        self.events.append(ev)
+        if self._telemetry is not None:
+            self._telemetry.emit(ev)
+        return ev
+
+    @property
+    def remaining_budget(self) -> int:
+        return max(0, self.max_local_steps - self.local_steps)
+
+    def note_partition(
+        self,
+        edge: Optional[tuple] = None,
+        step: Optional[int] = None,
+        reason: str = "",
+    ) -> None:
+        """The cross-site edge is down. Safe to call every step while the
+        fault holds — only the first call per partition emits."""
+        if self.partitioned:
+            return
+        self.partitioned = True
+        self.edge = tuple(edge) if edge is not None else None
+        self.local_steps = 0
+        self._emit("partitioned", step, reason or "cross-site edge declared dead")
+
+    def note_local_round(
+        self, inner_steps: int, step: Optional[int] = None
+    ) -> None:
+        """One outer round completed WITHOUT its cross-site sync. Charges
+        the divergence budget; raises when it is exhausted."""
+        self.local_steps += int(inner_steps)
+        self.outer_staleness += 1
+        self._emit("local", step)
+        if self.local_steps > self.max_local_steps:
+            raise CommEscalationError(
+                f"partition divergence budget exhausted: {self.local_steps} "
+                f"site-local steps > max_local_steps={self.max_local_steps}; "
+                f"escalating to supervisor"
+            )
+
+    def note_sync(self, step: Optional[int] = None) -> None:
+        """A cross-site outer sync completed. Ends an active partition
+        (the rejoin) and resets the staleness counter either way."""
+        if self.partitioned:
+            self._emit(
+                "rejoin", step,
+                f"edge healed after {self.local_steps} site-local steps; "
+                f"EF catch-up reduction merged",
+            )
+            self.partitioned = False
+            self.edge = None
+            self.local_steps = 0
+        self.outer_staleness = 0
+
+
+class OuterSyncDriver:
+    """Per-round routing glue for the geo-resilient loop: decides, BEFORE
+    each round is dispatched, whether the cross-site outer sync may run —
+    composing the two partition signals (the chaos injector's
+    ``partitioned`` flag, i.e. the fault is declared; and an outer
+    :class:`CollectiveWatchdog` whose expiry on an ``outer.*`` tag declares
+    the edge dead empirically) over a :class:`PartitionPolicy` that owns
+    the state machine, the typed events, and the divergence budget.
+
+    Usage, in a round loop::
+
+        driver = OuterSyncDriver(policy, probes=[lambda: injector.partitioned],
+                                 watchdog=outer_watchdog)
+        if driver.should_sync(step=i):
+            state, losses = compiled(state, batches)       # sync round
+            driver.note_sync(step=i)
+        else:
+            state, losses = compiled.local_round(state, batches)
+            driver.note_local(compiled.sync_every, step=i)  # may escalate
+
+    jax-free; probes are zero-arg callables so the driver never imports
+    the injector's module."""
+
+    def __init__(
+        self,
+        policy: PartitionPolicy,
+        probes: Any = (),
+        watchdog: Any = None,
+        edge_probe: Any = None,
+    ):
+        self.policy = policy
+        self._probes = list(probes)
+        self._watchdog = watchdog
+        self._edge_probe = edge_probe
+
+    def _partition_reason(self) -> Optional[str]:
+        for probe in self._probes:
+            if probe():
+                return "partition fault active"
+        wd = self._watchdog
+        if wd is not None and wd.expired_this_attempt():
+            return "outer sync deadline expired"
+        return None
+
+    def should_sync(self, step: Optional[int] = None) -> bool:
+        """True → run the sync round; False → the edge is (still) down,
+        run the collective-free local round."""
+        reason = self._partition_reason()
+        if reason is not None:
+            edge = self._edge_probe() if self._edge_probe is not None else None
+            self.policy.note_partition(edge=edge, step=step, reason=reason)
+            return False
+        return True
+
+    def note_sync(self, step: Optional[int] = None) -> None:
+        if self._watchdog is not None:
+            self._watchdog.begin_attempt()
+        self.policy.note_sync(step=step)
+
+    def note_local(self, inner_steps: int, step: Optional[int] = None) -> None:
+        """Charge one site-local round; raises ``CommEscalationError`` via
+        the policy when the divergence budget is exhausted."""
+        self.policy.note_local_round(inner_steps, step=step)
 
 
 class CollectiveWatchdog:

@@ -300,14 +300,29 @@ class FileSpool:
         (peers only take provably-dead claims via
         :meth:`requeue_orphans`). The fleet scheduler parks a preempted
         job's manifest through this so the job re-enters queue order with
-        its resume state attached."""
+        its resume state attached.
+
+        Ownership is proven BEFORE parking: the claim file is atomically
+        renamed to a private ``.releasing`` name (invisible to every
+        ``*.json`` scan), and only a successful rename parks the doc. A
+        worker that was stalled (SIGSTOP, GC pause, NFS hiccup) long
+        enough for the world to shrink past it loses its claim to a
+        peer's :meth:`requeue_orphans`; when it resumes, the rename fails
+        and the release no-ops — re-parking a stolen claim would put a
+        second live copy of the entry in circulation."""
         if self.claim_dir is None:
             raise ValueError("release_doc() needs a worker FileSpool")
+        claim = os.path.join(self.claim_dir, f"{entry_id}.json")
+        proof = f"{claim}.releasing"
+        try:
+            os.rename(claim, proof)
+        except OSError:
+            return  # claim already stolen (or completed) — nothing to park
         _atomic_write(
             os.path.join(self.queue_dir, f"{entry_id}.json"), doc
         )
         try:
-            os.unlink(os.path.join(self.claim_dir, f"{entry_id}.json"))
+            os.unlink(proof)
         except OSError:
             pass
 

@@ -104,6 +104,16 @@ def main(argv=None) -> int:
              " fabric's scalar",
     )
     parser.add_argument(
+        "--hierarchical", action="store_true",
+        help="extend the search with the two-level hierarchical grid"
+             " (sync period H x outer rank x sync/async) — the geo"
+             " placement question priced against the matrix's slow edge",
+    )
+    parser.add_argument(
+        "--sites", type=int, default=0,
+        help="site count for the hierarchical grid (0 = model default)",
+    )
+    parser.add_argument(
         "--top", type=int, default=3,
         help="per-fabric predictions to summarize on stderr (default 3)",
     )
@@ -139,7 +149,16 @@ def main(argv=None) -> int:
                 f"per-edge matrix: {len(matrix.get('edges', []))} edge(s),"
                 f" bottleneck {bn.get('src')}->{bn.get('dst')}"
             )
-    plan = costmodel.build_plan(calib, fabrics=fabrics, matrix=matrix)
+    configs = None
+    if args.hierarchical:
+        configs = costmodel.default_configs(calib) + costmodel.hierarchical_configs(
+            calib, sites=args.sites
+        )
+        _say(f"hierarchical grid: +{len(configs) - len(costmodel.default_configs(calib))}"
+             " two-level config(s)")
+    plan = costmodel.build_plan(
+        calib, fabrics=fabrics, configs=configs, matrix=matrix
+    )
 
     for path in (args.out, args.events_out):
         parent = os.path.dirname(path)

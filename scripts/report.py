@@ -943,6 +943,132 @@ def render_run_sections(
     return lines
 
 
+def hierarchy_summary(bandwidth: Optional[Dict]) -> Optional[Dict]:
+    """Per-level wire traffic for a two-level hierarchical run: the
+    bandwidth rows whose ledger tags carry the reducer's ``outer.`` /
+    ``inner.`` level prefixes, aggregated per level. None when the run
+    was flat (no level-tagged collectives) — the section simply doesn't
+    apply. ``outer_bytes_per_step`` is the geo claim's falsifiable
+    number: the cross-site traffic the compressed outer reduction
+    actually moved, joinable against the cost model's
+    ``predicted_outer_bytes_per_step``."""
+    if not isinstance(bandwidth, dict):
+        return None
+    levels: Dict[str, Dict] = {}
+    for row in bandwidth.get("by_tag") or []:
+        tag = str(row.get("tag") or "")
+        level = tag.split(".", 1)[0]
+        if level not in ("outer", "inner") or "." not in tag:
+            continue
+        slot = levels.setdefault(
+            level, {"payload_bytes": 0.0, "count": 0, "tags": []}
+        )
+        slot["payload_bytes"] += float(row.get("payload_bytes") or 0.0)
+        slot["count"] += int(row.get("count") or 0)
+        slot["tags"].append(tag)
+    if not levels:
+        return None
+    outer = levels.get("outer", {}).get("payload_bytes", 0.0)
+    inner = levels.get("inner", {}).get("payload_bytes", 0.0)
+    total = outer + inner
+    return {
+        "levels": levels,
+        "outer_bytes_per_step": outer,
+        "inner_bytes_per_step": inner,
+        # the shrinkage the two-level design buys: fraction of the wire
+        # traffic that actually crossed the slow edge
+        "cross_site_fraction": (outer / total) if total > 0 else None,
+    }
+
+
+def render_hierarchy_section(hierarchy: Optional[Dict]) -> List[str]:
+    if not hierarchy:
+        return []
+    lines = ["", "hierarchical reduction — bytes per level", "-" * 41]
+    for level in ("inner", "outer"):
+        slot = hierarchy["levels"].get(level)
+        if not slot:
+            continue
+        lines.append(
+            f"  {level:<6} {_fmt_bytes(slot['payload_bytes']):>12}/step "
+            f"x{slot['count']:<4} ({', '.join(sorted(slot['tags']))})"
+        )
+    frac = hierarchy.get("cross_site_fraction")
+    if frac is not None:
+        lines.append(
+            f"  cross-site share of wire traffic: {100 * frac:.2f}%"
+        )
+    return lines
+
+
+def partition_summary(events: List[Dict]) -> Optional[Dict]:
+    """The cross-site partition timeline: every typed ``partition`` event
+    (``observe.events.PartitionEvent`` — the guarded outer sync degrading
+    to site-local training, charging its divergence budget, rejoining).
+    None when the run never partitioned."""
+    parts = [e for e in events if e.get("event") == "partition"]
+    if not parts:
+        return None
+    phases: Dict[str, int] = {}
+    for e in parts:
+        k = str(e.get("phase", "?"))
+        phases[k] = phases.get(k, 0) + 1
+    local_steps = [
+        int(e["local_steps"]) for e in parts
+        if isinstance(e.get("local_steps"), (int, float))
+    ]
+    budgets = [
+        int(e["max_local_steps"]) for e in parts
+        if isinstance(e.get("max_local_steps"), (int, float))
+    ]
+    return {
+        "events": parts,
+        "by_phase": phases,
+        "n_partitions": phases.get("partitioned", 0),
+        "n_rejoins": phases.get("rejoin", 0),
+        "max_local_steps": max(local_steps) if local_steps else 0,
+        "budget": max(budgets) if budgets else None,
+        "healed": phases.get("rejoin", 0) >= phases.get("partitioned", 0)
+        and phases.get("partitioned", 0) > 0,
+    }
+
+
+def render_partition_section(partitions: Optional[Dict]) -> List[str]:
+    if not partitions:
+        return []
+    lines = ["", "cross-site partitions — timeline", "-" * 32]
+    timed = sorted(
+        partitions["events"],
+        key=lambda e: (_event_time(e) is None, _event_time(e) or 0.0),
+    )
+    for e in timed:
+        t = _event_time(e)
+        stamp = f"t+{t:8.3f}s" if t is not None else " " * 10
+        detail = []
+        if e.get("edge"):
+            detail.append(f"edge {e['edge']}")
+        if isinstance(e.get("local_steps"), (int, float)):
+            detail.append(
+                f"local {int(e['local_steps'])}/{e.get('max_local_steps', '?')}"
+            )
+        if e.get("reason"):
+            detail.append(str(e["reason"]))
+        lines.append(
+            f"  {stamp}  {str(e.get('phase', '?')):<12} "
+            f"step {e.get('step', '?')}  {'; '.join(detail)}"
+        )
+    lines.append(
+        f"  {partitions['n_partitions']} partition(s), "
+        f"{partitions['n_rejoins']} rejoin(s), worst site-local stretch "
+        f"{partitions['max_local_steps']} step(s)"
+        + (
+            f" of {partitions['budget']} budget"
+            if partitions.get("budget") is not None else ""
+        )
+    )
+    return lines
+
+
 def _union_len(intervals: List[Tuple[float, float]]) -> float:
     """Total length covered by a set of (start, end) intervals."""
     covered = 0.0
@@ -1642,6 +1768,10 @@ def run_report(
     sections.extend(render_memory_section(memory))
     comm_buckets = bucket_attribution(bandwidth, overlap)
     sections.extend(render_bucket_section(comm_buckets))
+    hierarchy = hierarchy_summary(bandwidth)
+    sections.extend(render_hierarchy_section(hierarchy))
+    partitions = partition_summary(merged.events)
+    sections.extend(render_partition_section(partitions))
     sections.extend(
         render_alert_section(
             [e for e in merged.events if e.get("event") == "alert"]
@@ -1725,6 +1855,15 @@ def run_report(
         # per-bucket exposed-comm attribution (DDP backward-order buckets;
         # empty when the run used a monolithic packed collective)
         "comm_buckets": comm_buckets,
+        # two-level reduction: wire bytes per level from the outer.* /
+        # inner.* ledger tags (None for flat runs) — the cross-site
+        # shrinkage claim joins hierarchy.outer_bytes_per_step against
+        # the plan's predicted_outer_bytes_per_step
+        "hierarchy": hierarchy,
+        # typed cross-site partition timeline (None when never
+        # partitioned): degradation to site-local training, divergence
+        # budget charged, rejoin
+        "partitions": partitions,
         "mfu": mfu_records,
         # the gate's scalar: the best steady-state MFU across phases
         # (higher = better; a regression means the run got less efficient)

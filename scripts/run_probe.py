@@ -839,6 +839,10 @@ def main(argv=None) -> int:
     plan_script = _load_script("plan")
     plan_fabrics = ("1GbE", "10GbE")
     plan_steps = 12
+    # keep per-step host overhead (checkpoint + telemetry writes, sleep
+    # granularity) small relative to the modeled step, or the 25% error
+    # ceiling measures scheduler jitter instead of the cost model
+    plan_step_s = max(args.step_seconds, 0.03)
     art_dir = os.path.dirname(args.json_out) or "."
 
     def _planner_toy_run(tag, extra_argv):
@@ -856,7 +860,7 @@ def main(argv=None) -> int:
                 "--steps", str(plan_steps),
                 "--state-dir", os.path.join(d, "state"),
                 "--result-dir", os.path.join(d, "results"),
-                "--step-seconds", str(args.step_seconds),
+                "--step-seconds", str(plan_step_s),
                 "--payload-mult", "8",
                 *extra_argv,
             ]
@@ -938,7 +942,8 @@ def main(argv=None) -> int:
     # toy knows how to run (TOY_RUNG_SPECS: the compress rung carries the
     # ladder's compress-low-rank knobs).
     toy_rungs = {"baseline": "baseline", "compress-low-rank": "compress",
-                 "localsgd": "localsgd"}
+                 "localsgd": "localsgd", "hierarchical": "hierarchical",
+                 "hierarchical-async": "hierarchical"}
     costmodel_error = None
     realized_best = {}
     for fabric in plan_fabrics:
@@ -1568,7 +1573,8 @@ def main(argv=None) -> int:
     os.makedirs(artifacts, exist_ok=True)
     with open(os.path.join(artifacts, "fleet_report.json"), "w") as f:
         json.dump(
-            {"fleet_goodput": float(goodput), **fleet_summary}, f, indent=1
+            {"fleet_goodput": float(goodput), **fleet_summary}, f,
+            indent=1, sort_keys=True,
         )
 
     # gate directionality: today's goodput holds against a worse baseline
@@ -1599,6 +1605,252 @@ def main(argv=None) -> int:
         f" quarantined after {fleet_summary['jobs']['looper']['strikes']}"
         f" strikes without blocking; goodput {goodput:.3f}/chip-s)"
         f" report -> {fleet_json}\n"
+    )
+
+    # --- phase 11: the geo partition game day ----------------------------
+    # The two-level hierarchical rung on a simulated two-site topology.
+    # Three runs of the same job: a fast-fabric-only baseline (the outer
+    # edge is also ICI), a slow-edge run whose async outer sync must hide
+    # the 1GbE cross-site cost (step p50 within 10% of the baseline) while
+    # the per-level wire ledger proves the cross-site bytes shrank by the
+    # cost model's predicted ratio, and a partition run — the cross-site
+    # edge throttled at step 2 and cut outright at step 10 — that must
+    # keep stepping at fast-fabric speed through a full site-local round
+    # (typed partition events charging the divergence budget), rejoin on
+    # the step-20 heal, and land the exact state of the never-partitioned
+    # baseline.
+    geo_steps = 32
+    geo_sync = 8  # the toy hierarchical rung's outer period (sync_every)
+    geo_budget = 12  # --max-local-steps: one local round fits, two do not
+    geo_step_s = max(args.step_seconds, 0.03)  # sleep jitter << 10% bound
+
+    def _geo_toy_run(tag, fabric, faults=None):
+        d = run_dir + "_" + tag
+        shutil.rmtree(d, ignore_errors=True)
+        os.makedirs(d, exist_ok=True)
+        chaos_argv = []
+        if faults:
+            chaos_path = os.path.join(d, "chaos_plan.json")
+            ChaosPlan(faults).save(chaos_path)
+            chaos_argv = ["--chaos-plan", chaos_path]
+
+        def argv_fn(rank, world_size, incarnation):
+            return [
+                sys.executable, worker,
+                "--rank", str(rank),
+                "--world", str(world_size),
+                "--steps", str(geo_steps),
+                "--state-dir", os.path.join(d, "state"),
+                "--result-dir", os.path.join(d, "results"),
+                "--step-seconds", str(geo_step_s),
+                "--payload-mult", "8",
+                "--rung", "hierarchical",
+                "--max-local-steps", str(geo_budget),
+                "--sim-fabric", fabric,
+                *chaos_argv,
+            ]
+
+        tele = telemetry_for_run(
+            event_log=os.path.join(d, SUPERVISOR_LOG), stdout=False
+        )
+        res = Supervisor(
+            argv_for_rank=argv_fn,
+            world_size=args.world,
+            config=SupervisorConfig(
+                max_restarts=1, backoff_base_s=0.05, poll_interval_s=0.05
+            ),
+            telemetry=tele,
+            run_dir=d,
+        ).run()
+        tele.close()
+        if not res.success:
+            sys.stderr.write(f"# run_probe: FAIL: {tag} run failed: {res}\n")
+            return d, None, None
+        out_json = os.path.join(art_dir, f"{tag}_report.json")
+        if report.main(["--run-dir", d, "--json-out", out_json]) != 0:
+            return d, None, None
+        with open(out_json) as f:
+            return d, out_json, json.load(f)
+
+    problems = []
+    _, geo_base_json, geo_base_doc = _geo_toy_run("geo_base", "ICI(v5e)")
+    if geo_base_doc is None:
+        return 1
+    base_p50 = geo_base_doc.get("step_p50_s")
+    if not (isinstance(base_p50, (int, float)) and base_p50 > 0):
+        sys.stderr.write(
+            f"# run_probe: FAIL: geo baseline has no step_p50_s\n"
+        )
+        return 1
+
+    # price the two-level grid off the fast-fabric run, then execute the
+    # slow-edge run and join predicted against realized through report.py
+    geo_plan_path = os.path.join(art_dir, "geo_plan.json")
+    if plan_script.main([
+        "--report", geo_base_json, "--out", geo_plan_path,
+        "--events-out", os.path.join(art_dir, "geo_predictions.jsonl"),
+        "--fabrics", "1GbE", "--hierarchical",
+    ]) != 0:
+        sys.stderr.write("# run_probe: FAIL: geo plan.py returned nonzero\n")
+        return 1
+
+    geo_slow_dir, geo_slow_json, geo_slow_doc = _geo_toy_run(
+        "geo_slow", "1GbE"
+    )
+    if geo_slow_doc is None:
+        return 1
+    if report.main([
+        "--run-dir", geo_slow_dir, "--json-out", geo_slow_json,
+        "--plan", geo_plan_path, "--plan-fabric", "1GbE",
+    ]) != 0:
+        return 1
+    with open(geo_slow_json) as f:
+        geo_slow_doc = json.load(f)
+
+    slow_p50 = geo_slow_doc.get("step_p50_s")
+    if not (isinstance(slow_p50, (int, float)) and slow_p50 > 0):
+        problems.append("geo slow-edge run has no step_p50_s")
+    elif slow_p50 > 1.10 * base_p50:
+        problems.append(
+            "async outer sync did not hide the 1GbE cross-site edge:"
+            f" p50 {slow_p50 * 1e3:.1f} ms vs fast-fabric-only"
+            f" {base_p50 * 1e3:.1f} ms (> 10% over)"
+        )
+
+    # the per-level wire ledger: outer.* rows are the only cross-site
+    # bytes, and they shrank to the compressed residual the plan priced
+    hier_sec = geo_slow_doc.get("hierarchy") or {}
+    outer_b = (hier_sec or {}).get("outer_bytes_per_step")
+    inner_b = (hier_sec or {}).get("inner_bytes_per_step")
+    if not (isinstance(outer_b, (int, float)) and outer_b > 0
+            and isinstance(inner_b, (int, float)) and inner_b > 0):
+        problems.append(
+            f"no per-level hierarchy ledger in {geo_slow_json}:"
+            f" {hier_sec!r}"
+        )
+    else:
+        if not outer_b < 0.05 * inner_b:
+            problems.append(
+                "cross-site bytes did not shrink: outer"
+                f" {outer_b:.0f} vs inner {inner_b:.0f} B/step"
+            )
+        cm = geo_slow_doc.get("costmodel") or {}
+        with open(geo_plan_path) as f:
+            geo_plan_doc = json.load(f)
+        ranked = (
+            geo_plan_doc.get("fabrics", {}).get("1GbE") or {}
+        ).get("ranked") or []
+        pred = next(
+            (p for p in ranked
+             if p.get("config_key") == cm.get("config_key")), None
+        )
+        pred_outer = (pred or {}).get("predicted_outer_bytes_per_step")
+        if not (isinstance(pred_outer, (int, float)) and pred_outer > 0):
+            problems.append(
+                "plan carries no predicted_outer_bytes_per_step for the"
+                f" executed config {cm.get('config_key')!r}"
+            )
+        elif abs(pred_outer - outer_b) / outer_b > 0.25:
+            problems.append(
+                "predicted cross-site bytes off by > 25%:"
+                f" {pred_outer:.0f} predicted vs {outer_b:.0f} realized"
+            )
+    cm = geo_slow_doc.get("costmodel") or {}
+    err = cm.get("error")
+    if not cm.get("matched"):
+        problems.append(
+            f"geo slow-edge run matched no plan prediction: {cm}"
+        )
+    elif not isinstance(err, (int, float)) or err > 0.25:
+        problems.append(
+            f"geo costmodel_error outside the 25% bound: {err!r}"
+        )
+
+    # the partition leg: throttle the cross-site edge, then cut it for a
+    # full outer round; the heal at step 20 lets round 3 rejoin
+    geo_faults = [
+        FaultSpec(
+            kind="comm_slow_edge", step=2, rank=0,
+            payload={
+                "edge": [0, 1], "bytes_per_s": 0.125e9,
+                "duration_steps": geo_steps, "max_sleep_s": 0.25,
+            },
+        ),
+    ]
+    for r in range(args.world):
+        geo_faults.append(FaultSpec(
+            kind="comm_partition", step=10, rank=r,
+            payload={"edge": [0, 1]},
+        ))
+        geo_faults.append(FaultSpec(kind="comm_heal", step=20, rank=r))
+    geo_part_dir, geo_part_json, geo_part_doc = _geo_toy_run(
+        "geo_partition", "1GbE", faults=geo_faults
+    )
+    if geo_part_doc is None:
+        return 1
+    part_p50 = geo_part_doc.get("step_p50_s")
+    if not (isinstance(part_p50, (int, float)) and part_p50 > 0):
+        problems.append("geo partition run has no step_p50_s")
+    elif part_p50 > 1.10 * base_p50:
+        problems.append(
+            "partitioned run stopped stepping at fast-fabric speed:"
+            f" p50 {part_p50 * 1e3:.1f} ms vs {base_p50 * 1e3:.1f} ms"
+        )
+    parts = geo_part_doc.get("partitions") or {}
+    if not parts:
+        problems.append(f"no partition timeline in {geo_part_json}")
+    else:
+        if (parts.get("n_partitions") or 0) < 1:
+            problems.append(f"no typed partition event: {parts}")
+        if (parts.get("max_local_steps") or 0) < geo_sync:
+            problems.append(
+                "partition did not accrue a full site-local round:"
+                f" {parts.get('max_local_steps')!r} < {geo_sync}"
+            )
+        if (parts.get("n_rejoins") or 0) < 1 or not parts.get("healed"):
+            problems.append(f"partition never rejoined: {parts}")
+        if parts.get("budget") != geo_budget:
+            problems.append(
+                f"divergence budget not surfaced: {parts.get('budget')!r}"
+                f" != {geo_budget}"
+            )
+
+    # completion oracle: the partitioned run must land the exact state of
+    # the never-partitioned baseline (the toy's state plane is
+    # partition-oblivious by construction; a mismatch means the rejoin
+    # path dropped or replayed steps)
+    for r in range(args.world):
+        try:
+            with open(os.path.join(
+                run_dir + "_geo_base", "state", f"rank{r}.json"
+            )) as f:
+                want = json.load(f)
+            with open(os.path.join(
+                geo_part_dir, "state", f"rank{r}.json"
+            )) as f:
+                got = json.load(f)
+        except (OSError, ValueError) as exc:
+            problems.append(f"geo oracle compare unreadable: {exc}")
+            continue
+        if got != want:
+            problems.append(
+                f"rank {r} partitioned-run state diverged from the"
+                f" baseline oracle: {got} != {want}"
+            )
+
+    if problems:
+        for prob in problems:
+            sys.stderr.write(f"# run_probe: FAIL: {prob}\n")
+        return 1
+    sys.stderr.write(
+        "# run_probe: geo partition game day ok (two-site hierarchical:"
+        f" fast-fabric p50 {base_p50 * 1e3:.1f} ms, 1GbE async-outer"
+        f" {slow_p50 * 1e3:.1f} ms, partitioned {part_p50 * 1e3:.1f} ms;"
+        f" cross-site {outer_b:.0f} B/step vs inner {inner_b:.0f};"
+        f" costmodel_error {err:.1%}; {parts.get('n_partitions')}"
+        f" partition(s), {parts.get('max_local_steps')} site-local steps,"
+        f" {parts.get('n_rejoins')} rejoin(s), state matches the oracle)"
+        f" report -> {geo_part_json}\n"
     )
     return 0
 

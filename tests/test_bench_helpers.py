@@ -807,3 +807,52 @@ def test_child_phases_real_jax_smoke(tmp_path):
     arm = events["fp32arm"]["data"]
     assert arm["preset"] == d["preset"] == "small"
     assert arm["fp32_scanned_imgs_per_sec"] > 0
+
+
+def test_run_perf_gate_strictness_follows_platform(monkeypatch, tmp_path):
+    """The round-end perf gate: skipped without a report/baseline pair,
+    chip-strict on TPU (--strict-device), advisory on CPU, and a nonzero
+    gate exit rides the status without failing the bench."""
+    bench = _load_bench(monkeypatch)
+    monkeypatch.setattr(bench, "HERE", str(tmp_path))
+
+    out, status = {"platform": "tpu"}, {}
+    bench._run_perf_gate(out, status)
+    assert status["gate"].startswith("skipped")
+    assert "gate_strict_device" not in out
+
+    art = tmp_path / "artifacts"
+    art.mkdir()
+    (art / "run_report.json").write_text("{}")
+    (art / "GATE_BASELINE.json").write_text("{}")
+
+    calls = []
+
+    def _fake_run(argv, timeout):
+        calls.append(list(argv))
+
+        class _R:
+            returncode = 0
+
+        return _R()
+
+    monkeypatch.setattr(bench.subprocess, "run", _fake_run)
+    bench._run_perf_gate(out, status)
+    assert status["gate"] == "ok" and out["gate_strict_device"] is True
+    assert "--strict-device" in calls[-1] and "--advisory" not in calls[-1]
+
+    out_cpu, status_cpu = {"platform": "cpu"}, {}
+    bench._run_perf_gate(out_cpu, status_cpu)
+    assert "--advisory" in calls[-1]
+    assert out_cpu["gate_strict_device"] is False
+
+    def _regressed(argv, timeout):
+        class _R:
+            returncode = 3
+
+        return _R()
+
+    monkeypatch.setattr(bench.subprocess, "run", _regressed)
+    status_bad = {}
+    bench._run_perf_gate({"platform": "tpu"}, status_bad)
+    assert status_bad["gate"] == "regressed (exit 3)"

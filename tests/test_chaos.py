@@ -631,7 +631,8 @@ def _info(phase="launch", chunk=0, n_chunks=1, payload=4096, device=0,
 
 def test_comm_fault_registry_bijection():
     assert set(COMM_FAULTS) == {
-        "comm_throttle", "comm_stall", "comm_flap", "comm_slow_edge"
+        "comm_throttle", "comm_stall", "comm_flap", "comm_slow_edge",
+        "comm_partition", "comm_heal",
     }
     for kind in COMM_FAULTS:
         assert kind in FAULT_KINDS

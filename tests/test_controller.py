@@ -36,19 +36,27 @@ def test_default_ladder_documented_order():
     names = [r.name for r in DEFAULT_LADDER]
     assert names == [
         "baseline", "chunked", "ring", "compress", "compress-low-rank",
-        "localsgd",
+        "localsgd", "hierarchical", "hierarchical-async",
     ]
     # baseline overrides nothing; each compression rung names the reducer;
-    # only the last rung widens the sync period
+    # the localsgd rung widens the sync period; the bottom two rungs go
+    # two-level (and finally async) — the geo-resilient end of the ladder
     assert DEFAULT_LADDER[0].overrides == {}
     assert DEFAULT_LADDER[2].overrides["comm_strategy"] == "ring"
-    for rung in DEFAULT_LADDER[3:]:
+    for rung in DEFAULT_LADDER[3:6]:
         assert rung.overrides["reducer"] == "powersgd"
     assert DEFAULT_LADDER[4].overrides["reducer_rank"] < (
         DEFAULT_LADDER[3].overrides["reducer_rank"]
     )
     assert "sync_every" not in DEFAULT_LADDER[4].overrides
     assert DEFAULT_LADDER[5].overrides["sync_every"] > 1
+    for rung in DEFAULT_LADDER[6:]:
+        assert rung.overrides["reducer"] == "hierarchical"
+    assert DEFAULT_LADDER[7].overrides.get("outer_async")
+    assert (
+        DEFAULT_LADDER[7].overrides["sync_every"]
+        > DEFAULT_LADDER[6].overrides["sync_every"]
+    )
 
 
 def test_ladder_validation():
@@ -75,7 +83,7 @@ def test_descends_in_order_and_stops_at_bottom():
     assert seen == [
         (a.name, b.name) for a, b in zip(DEFAULT_LADDER, DEFAULT_LADDER[1:])
     ]
-    assert c.rung.name == "localsgd"
+    assert c.rung.name == "hierarchical-async"
 
 
 def test_descend_requires_consecutive_degraded_epochs():

@@ -1,0 +1,275 @@
+"""Host-side geo-resilience plane: the partition policy / outer-sync driver
+state machine, the chaos partition faults, the cost model's two-level
+pricing, the report's hierarchy/partition sections, and the staleness
+detector. Everything here is jax-free by construction — the control plane
+must keep deciding while a worker's jax runtime is hung."""
+
+import importlib.util
+import os
+
+import pytest
+
+from network_distributed_pytorch_tpu.observe import costmodel
+from network_distributed_pytorch_tpu.observe.health import (
+    DetectorConfig,
+    HealthMonitor,
+)
+from network_distributed_pytorch_tpu.resilience.chaos import (
+    ChaosPlan,
+    CommFaultInjector,
+    FaultSpec,
+)
+from network_distributed_pytorch_tpu.resilience.guards import (
+    CommEscalationError,
+    OuterSyncDriver,
+    PartitionPolicy,
+    derive_outer_deadline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_report_module():
+    spec = importlib.util.spec_from_file_location(
+        "report", os.path.join(REPO, "scripts", "report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# PartitionPolicy / OuterSyncDriver
+# ---------------------------------------------------------------------------
+
+
+def test_partition_policy_lifecycle_and_budget():
+    policy = PartitionPolicy(max_local_steps=16)
+    policy.note_partition(edge=(0, 1), step=5, reason="test fault")
+    policy.note_partition(edge=(0, 1), step=6)  # idempotent while down
+    assert policy.partitioned and policy.edge == (0, 1)
+    assert [e.phase for e in policy.events] == ["partitioned"]
+
+    policy.note_local_round(8, step=6)
+    policy.note_local_round(8, step=7)  # == budget: charged, not exhausted
+    assert policy.local_steps == 16 and policy.outer_staleness == 2
+    assert policy.remaining_budget == 0
+    with pytest.raises(CommEscalationError):
+        policy.note_local_round(8, step=8)
+
+    # a heal-and-sync is the rejoin: partition ends, staleness resets
+    healed = PartitionPolicy(max_local_steps=16)
+    healed.note_partition(edge=(0, 1), step=2)
+    healed.note_local_round(8, step=3)
+    healed.note_sync(step=4)
+    assert not healed.partitioned and healed.outer_staleness == 0
+    phases = [e.phase for e in healed.events]
+    assert phases == ["partitioned", "local", "rejoin"]
+    assert "EF catch-up" in healed.events[-1].reason
+
+
+def test_outer_sync_driver_routes_on_probe():
+    down = {"v": False}
+    policy = PartitionPolicy(max_local_steps=32)
+    driver = OuterSyncDriver(
+        policy, probes=[lambda: down["v"]], edge_probe=lambda: (0, 1)
+    )
+    assert driver.should_sync(step=0)
+    driver.note_sync(step=0)
+
+    down["v"] = True
+    assert not driver.should_sync(step=1)
+    assert policy.partitioned and policy.edge == (0, 1)
+    driver.note_local(8, step=1)
+    assert policy.local_steps == 8
+
+    down["v"] = False
+    assert driver.should_sync(step=2)
+    driver.note_sync(step=2)
+    assert not policy.partitioned
+    assert [e.phase for e in policy.events] == ["partitioned", "local", "rejoin"]
+
+
+def test_derive_outer_deadline_floor_and_scaling():
+    tiny = derive_outer_deadline(64, n_sites=2, fabric="1GbE")
+    assert tiny >= 0.25  # the floor: scalars must not hair-trigger
+    small = derive_outer_deadline(100 << 20, n_sites=2, fabric="1GbE")
+    big = derive_outer_deadline(200 << 20, n_sites=2, fabric="1GbE")
+    assert big > small > tiny  # past the floor, wire-time scaling wins
+
+
+# ---------------------------------------------------------------------------
+# chaos: comm_partition / comm_heal
+# ---------------------------------------------------------------------------
+
+
+def test_comm_partition_holds_until_heal():
+    plan = ChaosPlan([
+        FaultSpec(
+            kind="comm_partition", step=2, rank=0,
+            payload={"edge": [0, 1]},
+        ),
+        FaultSpec(kind="comm_heal", step=5, rank=0),
+    ])
+    inj = CommFaultInjector(plan, rank=0)
+    for s in (0, 1):
+        inj.advance(s)
+        assert not inj.partitioned
+    for s in (2, 3, 4):  # no duration: the edge stays down until the heal
+        inj.advance(s)
+        assert inj.partitioned and inj.partition_edge == (0, 1)
+    inj.advance(5)
+    assert not inj.partitioned and inj.partition_edge is None
+
+
+def test_comm_partition_duration_self_clears():
+    plan = ChaosPlan([
+        FaultSpec(
+            kind="comm_partition", step=1, rank=0,
+            payload={"edge": [0, 1], "duration_steps": 2},
+        ),
+    ])
+    inj = CommFaultInjector(plan, rank=0)
+    inj.advance(1)
+    inj.advance(2)
+    assert inj.partitioned
+    inj.advance(3)  # step >= until_step: retired without an explicit heal
+    assert not inj.partitioned
+
+
+# ---------------------------------------------------------------------------
+# cost model: two-level pricing
+# ---------------------------------------------------------------------------
+
+
+def _calib(dense=1 << 20, workers=8):
+    return costmodel.CostCalibration(
+        step_time_s=0.02, compute_s=0.01, dense_bytes=float(dense),
+        bytes_per_step=float(dense), n_workers=workers,
+    )
+
+
+def test_canonical_config_hierarchical_knobs():
+    c = costmodel.canonical_config({
+        "reducer": "HierarchicalReducer", "reducer_rank": 1,
+        "sync_every": 8, "outer_async": 1, "sites": 2,
+    })
+    assert c["reducer"] == "hierarchical"
+    assert c["outer_async"] == 1 and c["sites"] == 2
+    key = costmodel.config_key(c)
+    assert "sync=8" in key and "async=1" in key
+    # the flat keys stay byte-stable: no two-level knobs leak into them
+    flat_key = costmodel.config_key(
+        costmodel.canonical_config({"reducer": "exact"})
+    )
+    assert "async" not in flat_key and "sites" not in flat_key
+
+
+def test_predict_hierarchical_prices_both_levels():
+    dense = 1 << 20
+    sync = 8
+    pred = costmodel.predict(
+        _calib(dense),
+        {"reducer": "hierarchical", "sync_every": sync,
+         "outer_async": 1, "sites": 2},
+        fabric="1GbE",
+    )
+    # exact outer (rank 0): the full dense delta crosses once per round
+    assert pred["predicted_outer_bytes_per_step"] == pytest.approx(dense / sync)
+    # inner: dense every step plus the amortized packed outer-delta reduce
+    assert pred["predicted_inner_bytes_per_step"] == pytest.approx(
+        dense * (1 + 1 / sync)
+    )
+    ranked = costmodel.predict(
+        _calib(dense),
+        {"reducer": "hierarchical", "reducer_rank": 1, "sync_every": sync,
+         "outer_async": 1, "sites": 2},
+        fabric="1GbE",
+    )
+    # a compressed outer shrinks the slow-fabric bytes, never the inner
+    assert (
+        ranked["predicted_outer_bytes_per_step"]
+        < pred["predicted_outer_bytes_per_step"]
+    )
+    assert ranked["predicted_inner_bytes_per_step"] == pytest.approx(
+        pred["predicted_inner_bytes_per_step"]
+    )
+
+
+def test_predict_hierarchical_async_hides_outer_time():
+    cfg = {"reducer": "hierarchical", "reducer_rank": 1, "sync_every": 8,
+           "sites": 2}
+    slow = costmodel.predict(_calib(), cfg, fabric="1GbE")
+    hidden = costmodel.predict(_calib(), {**cfg, "outer_async": 1},
+                               fabric="1GbE")
+    assert hidden["predicted_step_s"] <= slow["predicted_step_s"]
+    # the bytes on the wire are identical — async hides time, not traffic
+    assert hidden["predicted_outer_bytes_per_step"] == pytest.approx(
+        slow["predicted_outer_bytes_per_step"]
+    )
+
+
+def test_hierarchical_configs_extend_the_grid():
+    grid = costmodel.hierarchical_configs(_calib())
+    keys = {costmodel.config_key(costmodel.canonical_config(c)) for c in grid}
+    assert len(keys) == len(grid)  # no duplicate join keys
+    assert any(c.get("outer_async") for c in grid)
+    assert all(
+        costmodel.canonical_config(c)["reducer"] == "hierarchical"
+        for c in grid
+    )
+
+
+# ---------------------------------------------------------------------------
+# report: hierarchy + partition sections
+# ---------------------------------------------------------------------------
+
+
+def test_hierarchy_summary_splits_levels():
+    report = _load_report_module()
+    bandwidth = {"by_tag": [
+        {"tag": "inner.step_grads", "payload_bytes": 8000.0, "count": 8},
+        {"tag": "inner.grads", "payload_bytes": 1000.0, "count": 1},
+        {"tag": "outer.grads", "payload_bytes": 125.0, "count": 1},
+        {"tag": "grads", "payload_bytes": 999.0, "count": 1},  # flat: ignored
+    ]}
+    h = report.hierarchy_summary(bandwidth)
+    assert h["inner_bytes_per_step"] == 9000.0
+    assert h["outer_bytes_per_step"] == 125.0
+    assert h["cross_site_fraction"] == pytest.approx(125.0 / 9125.0)
+    assert report.hierarchy_summary({"by_tag": [
+        {"tag": "grads", "payload_bytes": 1.0, "count": 1},
+    ]}) is None  # a flat run has no hierarchy section
+    lines = report.render_hierarchy_section(h)
+    assert any("cross-site share" in l for l in lines)
+
+
+def test_partition_summary_counts_the_timeline():
+    report = _load_report_module()
+    policy = PartitionPolicy(max_local_steps=12, rank=0)
+    policy.note_partition(edge=(0, 1), step=10, reason="gameday")
+    policy.note_local_round(8, step=11)
+    policy.note_sync(step=12)
+    events = [e.record() for e in policy.events]
+    p = report.partition_summary(events)
+    assert p["n_partitions"] == 1 and p["n_rejoins"] == 1
+    assert p["healed"] and p["budget"] == 12 and p["max_local_steps"] == 8
+    assert report.partition_summary([{"event": "step"}]) is None
+    assert report.render_partition_section(p)
+
+
+# ---------------------------------------------------------------------------
+# health: divergence-budget burn detector
+# ---------------------------------------------------------------------------
+
+
+def test_outer_staleness_detector_pages_at_budget_fractions():
+    cfg = DetectorConfig()
+    assert HealthMonitor(cfg).observe_outer_staleness(4, 16) == []
+    warn = HealthMonitor(cfg).observe_outer_staleness(9, 16)
+    assert [a.severity for a in warn] == ["warn"]
+    crit = HealthMonitor(cfg).observe_outer_staleness(15, 16)
+    assert [a.severity for a in crit] == ["critical"]
+    assert "divergence budget" in crit[0].message
+    # no positive budget → no escalation contract → silence, not a page
+    assert HealthMonitor(cfg).observe_outer_staleness(5, 0) == []

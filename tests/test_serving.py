@@ -24,6 +24,8 @@ The load-bearing claims, in test form:
 import importlib.util
 import json
 import os
+import signal
+import subprocess
 import sys
 import threading
 import time
@@ -283,6 +285,97 @@ def test_spool_doc_release_reclaim_roundtrip(tmp_path):
     assert first.manifest_ids() == ["only"]
     second.complete_doc(entry_id2, dict(doc2, state="done"))
     assert second.drained()
+
+
+_STALLED_CLAIMER_SRC = """\
+import os, sys, time
+from network_distributed_pytorch_tpu.serving import FileSpool
+
+root, trigger = sys.argv[1], sys.argv[2]
+spool = FileSpool(root, rank=1, incarnation=0)
+got = None
+deadline = time.monotonic() + 30.0
+while got is None and time.monotonic() < deadline:
+    got = spool.claim_doc()
+    time.sleep(0.005)
+assert got is not None, "claimer never won the claim"
+print("CLAIMED", flush=True)
+while not os.path.exists(trigger):
+    time.sleep(0.005)
+entry_id, doc = got
+spool.release_doc(entry_id, dict(doc, parked_by="stalled-claimer"))
+print("RELEASED", flush=True)
+"""
+
+
+def test_spool_release_racing_requeue_sigstopped_claimer(tmp_path):
+    """The partition-shaped race the fleet scheduler must survive: a
+    claimer stalls (SIGSTOP — alive, not dead), the world shrinks past its
+    rank, a survivor's ``requeue_orphans`` lawfully takes the claim, and
+    the stalled worker then resumes and tries to ``release_doc`` a claim
+    it no longer owns. The late release must no-op — exactly one live
+    copy of the entry stays in circulation (no double-claim) and the
+    requeue's bookkeeping (the incremented ``requeues`` count) survives
+    instead of being overwritten by the staller's parked copy."""
+    root = str(tmp_path / "spool")
+    trigger = str(tmp_path / "release-now")
+    FileSpool(root).ensure_docs({"only": {"doc_id": "only", "requeues": 0}})
+
+    script = str(tmp_path / "stalled_claimer.py")
+    with open(script, "w") as f:
+        f.write(_STALLED_CLAIMER_SRC)
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [sys.executable, script, root, trigger],
+        stdout=subprocess.PIPE, text=True, env=env,
+    )
+    try:
+        assert proc.stdout.readline().strip() == "CLAIMED"
+        os.kill(proc.pid, signal.SIGSTOP)
+
+        survivor = FileSpool(root, rank=0, incarnation=0)
+        # at world=2 the stalled rank 1 is a LIVE identity — untouchable
+        assert survivor.requeue_orphans(world=2) == 0
+        # the world shrank past it: the claim is provably orphaned
+        assert survivor.requeue_orphans(world=1) == 1
+
+        # resume the staller and let its release_doc race to the finish
+        with open(trigger, "w") as f:
+            f.write("go")
+        os.kill(proc.pid, signal.SIGCONT)
+        assert proc.stdout.readline().strip() == "RELEASED"
+        assert proc.wait(timeout=30.0) == 0
+    finally:
+        try:
+            os.kill(proc.pid, signal.SIGCONT)
+        except OSError:
+            pass
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10.0)
+
+    # exactly one live copy: the requeued doc, its bookkeeping intact
+    queued = sorted(os.listdir(os.path.join(root, "queue")))
+    assert queued == ["only.json"]
+    with open(os.path.join(root, "queue", "only.json")) as f:
+        doc = json.load(f)
+    assert doc["requeues"] == 1
+    assert "parked_by" not in doc  # the stolen claim's release no-oped
+    # no claim-side residue anywhere (including .releasing proof files)
+    claimed_root = os.path.join(root, "claimed")
+    residue = [
+        os.path.join(d, n)
+        for d in sorted(os.listdir(claimed_root))
+        for n in os.listdir(os.path.join(claimed_root, d))
+    ]
+    assert residue == []
+    # the entry is claimable exactly once, then the spool drains normally
+    reclaimer = FileSpool(root, rank=0, incarnation=1)
+    entry_id, doc2 = reclaimer.claim_doc()
+    assert entry_id == "only" and doc2["requeues"] == 1
+    assert reclaimer.claim_doc() is None
+    reclaimer.complete_doc(entry_id, dict(doc2, state="done"))
+    assert reclaimer.drained()
 
 
 # --- toy-engine fail-over (jax-free, the probe's fast twin) ---------------

@@ -81,6 +81,11 @@ from network_distributed_pytorch_tpu.observe.runlog import (  # noqa: E402
     ENV_RUN_DIR,
     shard_event_log_from_env,
 )
+from network_distributed_pytorch_tpu.resilience.guards import (  # noqa: E402
+    CommEscalationError,
+    OuterSyncDriver,
+    PartitionPolicy,
+)
 from network_distributed_pytorch_tpu.resilience.supervisor import (  # noqa: E402
     incarnation_from_env,
 )
@@ -119,7 +124,27 @@ TOY_RUNG_SPECS = {
         8, 8, 2,
         {"reducer": "powersgd", "reducer_rank": 1, "sync_every": 8},
     ),
+    # the two-level geo rung (byte-compatible with the ladder's
+    # "hierarchical-async" knobs): dense inner reduction every step on
+    # the fast in-node fabric, rank-1-compressed outer reduction across
+    # TOY_SITES every sync_every steps on --sim-fabric, outer sync
+    # hidden behind the next round's compute (outer_async). The divisor
+    # here compresses only the OUTER payload — the inner level stays
+    # dense, which is the whole point of the hierarchy.
+    "hierarchical": (
+        8, 8, 2,
+        {
+            "reducer": "hierarchical", "reducer_rank": 1,
+            "sync_every": 8, "outer_async": 1,
+        },
+    ),
 }
+# the toy geo topology: two sites, ring-split down the middle; the
+# cross-site edge the partition game day cuts is (inner_world-1, inner_world)
+TOY_SITES = 2
+# the toy inner fabric: the fast in-node level the hierarchical rung's
+# per-step dense reduction is priced on, regardless of --sim-fabric
+TOY_INNER_FABRIC = "ICI(v5e)"
 # --health-every: the synthetic grad norm baseline — near-constant, so the
 # live plane's EWMA spike detector has an almost-zero-variance envelope and
 # a chaos ``grad_spike`` (factor 1000 by default) is unambiguously critical
@@ -232,6 +257,13 @@ def main() -> int:
              " overrides it per-step",
     )
     p.add_argument(
+        "--max-local-steps", type=int, default=64, metavar="N",
+        help="divergence budget of the hierarchical rung: site-local"
+             " steps a cross-site partition may accrue before the toy"
+             " escalates (CommEscalationError -> chaos exit), mirroring"
+             " resilience.guards.PartitionPolicy's contract",
+    )
+    p.add_argument(
         "--hbm-mult", type=float, default=1.0, metavar="X",
         help="scale the toy HBM limit, compile-time footprint, and live"
              " memory ramp by X — the memory observatory's \"double the"
@@ -269,6 +301,21 @@ def main() -> int:
     divisor, sync_every, n_coll, comm_config = TOY_RUNG_SPECS[args.rung]
     rung_bytes_now = payload_bytes // divisor
 
+    # the two-level rung's per-step wire accounting: the dense inner
+    # reduction runs every step AND once more inside each sync round; only
+    # the compressed outer payload (rung_bytes_now) crosses the slow edge,
+    # amortized over the sync period — the per-level split the report's
+    # hierarchy section and the cost model's predicted_outer_bytes join on
+    hier = args.rung == "hierarchical"
+    outer_async = bool(comm_config.get("outer_async"))
+    inner_world = max(1, args.world // TOY_SITES)
+    if hier:
+        inner_sync_bytes = payload_bytes // sync_every
+        outer_step_bytes = rung_bytes_now // sync_every
+        total_step_bytes = payload_bytes + inner_sync_bytes + outer_step_bytes
+    else:
+        total_step_bytes = rung_bytes_now
+
     # the toy memory plane, scaled as one unit: limit, footprint, and the
     # live ramp all follow --hbm-mult (occupancy FRACTIONS are invariant,
     # so the headroom detector behaves identically at any scale)
@@ -287,29 +334,49 @@ def main() -> int:
         if event_log else None
     )
     if telemetry is not None:
-        telemetry.emit(
-            CollectiveEvent(
-                label="toy", tag="toy.grads", layer="reducer",
-                op="all-reduce", axis="data", dtype="float32",
-                payload_bytes=rung_bytes_now,
+        if hier:
+            # the per-level toy ledger, tags matching the real
+            # HierarchicalReducer's tag_scope prefixes: the per-step
+            # inner DDP reduction, the sync round's inner phase
+            # (amortized), and the compressed cross-site outer payload
+            # (amortized) — what hierarchy_summary splits per level
+            for tag, axis, b in (
+                ("inner.step_grads", "ici", payload_bytes),
+                ("inner.grads", "ici", inner_sync_bytes),
+                ("outer.grads", "dcn", outer_step_bytes),
+            ):
+                telemetry.emit(
+                    CollectiveEvent(
+                        label="toy", tag=tag, layer="reducer",
+                        op="all-reduce", axis=axis, dtype="float32",
+                        payload_bytes=b,
+                    )
+                )
+        else:
+            telemetry.emit(
+                CollectiveEvent(
+                    label="toy", tag="toy.grads", layer="reducer",
+                    op="all-reduce", axis="data", dtype="float32",
+                    payload_bytes=rung_bytes_now,
+                )
             )
-        )
         # the toy compile verdict: byte-exact by fiat, one fully-exposed
         # collective, the cost fields observe.mfu joins at report time, and
         # the active rung's comm_config so the cost-model observatory can
         # identify WHICH config this run executed (join_realized)
+        n_hlo_coll = 3 if hier else 1
         telemetry.emit(
             CompileEvent(
                 label="toy",
-                analytic_bytes=rung_bytes_now,
-                hlo_bytes=rung_bytes_now,
+                analytic_bytes=total_step_bytes,
+                hlo_bytes=total_step_bytes,
                 delta_bytes=0,
                 exact=True,
-                hlo_collective_count=1,
-                hlo_by_kind={"all-reduce": 1},
+                hlo_collective_count=n_hlo_coll,
+                hlo_by_kind={"all-reduce": n_hlo_coll},
                 overlap={
                     "scheduled": True,
-                    "n_sync_collectives": 1,
+                    "n_sync_collectives": n_hlo_coll,
                     "n_sync_gaps_with_compute": 0,
                 },
                 flops_per_step=TOY_FLOPS_PER_STEP,
@@ -321,6 +388,7 @@ def main() -> int:
                 # real backend, byte-exact by fiat — the predicted side of
                 # the report's memory join, jax-free
                 **footprint,
+                dense_grad_bytes=payload_bytes if hier else None,
                 comm_config=dict(comm_config),
             )
         )
@@ -334,6 +402,25 @@ def main() -> int:
     comm_chaos = CommFaultInjector(
         plan, rank=args.rank, incarnation=incarnation, telemetry=telemetry
     )
+
+    # the geo-resilient control plane of the hierarchical rung: the real
+    # PartitionPolicy/OuterSyncDriver (not a toy copy) route each outer
+    # round — a comm_partition fault degrades rounds to site-local, each
+    # one charging the --max-local-steps divergence budget, and the heal
+    # rejoins via note_sync. Budget exhaustion escalates exactly like the
+    # jax loop: CommEscalationError -> chaos exit.
+    outer_driver = None
+    if hier:
+        outer_driver = OuterSyncDriver(
+            PartitionPolicy(
+                max_local_steps=args.max_local_steps,
+                telemetry=telemetry,
+                rank=args.rank,
+                incarnation=incarnation,
+            ),
+            probes=(lambda: comm_chaos.partitioned,),
+            edge_probe=lambda: comm_chaos.partition_edge,
+        )
 
     flap = args.comm_flap
     run_dir = os.environ.get(ENV_RUN_DIR)
@@ -375,16 +462,38 @@ def main() -> int:
     def _comm_sleep_s():
         if args.sim_fabric is None:
             return 0.0
+        from network_distributed_pytorch_tpu.utils.bandwidth import (
+            allreduce_time_s,
+        )
+
+        if hier and controller is None:
+            # two-level wire model, mirroring the cost model's pricing:
+            # the dense inner reduction (per step + the sync round's
+            # phase) on the fast in-node fabric; the compressed outer
+            # payload on --sim-fabric across the site leaders, slowed by
+            # any active cross-site throttle, skipped entirely while the
+            # edge is partitioned (site-local round), and hidden behind
+            # the round's compute window when the outer loop is async
+            inner_s = allreduce_time_s(
+                payload_bytes, inner_world, TOY_INNER_FABRIC
+            ) * (1.0 + 1.0 / sync_every)
+            if outer_driver is not None and comm_chaos.partitioned:
+                return inner_s
+            outer_s = allreduce_time_s(
+                rung_bytes_now, TOY_SITES, args.sim_fabric,
+                n_collectives=n_coll,
+            )
+            outer_s += comm_chaos.host_throttle_sleep_s(rung_bytes_now)
+            if outer_async:
+                window = sync_every * (args.step_seconds + inner_s)
+                outer_s = max(0.0, outer_s - window)
+            return inner_s + outer_s / sync_every
         if controller is not None:
             b, sync, nc = _rung_bytes(controller.index), 1, (
                 1 if controller.index == 0 else 2
             )
         else:
             b, sync, nc = rung_bytes_now, sync_every, n_coll
-        from network_distributed_pytorch_tpu.utils.bandwidth import (
-            allreduce_time_s,
-        )
-
         return allreduce_time_s(
             b, args.world, args.sim_fabric, n_collectives=nc
         ) / sync
@@ -525,7 +634,11 @@ def main() -> int:
                 comm_s = _comm_sleep_s()
                 # active per-edge throttle: the modeled extra wire time the
                 # fence hook would have injected, paid on the host here
-                comm_s += comm_chaos.host_throttle_sleep_s(rung_bytes_now)
+                # (the hierarchical path already folds it into the outer
+                # sync inside _comm_sleep_s, where async overlap and
+                # partition skipping apply to it)
+                if not hier:
+                    comm_s += comm_chaos.host_throttle_sleep_s(rung_bytes_now)
                 if comm_s > 0:
                     with span("step/comm", step=i, rank=args.rank):
                         time.sleep(comm_s)
@@ -543,12 +656,34 @@ def main() -> int:
                         rank=args.rank, step=i, incarnation=incarnation,
                     )
                 )
+            if outer_driver is not None and (i + 1) % sync_every == 0:
+                # end of an outer round: route the cross-site sync through
+                # the real driver — partitioned rounds degrade to
+                # site-local (typed "local" event, budget charged), the
+                # first healthy round after the heal is the rejoin
+                if outer_driver.should_sync(step=i):
+                    outer_driver.note_sync(step=i)
+                else:
+                    try:
+                        outer_driver.note_local(sync_every, step=i)
+                    except CommEscalationError as e:
+                        if telemetry is not None:
+                            telemetry.emit(
+                                FailureEvent(
+                                    kind="comm_escalation", label="toy",
+                                    rank=args.rank, step=i,
+                                    incarnation=incarnation,
+                                    message=str(e),
+                                )
+                            )
+                            telemetry.close()
+                        os._exit(CHAOS_EXIT_CODE)
             if telemetry is not None:
                 telemetry.emit(
                     StepEvent(
                         step=i, epoch=0, loss=1.0 / (i + 1),
                         step_time_s=step_time,
-                        bits_cumulative=8 * rung_bytes_now * (i + 1),
+                        bits_cumulative=8 * total_step_bytes * (i + 1),
                     )
                 )
             if (
