@@ -94,6 +94,12 @@ MFU_TARGETS = {"small": 0.002, "full": 0.005}
 # acceptance). gate.py reads the recorded value as a lower-is-better
 # metric AND this target as an absolute bound, mirroring mfu_target.
 DATA_LOAD_SHARE_TARGET = 0.05
+# absolute floor for the paged KV cache's concurrency win at equal HBM:
+# the block pool must admit >= 2x the requests a dense slot cache holds
+# in the same device bytes (PR 19 acceptance). gate.py reads the recorded
+# kv_capacity_ratio as higher-is-better AND this target as an absolute
+# bound, mirroring data_load_share_target.
+KV_CAPACITY_RATIO_TARGET = 2.0
 # absolute ceiling for the offline cost model's predicted-vs-realized step
 # time error (observe.costmodel; ISSUE PR 13 acceptance): the planner's
 # predictions must stay within 25% of measured on executed configs.
@@ -129,12 +135,18 @@ PHASE_BUDGET_S = {
     "fp32arm": int(os.environ.get("BENCH_FP32ARM_BUDGET_S", "240")),
     "overlap": int(os.environ.get("BENCH_OVERLAP_BUDGET_S", "240")),
     "loader": int(os.environ.get("BENCH_LOADER_BUDGET_S", "150")),
+    "serving": int(os.environ.get("BENCH_SERVING_BUDGET_S", "240")),
 }
 # priority order under the global deadline: the headline pair first, then
 # the GPT MFU row (verdict item), then the decomposition arm, then the
 # AOT-only overlap evidence, then the loader-isolation arm (host-only —
-# cheap, but it must never displace a device measurement)
-PHASES = ("probe", "flagship", "baseline", "gpt", "fp32arm", "overlap", "loader")
+# cheap, but it must never displace a device measurement), then the
+# serving arm (small-model inference — last because the training-path
+# numbers are the round's headline)
+PHASES = (
+    "probe", "flagship", "baseline", "gpt", "fp32arm", "overlap", "loader",
+    "serving",
+)
 # extra wait on a child's FIRST event only: process start + jax import +
 # the backend-init watchdog (BENCH_INIT_TIMEOUT_S, default 240 s) all
 # precede it. Without this, a respawned child that hangs at init would be
@@ -1028,6 +1040,158 @@ def _phase_loader() -> dict:
     return out
 
 
+def _phase_serving() -> dict:
+    """Paged-KV serving arm (PR 19): dense slot cache vs block-pool paged
+    cache on the SAME model, workload, and KV device bytes. Three claims,
+    each measured here rather than asserted:
+
+    - ``kv_capacity_ratio``: peak concurrently-admitted requests, paged
+      over dense, at equal KV HBM (the paged pool is sized to the dense
+      cache's bytes plus one permanent garbage block). Requests are much
+      shorter than ``max_len``, so the dense engine pins a full
+      ``max_len`` row per request while the pool hands out only the
+      blocks each request can actually reach — the acceptance bound is
+      >= 2x (``KV_CAPACITY_RATIO_TARGET``).
+    - ``serving_tokens_per_s_per_chip`` / ``p99_decode_ms_per_token``:
+      throughput and tail latency of the PAGED arm — the engine the gate
+      protects from here on.
+    - ``serving_paged_bitwise_equal``: per-request token streams from the
+      paged arm compared bit-for-bit against the dense arm's (the
+      guarantee class that makes the capacity win free).
+
+    A speculative arm (self-drafting target, ``spec_k=4``) rides along:
+    same bitwise check, plus accept rate and target decode steps — on
+    real hardware fewer target dispatches per token is the win; the
+    accept accounting is what this tier can verify.
+    """
+    import jax
+
+    from network_distributed_pytorch_tpu.models.gpt import gpt_tiny
+    from network_distributed_pytorch_tpu.serving import (
+        WorkloadConfig,
+        poisson_workload,
+        replay,
+        slo_summary,
+    )
+    from network_distributed_pytorch_tpu.serving.engine import (
+        PagedEngine,
+        SlotEngine,
+    )
+
+    small = _small_preset()
+    n_requests = 32 if small else 64
+    dense_slots = 4
+    max_len, block_len = 64, 8
+    # budget <= 16 tokens/request -> <= 2 blocks of 8, against a dense
+    # engine pinning all 64 positions per admission: the capacity gap the
+    # ratio measures. rate_rps is effectively "all queued at t=0" so both
+    # engines run at their admission ceiling, not the arrival rate's.
+    workload = WorkloadConfig(
+        n_requests=n_requests,
+        rate_rps=2000.0,
+        prompt_len=(4, 8),
+        max_new_tokens=(2, 8),
+        vocab=64,
+        seed=0,
+    )
+    model = gpt_tiny(vocab_size=64, max_position_embeddings=max_len)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp_zeros_tokens(max_len)
+    )["params"]
+
+    def arm(make_engine):
+        eng = make_engine()
+        t0 = time.perf_counter()
+        finished = replay(eng, poisson_workload(workload), max_wall_s=120.0)
+        wall = time.perf_counter() - t0
+        tokens = {r.request_id: list(r.tokens) for r in finished}
+        return eng, slo_summary(finished), tokens, wall
+
+    dense, dense_slo, dense_tokens, dense_wall = arm(
+        lambda: SlotEngine(
+            model.config, params, n_slots=dense_slots, max_len=max_len
+        )
+    )
+    # equal-HBM paged arm: pool = the dense cache's block-equivalents
+    # (+ garbage block 0); n_slots raised so the BLOCK POOL is the
+    # admission limit being measured, not the table count. Prefix sharing
+    # off — random prompts never share, and a pinned index entry would
+    # muddy the capacity count.
+    n_blocks = dense_slots * (max_len // block_len) + 1
+    paged, paged_slo, paged_tokens, paged_wall = arm(
+        lambda: PagedEngine(
+            model.config, params, n_slots=4 * dense_slots, max_len=max_len,
+            block_len=block_len, n_blocks=n_blocks, prefix_sharing=False,
+        )
+    )
+    spec, spec_slo, spec_tokens, spec_wall = arm(
+        lambda: PagedEngine(
+            model.config, params, n_slots=4 * dense_slots, max_len=max_len,
+            block_len=block_len, n_blocks=n_blocks, prefix_sharing=False,
+            draft_config=model.config, draft_params=params, spec_k=4,
+        )
+    )
+
+    n_chips = 1  # single-device engines; the per-chip label is the contract
+    ratio = (
+        paged.peak_active / dense.peak_active if dense.peak_active else 0.0
+    )
+    total_tokens = sum(len(t) for t in paged_tokens.values())
+    out = {
+        "serving_requests": n_requests,
+        "serving_dense_slots": dense_slots,
+        "serving_block_len": block_len,
+        "serving_n_blocks": n_blocks,
+        # the equal-HBM attestation: pool bytes over dense cache bytes
+        # (slightly > 1.0 — the garbage block is the only extra)
+        "serving_hbm_parity": round(paged.pool_bytes / dense.cache_bytes, 4),
+        "serving_dense_peak_active": dense.peak_active,
+        "serving_paged_peak_active": paged.peak_active,
+        "kv_capacity_ratio": round(ratio, 2),
+        "kv_capacity_ratio_target": KV_CAPACITY_RATIO_TARGET,
+        "serving_paged_bitwise_equal": paged_tokens == dense_tokens,
+        "serving_spec_bitwise_equal": spec_tokens == dense_tokens,
+        "serving_tokens_per_s_per_chip": round(
+            total_tokens / paged_wall / n_chips, 2
+        ),
+        "serving_dense_tokens_per_s_per_chip": round(
+            sum(len(t) for t in dense_tokens.values()) / dense_wall / n_chips,
+            2,
+        ),
+        "p99_decode_ms_per_token": round(
+            paged_slo["p99_decode_ms_per_token"], 3
+        ),
+        "serving_dense_p99_decode_ms_per_token": round(
+            dense_slo["p99_decode_ms_per_token"], 3
+        ),
+        # speculative arm: accept accounting + the dispatch win (target
+        # decode steps per generated token, lower is better — CPU wall
+        # clock is draft-dominated at this model size, so the STEP ratio
+        # is the portable evidence)
+        "serving_spec_accept_rate": round(
+            spec.stats().get("spec_accept_rate", 0.0), 4
+        ),
+        "serving_spec_decode_steps": spec.decode_steps,
+        "serving_paged_decode_steps": paged.decode_steps,
+        "serving_spec_p99_decode_ms_per_token": round(
+            spec_slo["p99_decode_ms_per_token"], 3
+        ),
+        "serving_spec_wall_s": round(spec_wall, 3),
+    }
+    if not out["serving_paged_bitwise_equal"]:
+        raise RuntimeError("paged serving arm diverged bitwise from dense")
+    if not out["serving_spec_bitwise_equal"]:
+        raise RuntimeError("speculative serving arm diverged bitwise from dense")
+    return out
+
+
+def jnp_zeros_tokens(max_len: int):
+    """Tiny helper so _phase_serving's jax import stays phase-local."""
+    import jax.numpy as jnp
+
+    return jnp.zeros((1, max_len), jnp.int32)
+
+
 _PHASE_FNS = {
     "probe": _phase_probe,
     "flagship": _phase_flagship,
@@ -1036,6 +1200,7 @@ _PHASE_FNS = {
     "fp32arm": _phase_fp32arm,
     "overlap": _phase_overlap,
     "loader": _phase_loader,
+    "serving": _phase_serving,
 }
 
 
@@ -1759,6 +1924,22 @@ def _record_gate_baseline(out: dict, status: dict) -> None:
                 rec[key] = float(v)
         if "data_load_share" in rec:
             rec["data_load_share_target"] = DATA_LOAD_SHARE_TARGET
+    # paged-serving arm (PR 19): throughput and tail latency of the paged
+    # engine are relative gate metrics; the capacity ratio also carries its
+    # absolute >= 2x floor, same contract as data_load_share's ceiling.
+    # Phase-gated like the loader's so a skipped arm keeps the previous
+    # baseline's serving fields alive.
+    if str(status.get("serving", "")).startswith("ok"):
+        for key in (
+            "serving_tokens_per_s_per_chip",
+            "p99_decode_ms_per_token",
+            "kv_capacity_ratio",
+        ):
+            v = out.get(key)
+            if isinstance(v, (int, float)) and v > 0:
+                rec[key] = float(v)
+        if "kv_capacity_ratio" in rec:
+            rec["kv_capacity_ratio_target"] = KV_CAPACITY_RATIO_TARGET
     # disaster-recovery MTTR from the newest game-day report (run_probe
     # phase 5 — the plain probe report has no replans): rides along so
     # gate.py's lower-is-better recovery_time_s metric has a recorded

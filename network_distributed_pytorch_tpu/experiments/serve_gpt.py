@@ -7,7 +7,11 @@ decoder (freshly initialized, or hot-loaded from the newest committed
 TRAINING checkpoint via ``serving.cache.restore_serving_params``), draws
 a deterministic Poisson workload, and serves it through
 ``serving.engine.SlotEngine`` — iteration-level continuous batching over
-``slots`` static batch slots, one compiled decode step for the run.
+``slots`` static batch slots, one compiled decode step for the run — or,
+with ``--engine paged``, through ``serving.engine.PagedEngine``: the
+block-pool paged KV cache (copy-on-write prefix sharing, optional
+speculative decoding via ``--spec-k``), bitwise-identical tokens at a
+fraction of the dense cache's HBM.
 
 Two serving modes:
 
@@ -48,6 +52,11 @@ def run(
     checkpoint_dir: Optional[str] = None,
     spool_dir: Optional[str] = None,
     max_wall_s: float = 120.0,
+    engine: str = "slot",
+    block_len: int = 16,
+    n_blocks: Optional[int] = None,
+    prefix_sharing: bool = True,
+    spec_k: int = 0,
 ) -> Dict:
     from ..observe import NoteEvent, telemetry_from_config
     from ..serving import (
@@ -56,9 +65,15 @@ def run(
         replay,
         slo_summary,
     )
-    from ..serving.engine import SlotEngine, padded_static_decode_steps
+    from ..serving.engine import (
+        PagedEngine,
+        SlotEngine,
+        padded_static_decode_steps,
+    )
 
     config = config or ExperimentConfig()
+    if engine not in ("slot", "paged"):
+        raise ValueError(f"engine must be 'slot' or 'paged', got {engine!r}")
     if slots < 1:
         raise ValueError(f"slots must be >= 1, got {slots}")
     if requests < 1:
@@ -80,8 +95,11 @@ def run(
     )
     # cache capacity covers the longest possible request; every admission
     # prefills at this capacity so outputs are comparable bit-for-bit with
-    # a sequential generate(cache_len=max_len) reference
+    # a sequential generate(cache_len=max_len) reference. The paged engine
+    # wants a whole number of KV blocks.
     max_len = p_hi + max_new_tokens
+    if engine == "paged":
+        max_len = ((max_len + block_len - 1) // block_len) * block_len
 
     make = gpt_tiny if preset == "small" else gpt_small
     model = make(
@@ -119,10 +137,28 @@ def run(
             else:
                 params, ckpt_step = restored
 
-        engine = SlotEngine(
-            model.config, params, n_slots=slots, max_len=max_len,
-            telemetry=telemetry, rank=config.process_id, label="serve_gpt",
-        )
+        if engine == "paged":
+            # speculative decoding self-drafts here: a freshly-initialized
+            # independent draft would propose noise (accept rate ~1/vocab),
+            # so the mechanical demo uses the target as its own draft —
+            # bitwise-accept semantics are what is being exercised, and a
+            # real deployment swaps in a distilled gpt_tiny-class draft
+            eng = PagedEngine(
+                model.config, params, n_slots=slots, max_len=max_len,
+                block_len=block_len, n_blocks=n_blocks,
+                prefix_sharing=prefix_sharing,
+                draft_config=model.config if spec_k >= 2 else None,
+                draft_params=params if spec_k >= 2 else None,
+                spec_k=spec_k,
+                telemetry=telemetry, rank=config.process_id,
+                label="serve_gpt",
+            )
+        else:
+            eng = SlotEngine(
+                model.config, params, n_slots=slots, max_len=max_len,
+                telemetry=telemetry, rank=config.process_id,
+                label="serve_gpt",
+            )
 
         if spool_dir is not None:
             from ..resilience import incarnation_from_env
@@ -136,14 +172,14 @@ def run(
             )
             spool.ensure(poisson_workload(workload))
             served = serve_from_spool(
-                engine, spool, world=config.num_processes,
+                eng, spool, world=config.num_processes,
                 max_wall_s=max_wall_s,
             )
             finished = served.pop("requests")
             mode: Dict = {"mode": "spool", **served}
         else:
             finished = replay(
-                engine, poisson_workload(workload), max_wall_s=max_wall_s
+                eng, poisson_workload(workload), max_wall_s=max_wall_s
             )
             mode = {"mode": "in_process"}
 
@@ -161,8 +197,9 @@ def run(
             "request_rate": request_rate,
             "max_len": max_len,
             "checkpoint_step": ckpt_step,
-            "decode_steps": engine.decode_steps,
-            "prefills": engine.prefills,
+            "engine": engine,
+            "decode_steps": eng.decode_steps,
+            "prefills": eng.prefills,
             "padded_static_decode_steps": padded_static_decode_steps(
                 decode_lengths, slots
             ),
@@ -177,6 +214,17 @@ def run(
             ),
             **mode,
         }
+        if engine == "paged":
+            summary["kv"] = eng.kv_stats()
+            if spec_k >= 2:
+                stats = eng.stats()
+                summary["spec"] = {
+                    k: stats[k]
+                    for k in (
+                        "spec_k", "spec_rounds", "spec_proposed",
+                        "spec_accepted", "spec_accept_rate",
+                    )
+                }
         return summary
     finally:
         telemetry.close()

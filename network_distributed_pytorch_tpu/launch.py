@@ -239,6 +239,34 @@ def build_parser() -> argparse.ArgumentParser:
              " mid-decode fail-over (dead ranks' in-flight requests are"
              " re-queued on the survivors)",
     )
+    p.add_argument(
+        "--engine", type=str, default=None, choices=("slot", "paged"),
+        help="serve_gpt only: KV cache engine — 'slot' (dense per-slot"
+             " cache) or 'paged' (block-pool cache with copy-on-write"
+             " prefix sharing; default slot)",
+    )
+    p.add_argument(
+        "--block-len", type=int, default=None,
+        help="serve_gpt only (--engine paged): tokens per KV block"
+             " (default 16)",
+    )
+    p.add_argument(
+        "--n-blocks", type=int, default=None,
+        help="serve_gpt only (--engine paged): KV pool size in blocks"
+             " (default: dense-equivalent bytes, slots * max_len/block_len"
+             " + 1)",
+    )
+    p.add_argument(
+        "--no-prefix-sharing", action="store_true",
+        help="serve_gpt only (--engine paged): disable copy-on-write"
+             " prompt-prefix sharing",
+    )
+    p.add_argument(
+        "--spec-k", type=int, default=None,
+        help="serve_gpt only (--engine paged): speculative decoding window"
+             " — draft proposes K-1 tokens, target verifies all K in one"
+             " batched step (default off)",
+    )
     p.add_argument("--json", action="store_true", help="print the summary as JSON")
     p.add_argument(
         "--chaos-plan", type=str, default=None,
@@ -689,6 +717,10 @@ def main(argv=None) -> dict:
         ("--slots", args.slots), ("--requests", args.requests),
         ("--request-rate", args.request_rate),
         ("--spool-dir", args.spool_dir),
+        ("--engine", args.engine), ("--block-len", args.block_len),
+        ("--n-blocks", args.n_blocks),
+        ("--no-prefix-sharing", args.no_prefix_sharing or None),
+        ("--spec-k", args.spec_k),
     ):
         if val is not None and args.experiment != "serve_gpt":
             raise ValueError(
@@ -747,7 +779,14 @@ def main(argv=None) -> dict:
                       if args.request_rate is not None else 64.0,
                       max_new_tokens=args.max_new_tokens,
                       checkpoint_dir=args.checkpoint_dir,
-                      spool_dir=args.spool_dir)
+                      spool_dir=args.spool_dir,
+                      engine=args.engine if args.engine is not None
+                      else "slot",
+                      block_len=args.block_len
+                      if args.block_len is not None else 16,
+                      n_blocks=args.n_blocks,
+                      prefix_sharing=not args.no_prefix_sharing,
+                      spec_k=args.spec_k if args.spec_k is not None else 0)
     elif args.experiment == "bandwidth_study":
         kwargs.update(preset=args.preset)
     elif args.experiment in ("gpt_lm", "gpt_pp", "gpt_sp", "gpt_tp", "gpt_moe"):

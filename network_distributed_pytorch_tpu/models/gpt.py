@@ -881,6 +881,133 @@ def gpt_prefill(config: GPTConfig, params, prompt_ids: jax.Array, max_len: int):
     return logits.astype(jnp.float32), cache
 
 
+def gpt_decode_step_paged(config: GPTConfig, params, pool, tables, tokens, pos):
+    """:func:`gpt_decode_step_slots` over a PAGED KV pool: per-layer K/V live
+    in a shared ``(n_blocks, block_len, H, D)`` block pool and each row's
+    logical ``(max_len, H, D)`` cache is stitched through its block TABLE
+    (``tables`` (B, max_len // block_len) int32, vLLM/PagedAttention
+    layout). Row ``b`` writes ``tokens[b]``'s K/V at physical
+    ``(tables[b, pos[b] // L], pos[b] % L)``, then attention reads the
+    gathered ``(B, max_len, H, D)`` view — IDENTICAL math to the dense
+    slots step from there, so valid positions carry the same bits and the
+    ``<= pos`` mask zeroes everything else exactly (garbage blocks hold
+    finite values only, and ``0.0 * finite`` contributions are exact
+    zeros). Tables are DATA, not structure: alloc/free/copy-on-write on
+    the host never retrace this program. Positions past a table's span
+    scatter into the reserved garbage block 0 (speculative overrun
+    safety), never onto a live block."""
+    from ..ops.paged import gather_block_view, scatter_token_rows
+
+    cfg = config
+    head_dim = cfg.dim // cfg.n_heads
+    block_len = pool[0]["k"].shape[1]
+    max_len = tables.shape[1] * block_len
+
+    apply_dense = lambda p, h: _apply_dense(cfg, p, h)
+    apply_ln = lambda p, h: _apply_ln(cfg, p, h)
+
+    x = params["wte"]["embedding"][tokens].astype(cfg.dtype)  # (B, dim)
+    x = x + params["wpe"]["embedding"][pos].astype(cfg.dtype)
+
+    pool = list(pool)
+    for i in range(cfg.n_layers):
+        bp = params[f"h_{i}"]
+        h = apply_ln(bp["ln_1"], x)
+        q = apply_dense(bp["attn"]["q_proj"], h).reshape(-1, cfg.n_heads, head_dim)
+        k = apply_dense(bp["attn"]["k_proj"], h).reshape(-1, cfg.n_heads, head_dim)
+        v = apply_dense(bp["attn"]["v_proj"], h).reshape(-1, cfg.n_heads, head_dim)
+        pool[i] = {
+            "k": scatter_token_rows(pool[i]["k"], tables, pos, k),
+            "v": scatter_token_rows(pool[i]["v"], tables, pos, v),
+        }
+        k_view = gather_block_view(pool[i]["k"], tables)  # (B, max_len, H, D)
+        v_view = gather_block_view(pool[i]["v"], tables)
+        scores = jnp.einsum(
+            "bhd,bthd->bht", q.astype(jnp.float32),
+            k_view.astype(jnp.float32),
+        ) / jnp.sqrt(head_dim)
+        valid = jnp.arange(max_len)[None, :] <= pos[:, None]
+        scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
+        weights = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum(
+            "bht,bthd->bhd", weights, v_view.astype(jnp.float32)
+        ).astype(cfg.dtype)
+        x = x + apply_dense(
+            bp["attn"]["out_proj"], ctx.reshape(-1, cfg.dim)
+        )
+        h = apply_ln(bp["ln_2"], x)
+        h = apply_dense(bp["mlp_fc"], h)
+        h = nn.gelu(h, approximate=True)
+        x = x + apply_dense(bp["mlp_proj"], h)
+
+    x = apply_ln(params["ln_f"], x)
+    logits = x @ params["wte"]["embedding"].T.astype(cfg.dtype)
+    return logits.astype(jnp.float32), pool
+
+
+def gpt_prefill_shared(config: GPTConfig, params, suffix_ids: jax.Array, prefix_cache):
+    """Prefill only the SUFFIX of a prompt whose first ``P`` tokens already
+    have KV in the cache (prefix sharing: ``P`` is block-aligned and the
+    prefix chain was filled by an earlier request). ``suffix_ids`` is
+    ``(1, t_s)`` at global positions ``P .. P+t_s-1``; ``prefix_cache`` is
+    the per-layer ``{"k","v"}: (1, P, H, D)`` view gathered from the block
+    pool. Suffix queries attend over ``concat(prefix KV, suffix KV)`` with
+    the global causal mask, so the attention reduction for each query spans
+    the same ``P + t_s`` keys a full prefill would — only the prefix
+    projections are skipped. Returns ``(last_logits (1, V) f32,
+    suffix_cache)`` with suffix_cache per-layer ``(1, t_s, H, D)`` K/V to
+    scatter into the request's private blocks."""
+    cfg = config
+    head_dim = cfg.dim // cfg.n_heads
+    b, t = suffix_ids.shape
+    p_len = prefix_cache[0]["k"].shape[1]
+    apply_dense = lambda p, h: _apply_dense(cfg, p, h)
+    apply_ln = lambda p, h: _apply_ln(cfg, p, h)
+
+    x = params["wte"]["embedding"][suffix_ids].astype(cfg.dtype)  # (B, t, dim)
+    x = x + params["wpe"]["embedding"][p_len + jnp.arange(t)][None].astype(cfg.dtype)
+
+    suffix_cache = []
+    # query j sits at global position p_len + j: attends keys 0 .. p_len + j
+    causal = (
+        jnp.arange(p_len + t)[None, :] <= (p_len + jnp.arange(t))[:, None]
+    )
+    for i in range(cfg.n_layers):
+        bp = params[f"h_{i}"]
+        h = apply_ln(bp["ln_1"], x)
+        split = lambda y: y.reshape(b, t, cfg.n_heads, head_dim)
+        q = split(apply_dense(bp["attn"]["q_proj"], h))
+        k = split(apply_dense(bp["attn"]["k_proj"], h))
+        v = split(apply_dense(bp["attn"]["v_proj"], h))
+        suffix_cache.append(
+            {"k": k.astype(cfg.dtype), "v": v.astype(cfg.dtype)}
+        )
+        k_full = jnp.concatenate(
+            [prefix_cache[i]["k"].astype(cfg.dtype), k], axis=1
+        )
+        v_full = jnp.concatenate(
+            [prefix_cache[i]["v"].astype(cfg.dtype), v], axis=1
+        )
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk",
+            q.astype(jnp.float32), k_full.astype(jnp.float32),
+        ) / jnp.sqrt(head_dim)
+        scores = jnp.where(causal[None, None], scores, -jnp.inf)
+        weights = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum(
+            "bhqk,bkhd->bqhd", weights, v_full.astype(jnp.float32)
+        ).astype(cfg.dtype)
+        x = x + apply_dense(bp["attn"]["out_proj"], ctx.reshape(b, t, cfg.dim))
+        h = apply_ln(bp["ln_2"], x)
+        h = apply_dense(bp["mlp_fc"], h)
+        h = nn.gelu(h, approximate=True)
+        x = x + apply_dense(bp["mlp_proj"], h)
+
+    last = apply_ln(params["ln_f"], x[:, -1])
+    logits = last @ params["wte"]["embedding"].T.astype(cfg.dtype)
+    return logits.astype(jnp.float32), suffix_cache
+
+
 def _sample_token(logits, sub, temperature: float):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)

@@ -82,6 +82,7 @@ from . import (  # noqa: F401
 from .events import (  # noqa: F401
     SCHEMA_VERSION,
     AlertEvent,
+    AutoscaleEvent,
     CollectiveEvent,
     CompileEvent,
     CritPathEvent,
@@ -91,6 +92,7 @@ from .events import (  # noqa: F401
     FailureEvent,
     JobEvent,
     JobFailedEvent,
+    KVPoolEvent,
     LoaderEvent,
     MarkerEvent,
     MemoryEvent,

@@ -772,6 +772,65 @@ class JobFailedEvent(Event):
 
 
 @dataclass
+class KVPoolEvent(Event):
+    """Paged-KV pool occupancy sample (``serving.engine.PagedEngine``):
+    the block allocator's view of the serving KV cache — free/used/shared
+    block counts over the fixed ``n_blocks`` pool, the pool's device
+    bytes, and the monotone sharing ledgers (prefix-index hits, prefill
+    tokens skipped via sharing, copy-on-write block copies, admissions
+    deferred for lack of blocks). Emitted every ``emit_pool_every`` decode
+    ticks plus on eviction, so the live aggregator can expose
+    ``live_kv_blocks_free`` / ``live_kv_prefix_hits_total`` /
+    ``live_kv_cow_copies_total`` gauges and the report can fold pool bytes
+    into the serving memory table. Counter fields are engine-lifetime
+    totals (gauge-of-counter on the live plane). Silent on stdout."""
+
+    KIND: ClassVar[str] = "kv_pool"
+
+    n_blocks: int
+    block_len: int = 0
+    blocks_free: int = 0
+    blocks_used: int = 0
+    blocks_shared: int = 0
+    pool_bytes: int = 0
+    prefix_hits_total: int = 0
+    prefill_tokens_saved_total: int = 0
+    cow_copies_total: int = 0
+    admissions_deferred_total: int = 0
+    rank: Optional[int] = None
+    label: str = ""
+
+
+@dataclass
+class AutoscaleEvent(Event):
+    """The serving autoscaler changed (or tried to change) the spool-worker
+    pool: ``direction`` is ``up`` (worker spawned on leased chips), ``down``
+    (worker drained and its chips released), or ``denied`` (scale-up wanted
+    but the scheduler had no grantable chips). ``reason`` names the trigger
+    signal (``slo_burn`` for a live-plane burn escalation, ``queue_depth``
+    for sustained spool backlog, ``drained`` for end-of-storm reaping);
+    ``workers`` is the pool size AFTER the action and ``queue_depth`` /
+    ``p99_s`` the gauge values that drove it, so every scaling decision is
+    auditable from the event log alone. The banner is the record as JSON,
+    like :class:`ScheduleEvent`."""
+
+    KIND: ClassVar[str] = "autoscale"
+
+    direction: str
+    reason: str = ""
+    workers: int = 0
+    worker_id: Optional[int] = None
+    device_ranks: Optional[List[int]] = None
+    queue_depth: Optional[int] = None
+    p99_s: Optional[float] = None
+    escalation: Optional[int] = None
+
+    def banner(self) -> str:
+        rec = {k: v for k, v in self.record().items() if v is not None}
+        return json.dumps(rec, default=str)
+
+
+@dataclass
 class NoteEvent(Event):
     """A free-form human banner (init lifecycle, dropped-batch notes,
     study tables) that should also land in the structured log."""

@@ -308,6 +308,42 @@ def ingest_record(
                 "live_serving_decode_ms_per_token", 1e3 * decode / tokens,
                 help="per-token decode latency (rolling)",
             )
+    elif kind == "kv_pool":
+        # paged-KV pool occupancy (PagedEngine): free blocks are a live
+        # gauge; the sharing/COW ledgers are engine-lifetime totals carried
+        # as gauges-of-counters (each sample supersedes the last)
+        for field, metric, helptxt in (
+            ("blocks_free", "live_kv_blocks_free",
+             "free KV blocks in the paged pool"),
+            ("blocks_used", "live_kv_blocks_used",
+             "allocated KV blocks in the paged pool"),
+            ("blocks_shared", "live_kv_blocks_shared",
+             "KV blocks with refcount > 1 (prefix-shared)"),
+            ("pool_bytes", "live_kv_pool_bytes",
+             "device bytes of the paged KV block pool"),
+            ("prefix_hits_total", "live_kv_prefix_hits_total",
+             "admissions served from the prefix index (lifetime)"),
+            ("cow_copies_total", "live_kv_cow_copies_total",
+             "copy-on-write block copies (lifetime)"),
+            ("admissions_deferred_total", "live_kv_admissions_deferred_total",
+             "admissions deferred for lack of free blocks (lifetime)"),
+        ):
+            v = rec.get(field)
+            if isinstance(v, (int, float)):
+                registry.gauge(metric, v, help=helptxt, rank=rlabel)
+    elif kind == "autoscale":
+        registry.counter(
+            "live_autoscale_events_total",
+            help="serving autoscaler actions",
+            direction=str(rec.get("direction", "?")),
+            reason=str(rec.get("reason", "?")),
+        )
+        workers = rec.get("workers")
+        if isinstance(workers, (int, float)):
+            registry.gauge(
+                "live_serving_workers", float(workers),
+                help="spool workers currently in the serving pool",
+            )
     elif kind == "alert":
         registry.counter(
             "live_alerts_total",

@@ -4,3 +4,10 @@ and Pallas flash attention."""
 from .. import _jax_compat  # noqa: F401  (jax API shims, must load first)
 from .orthogonalize import orthogonalize  # noqa: F401
 from .flash_attention import flash_attention  # noqa: F401
+from .paged import (  # noqa: F401
+    copy_block,
+    gather_block_view,
+    pool_chain_view,
+    scatter_chain,
+    scatter_token_rows,
+)

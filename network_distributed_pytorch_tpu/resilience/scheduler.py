@@ -358,6 +358,11 @@ class FleetScheduler:
         # chips held for a burning pool until it finishes: job_id -> ranks
         self._reserved: Dict[str, List[int]] = {}
         self._born: Dict[str, float] = {}  # first-submission clock
+        # chips leased OUT of the inventory by name (serving autoscaler
+        # pools etc.); lease/release may be called from another thread
+        # than run(), so inventory handoff is lock-protected
+        self._leases: Dict[str, List[int]] = {}
+        self._inv_lock = threading.Lock()
         self._parked_ids: set = set()
         self._segments: Dict[str, int] = {}
         self._final: Dict[str, Dict] = {}  # job_id -> terminal record
@@ -407,6 +412,68 @@ class FleetScheduler:
             if owner != job.job_id:
                 held_for_others.update(ranks)
         return [r for r in self._free if r not in held_for_others]
+
+    # -- chip leasing (serving autoscaler) ---------------------------------
+
+    def lease(self, owner: str, n: int, reason: str = "") -> List[int]:
+        """Grant ``n`` free chips to an out-of-band pool (the serving
+        autoscaler growing its worker fleet). Returns the granted ranks —
+        possibly FEWER than asked (whatever is free and unreserved), empty
+        when the inventory has nothing to give; the caller decides whether
+        a partial grant is worth spawning on. Granted chips leave the
+        free list until :meth:`lease_release`. Thread-safe against the
+        scheduler's own run loop; every grant is a typed ScheduleEvent
+        (``planner="lease"``) so scaling decisions audit from the event
+        log like any admission."""
+        if n < 1:
+            return []
+        with self._inv_lock:
+            held = set()
+            for ranks in self._reserved.values():
+                held.update(ranks)
+            grantable = [r for r in self._free if r not in held]
+            granted = grantable[:n]
+            if not granted:
+                return []
+            self._free = [r for r in self._free if r not in granted]
+            self._leases.setdefault(owner, []).extend(granted)
+        self._emit(
+            ScheduleEvent(
+                job_id=owner,
+                world=len(granted),
+                device_ranks=list(granted),
+                planner="lease",
+                reason=reason or "autoscale",
+            )
+        )
+        return granted
+
+    def lease_release(self, owner: str, ranks: Optional[List[int]] = None) -> None:
+        """Return leased chips to the free inventory — all of ``owner``'s
+        lease when ``ranks`` is None. Unknown ranks are ignored (release is
+        idempotent so a drained worker's chips cannot double-free)."""
+        with self._inv_lock:
+            held = self._leases.get(owner, [])
+            back = [r for r in (held if ranks is None else ranks) if r in held]
+            if not back:
+                return
+            self._leases[owner] = [r for r in held if r not in back]
+            if not self._leases[owner]:
+                self._leases.pop(owner, None)
+            self._free.extend(back)
+            self._free.sort()
+        self._emit(
+            ScheduleEvent(
+                job_id=owner,
+                world=0,
+                device_ranks=list(back),
+                planner="lease",
+                reason="release",
+            )
+        )
+
+    def leased(self, owner: str) -> List[int]:
+        return list(self._leases.get(owner, []))
 
     def _viable_worlds(self, job: JobManifest, cap: int) -> List[int]:
         if job.mesh_axes is None:
@@ -550,7 +617,8 @@ class FleetScheduler:
         )
         run = _JobRun(job, supervisor, ranks, job_run_dir, feed, escalator)
         granted = set(ranks)
-        self._free = [r for r in self._free if r not in granted]
+        with self._inv_lock:
+            self._free = [r for r in self._free if r not in granted]
         self._running[job.job_id] = run
         self._emit(
             ScheduleEvent(
@@ -639,8 +707,9 @@ class FleetScheduler:
             job = run.job
             wall = now - run.started_mono
             job.chip_seconds += wall * len(run.device_ranks)
-            self._free.extend(run.device_ranks)
-            self._free.sort()
+            with self._inv_lock:
+                self._free.extend(run.device_ranks)
+                self._free.sort()
             # a finished job releases any reservation held on ITS behalf
             self._reserved.pop(job_id, None)
             res = run.result

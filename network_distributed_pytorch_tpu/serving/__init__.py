@@ -4,13 +4,21 @@ The north star talks about "heavy traffic from millions of users"; this
 package is the piece that actually serves it. The decode primitives come
 from ``models.gpt`` (batched prefill, fixed-capacity KV cache, one-token
 decode steps); serving adds the SCHEDULING layer where real throughput
-lives (Orca iteration-level batching, OSDI '22; vLLM's KV management,
-SOSP '23 — slot-granular here, not paged):
+lives (Orca iteration-level batching, OSDI '22; vLLM's paged KV
+management, SOSP '23):
 
-- :mod:`serving.engine`   — the slot engine: a fixed set of batch slots
-  over one slot-batched KV cache, ONE compiled per-slot-position decode
-  step shared by requests at different depths, freed slots backfilled
-  from the queue after every single-token step.
+- :mod:`serving.engine`   — two engines behind one queue/step/run/evict
+  surface. ``SlotEngine``: a fixed set of batch slots over one dense
+  slot-batched KV cache, ONE compiled per-slot-position decode step
+  shared by requests at different depths, freed slots backfilled from
+  the queue after every single-token step. ``PagedEngine``: the same
+  scheduler over a fixed pool of KV BLOCKS with host-side block tables —
+  copy-on-write prefix sharing, optional speculative decoding, and
+  bitwise-identical tokens at a fraction of the dense cache's HBM.
+- :mod:`serving.blocks`   — the jax-free host side of paging: the
+  refcounted free-list block allocator (``BlockPool``, with the
+  ``check_owners`` leak invariant) and the prompt-hash prefix index
+  behind copy-on-write sharing (``PrefixIndex``).
 - :mod:`serving.request`  — the typed request lifecycle (queued →
   prefilling → decoding → finished/evicted/failed), timestamped per
   transition and emitted as one terminal ``observe.RequestEvent`` per

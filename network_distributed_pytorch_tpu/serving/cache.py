@@ -53,6 +53,62 @@ def write_slot(cache: List, row_cache: List, slot) -> List:
     return out
 
 
+def init_block_pool(config: GPTConfig, n_blocks: int, block_len: int):
+    """Per-layer PAGED K/V pool: zeros of (n_blocks, block_len, H, D).
+
+    The paged counterpart of :func:`init_slot_cache` — rows no longer pin a
+    dense ``max_len`` each; the host allocator (``serving.blocks``) maps
+    logical positions onto blocks and ``gpt_decode_step_paged`` gathers
+    through per-slot block tables. Block 0 is the reserved garbage block
+    (``serving.blocks.GARBAGE_BLOCK``): vacant/padding table entries point
+    there, so its contents are written freely and never read as valid."""
+    head_dim = config.dim // config.n_heads
+    shape = (n_blocks, block_len, config.n_heads, head_dim)
+    return [
+        {
+            "k": jnp.zeros(shape, config.dtype),
+            "v": jnp.zeros(shape, config.dtype),
+        }
+        for _ in range(config.n_layers)
+    ]
+
+
+def write_chain(pool: List, row_cache: List, chain) -> List:
+    """Scatter a freshly-prefilled batch-1 row cache (per layer
+    ``(1, T*L, H, D)`` from ``gpt_prefill``) into the block chain
+    ``chain`` (``(T,)`` int32, padded with the garbage block past the
+    request's reservation). ``chain`` may be traced — one compiled
+    admission program covers every placement."""
+    from ..ops.paged import scatter_chain
+
+    out = []
+    for layer, row in zip(pool, row_cache):
+        out.append(
+            {
+                "k": scatter_chain(layer["k"], chain, row["k"][0]),
+                "v": scatter_chain(layer["v"], chain, row["v"][0]),
+            }
+        )
+    return out
+
+
+def read_chain(pool: List, chain, n_tokens: Optional[int] = None) -> List:
+    """A chain's logical rows as a batch-1 cache (per layer
+    ``(1, len(chain)*L, H, D)``, truncated to ``n_tokens`` when given).
+    Debug/tests and the shared-prefix admission path."""
+    from ..ops.paged import pool_chain_view
+
+    chain = jnp.asarray(chain, jnp.int32)
+    out = []
+    for layer in pool:
+        k = pool_chain_view(layer["k"], chain)[None]
+        v = pool_chain_view(layer["v"], chain)[None]
+        if n_tokens is not None:
+            k, v = k[:, :n_tokens], v[:, :n_tokens]
+        out.append({"k": k, "v": v})
+    return out
+
+
 def read_slot(cache: List, slot: int) -> List:
     """Row ``slot`` of the slot cache as a batch-1 cache (debug/tests)."""
     return [

@@ -26,19 +26,34 @@ Greedy decoding only (temperature 0): serving SLO comparisons and the
 bit-identity acceptance test (engine tokens == sequential
 ``generate()`` tokens) need determinism. Sampling belongs to a
 per-request RNG lane, left for a future PR.
+
+Two engines share the scheduler above: :class:`SlotEngine` (dense — one
+``(S, max_len, …)`` KV row per slot) and :class:`PagedEngine` (block-pool
+KV with copy-on-write prefix sharing and optional draft-verify
+speculative decoding; bitwise-equal tokens, ≥2× the concurrency per KV
+byte — see the paged sections of DESIGN.md).
 """
 
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
 
-from ..models.gpt import GPTConfig, gpt_decode_step_slots, gpt_prefill
-from .cache import init_slot_cache, write_slot
+from ..models.gpt import (
+    GPTConfig,
+    gpt_decode_step_paged,
+    gpt_decode_step_slots,
+    gpt_prefill,
+    gpt_prefill_shared,
+)
+from .blocks import BlockPool, OutOfBlocks, PrefixIndex, blocks_needed
+from .cache import init_block_pool, init_slot_cache, read_chain, write_chain, write_slot
 from .request import Request
 
 
@@ -111,9 +126,12 @@ class SlotEngine:
         self.slots: List[Optional[_Slot]] = [None] * n_slots
         self.queue: List[Request] = []
         self._finished: List[Request] = []
-        # scheduler accounting (the continuous-vs-static claim in tests)
+        # scheduler accounting (the continuous-vs-static claim in tests);
+        # peak_active is the dense side of bench.py's kv_capacity_ratio —
+        # the most requests this engine ever held in flight at once
         self.decode_steps = 0
         self.prefills = 0
+        self.peak_active = 0
 
         def _decode(params, cache, tokens, pos):
             logits, cache = gpt_decode_step_slots(
@@ -214,6 +232,7 @@ class SlotEngine:
         when any work happened (prefill or decode), False when idle."""
         before = self.prefills
         self._backfill()
+        self.peak_active = max(self.peak_active, self.n_active)
         occupied = [s for s in range(self.n_slots) if self.slots[s] is not None]
         if not occupied:
             return self.prefills != before
@@ -309,8 +328,734 @@ class SlotEngine:
             "prefills": self.prefills,
             "active": self.n_active,
             "queued": self.queue_len,
+            "peak_active": self.peak_active,
             # device-memory attribution (observe.memory): total KV-cache
             # allocation and the active slots' share of it
             "kv_cache_bytes": self.cache_bytes,
             "kv_occupied_bytes": self.occupied_cache_bytes,
         }
+
+
+def spec_accept(
+    fed: Sequence[int],
+    outs: Sequence[int],
+    budget_left: int,
+    eos_token_id: Optional[int] = None,
+) -> List[int]:
+    """Bitwise-accept rule for one speculative verify round of one row.
+
+    ``fed[i]`` is the token the target was FED at step ``i`` of the round
+    (``fed[0]`` is the row's already-emitted pending token, ``fed[1:]`` the
+    draft's proposals); ``outs[i]`` is the target's greedy token after
+    feeding ``fed[i]``. The emitted tokens are exactly the prefix a
+    target-only decode would have produced: ``outs[i]`` is trustworthy iff
+    every earlier fed token matched the target's own output — the first
+    draft token that diverges (``fed[i+1] != outs[i]``) still yields the
+    CORRECTED token ``outs[i]``, then the round stops. A fully-matching
+    round emits all K tokens (K-1 drafts plus the bonus token from the last
+    verify step). Capped at ``budget_left`` and truncated after EOS.
+    """
+    emitted: List[int] = []
+    for i in range(len(fed)):
+        tok = int(outs[i])
+        emitted.append(tok)
+        if len(emitted) >= budget_left:
+            break
+        if eos_token_id is not None and tok == eos_token_id:
+            break
+        if i + 1 < len(fed) and int(fed[i + 1]) != tok:
+            break
+    return emitted
+
+
+@dataclass
+class _PagedSlot:
+    """Per-slot decode state for the paged engine: the dense fields plus
+    this request's block chain (the slot's one reference on each entry)
+    and the copy-on-write spare reserved at admission."""
+
+    request: Request
+    pending_token: int
+    pos: int
+    chain: List[int]
+    spare: List[int] = field(default_factory=list)
+
+
+class PagedEngine:
+    """:class:`SlotEngine`'s scheduler over a PAGED block-pool KV cache.
+
+    Same queue/step/run/evict surface and the same bits on the wire —
+    decode goes through ``gpt_decode_step_paged``, whose valid positions
+    carry identical values to the dense step — but KV HBM is a fixed pool
+    of ``n_blocks`` blocks of ``block_len`` tokens, allocated per request
+    at ``ceil((len(prompt) + max_new) / block_len)`` granularity instead
+    of a dense ``max_len`` row per slot. Block tables are host-side data
+    (one int32 ``(n_slots, max_len // block_len)`` array pushed per tick),
+    so admission/free/copy-on-write never recompile the ONE decode
+    program.
+
+    On top of the pool:
+
+    - **Prefix sharing** (``prefix_sharing=True``): a prompt-hash index
+      (``serving.blocks.PrefixIndex``) maps previously-prefilled prompts
+      and their block-aligned prefixes to live block chains. An exact
+      full-prompt hit admits with ZERO device work (blocks linked
+      refcounted, greedy first token replayed from the index); a
+      block-aligned prefix hit links the prefix chain and prefills only
+      the suffix (``gpt_prefill_shared``). A slot's first decode write
+      into a still-shared boundary block triggers a one-block
+      copy-on-write from the spare reserved at admission (so COW can
+      never deadlock the pool).
+    - **Speculative decoding** (``spec_k >= 2`` with draft params): a
+      small draft model over a dense slot cache proposes ``spec_k - 1``
+      greedy tokens per round; the target verifies all of them in ONE
+      batched multi-position dispatch and :func:`spec_accept` keeps
+      exactly the prefix a target-only decode would have emitted —
+      bitwise semantics, fewer target dispatches per token.
+    - **Leak accounting**: with ``check_leaks`` (defaults to
+      ``__debug__``) the engine re-proves
+      ``free + Σ distinct chain entries == usable blocks`` and exact
+      per-block refcounts after every tick, admission, and eviction —
+      ``evict_all`` releases each chain exactly once or fails loudly.
+
+    Out-of-blocks admission is BACKPRESSURE, not failure: the request
+    stays queued (FIFO order preserved) until eviction/finish frees
+    blocks, after LRU-evicting stale prefix-index entries first.
+    """
+
+    def __init__(
+        self,
+        config: GPTConfig,
+        params,
+        n_slots: int,
+        max_len: int,
+        block_len: int = 16,
+        n_blocks: Optional[int] = None,
+        prefix_sharing: bool = True,
+        draft_config: Optional[GPTConfig] = None,
+        draft_params: Any = None,
+        spec_k: int = 0,
+        telemetry: Any = None,
+        rank: Optional[int] = None,
+        label: str = "serving",
+        clock: Callable[[], float] = time.monotonic,
+        check_leaks: Optional[bool] = None,
+        emit_pool_every: int = 16,
+    ):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        if max_len > config.max_position_embeddings:
+            raise ValueError(
+                f"max_len {max_len} exceeds max_position_embeddings"
+                f" {config.max_position_embeddings}"
+            )
+        if max_len % block_len != 0:
+            raise ValueError(
+                f"max_len {max_len} must be a multiple of block_len {block_len}"
+            )
+        if spec_k and (spec_k < 2 or draft_params is None or draft_config is None):
+            raise ValueError(
+                "speculative decoding needs spec_k >= 2 plus draft_config"
+                " and draft_params"
+            )
+        self.config = config
+        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.block_len = block_len
+        self.max_blocks = max_len // block_len
+        # default pool: dense-equivalent KV bytes (+ the garbage block) —
+        # same HBM as SlotEngine(n_slots), ~2x the admissible requests
+        self.n_blocks = (
+            n_blocks if n_blocks is not None else n_slots * self.max_blocks + 1
+        )
+        self.prefix_sharing = prefix_sharing
+        self.telemetry = telemetry
+        self.rank = rank
+        self.label = label
+        self.clock = clock
+        self.check_leaks = bool(__debug__) if check_leaks is None else check_leaks
+        self.emit_pool_every = emit_pool_every
+
+        self.pool = init_block_pool(config, self.n_blocks, block_len)
+        self.allocator = BlockPool(self.n_blocks, block_len)
+        self.index = PrefixIndex(self.allocator) if prefix_sharing else None
+        self._tables = np.zeros((n_slots, self.max_blocks), np.int32)
+        self.slots: List[Optional[_PagedSlot]] = [None] * n_slots
+        self.queue: List[Request] = []
+        self._finished: List[Request] = []
+
+        # scheduler + sharing + speculation ledgers (tests count these)
+        self.decode_steps = 0
+        self.prefills = 0
+        self.prefill_tokens = 0
+        self.prefix_hits = 0
+        self.prefill_tokens_saved = 0
+        self.cow_copies = 0
+        self.admissions_deferred = 0
+        self.spec_rounds = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.peak_active = 0
+
+        def _decode(params, pool, tables, tokens, pos):
+            logits, pool = gpt_decode_step_paged(
+                config, params, pool, tables, tokens, pos
+            )
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), pool
+
+        # the paged analogue of SlotEngine._decode: one program for the
+        # engine's lifetime; the pool carry is donated (largest allocation,
+        # strictly threaded through step())
+        self._decode = jax.jit(_decode, donate_argnums=(1,))
+
+        def _admit_full(params, pool, prompt, chain):
+            last_logits, row_cache = gpt_prefill(config, params, prompt, max_len)
+            pool = write_chain(pool, row_cache, chain)
+            first = jnp.argmax(last_logits[0], axis=-1).astype(jnp.int32)
+            return first, pool
+
+        # one program per distinct prompt length (chain entries are traced)
+        self._admit_full = jax.jit(_admit_full, donate_argnums=(1,))
+
+        def _admit_shared(params, pool, suffix, prefix_chain, suffix_chain):
+            # prefix KV gathered INSIDE the program: the (block-aligned)
+            # prefix length is static from the chain shape
+            prefix_cache = read_chain(pool, prefix_chain)
+            last_logits, suffix_cache = gpt_prefill_shared(
+                config, params, suffix, prefix_cache
+            )
+            t_s = suffix.shape[1]
+            pad = suffix_chain.shape[0] * block_len - t_s
+            padded = [
+                {
+                    "k": jnp.pad(layer["k"], ((0, 0), (0, pad), (0, 0), (0, 0))),
+                    "v": jnp.pad(layer["v"], ((0, 0), (0, pad), (0, 0), (0, 0))),
+                }
+                for layer in suffix_cache
+            ]
+            pool = write_chain(pool, padded, suffix_chain)
+            first = jnp.argmax(last_logits[0], axis=-1).astype(jnp.int32)
+            return first, pool
+
+        # one program per (prefix blocks, suffix length) pair
+        self._admit_shared = jax.jit(_admit_shared, donate_argnums=(1,))
+
+        def _cow_copy(pool, src, dst):
+            from ..ops.paged import copy_block
+
+            return [
+                {
+                    "k": copy_block(layer["k"], src, dst),
+                    "v": copy_block(layer["v"], src, dst),
+                }
+                for layer in pool
+            ]
+
+        # src/dst are traced scalars: every COW event shares one program
+        self._cow_copy = jax.jit(_cow_copy, donate_argnums=(0,))
+
+        # --- speculative tier ------------------------------------------
+        self.spec_k = int(spec_k)
+        self.draft_config = draft_config
+        if self.spec_k:
+            self.draft_params = jax.tree_util.tree_map(jnp.asarray, draft_params)
+            self.draft_cache = init_slot_cache(draft_config, n_slots, max_len)
+            k_steps = self.spec_k
+
+            def _propose(dparams, dcache, start, pos):
+                # K greedy draft steps: step i feeds the previous token at
+                # pos+i (the last proposal is fed too, so its KV lands in
+                # the draft cache for the next round; its successor output
+                # is discarded)
+                def body(carry, i):
+                    dcache, tok = carry
+                    logits, dcache = gpt_decode_step_slots(
+                        draft_config, dparams, dcache, tok, pos + i
+                    )
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return (dcache, nxt), nxt
+
+                (dcache, _), outs = jax.lax.scan(
+                    body, (dcache, start), jnp.arange(k_steps)
+                )
+                # fed[:, 0] = pending, fed[:, 1:] = first K-1 proposals
+                fed = jnp.concatenate(
+                    [start[:, None], outs.T[:, : k_steps - 1]], axis=1
+                )
+                return fed, dcache
+
+            self._propose = jax.jit(_propose, donate_argnums=(1,))
+
+            def _verify(params, pool, tables, fed, pos):
+                # ONE batched dispatch verifying K positions per row: the
+                # scan body is gpt_decode_step_paged verbatim, so each
+                # step's bits match the engine's single-token program
+                def body(pool, i):
+                    logits, pool = gpt_decode_step_paged(
+                        config, params, pool, tables, fed[:, i], pos + i
+                    )
+                    return pool, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+                pool, outs = jax.lax.scan(body, pool, jnp.arange(k_steps))
+                return outs.T, pool  # (S, K)
+
+            self._verify = jax.jit(_verify, donate_argnums=(1,))
+
+            def _draft_admit(dparams, dcache, prompt, slot):
+                last_logits, row_cache = gpt_prefill(
+                    draft_config, dparams, prompt, max_len
+                )
+                dcache = write_slot(dcache, row_cache, slot)
+                return dcache
+
+            self._draft_admit = jax.jit(_draft_admit, donate_argnums=(1,))
+
+    # --- queue interface (same surface as SlotEngine) ---------------------
+
+    def submit(self, request: Request) -> None:
+        request.mark_enqueued(self.clock())
+        self.queue.append(request)
+
+    @property
+    def n_free(self) -> int:
+        return sum(1 for s in self.slots if s is None)
+
+    @property
+    def n_active(self) -> int:
+        return self.n_slots - self.n_free
+
+    @property
+    def queue_len(self) -> int:
+        return len(self.queue)
+
+    @property
+    def idle(self) -> bool:
+        return not self.queue and self.n_active == 0
+
+    def take_finished(self) -> List[Request]:
+        out, self._finished = self._finished, []
+        return out
+
+    # --- block accounting -------------------------------------------------
+
+    def _owner_chains(self) -> List[List[int]]:
+        chains: List[List[int]] = []
+        for slot in self.slots:
+            if slot is not None:
+                chains.append(slot.chain)
+                if slot.spare:
+                    chains.append(slot.spare)
+        if self.index is not None:
+            chains.extend(self.index.chains())
+        return chains
+
+    def _assert_no_leaks(self) -> None:
+        if self.check_leaks:
+            self.allocator.check_owners(self._owner_chains())
+
+    def _release_slot(self, slot_index: int) -> None:
+        """Free a slot's blocks EXACTLY once: one release per chain entry
+        (shared entries drop to the survivors' refcount; private entries
+        return to the free list) plus the unused COW spare."""
+        slot = self.slots[slot_index]
+        self.allocator.release(slot.chain)
+        if slot.spare:
+            self.allocator.release(slot.spare)
+        self._tables[slot_index, :] = 0
+        self.slots[slot_index] = None
+
+    def _padded_chain(self, chain: List[int]) -> jnp.ndarray:
+        padded = chain + [0] * (self.max_blocks - len(chain))
+        return jnp.asarray(padded, jnp.int32)
+
+    # --- admission --------------------------------------------------------
+
+    def _emit(self, request: Request) -> None:
+        if self.telemetry is not None:
+            self.telemetry.emit(request.event(label=self.label, rank=self.rank))
+
+    def _terminal(self, request: Request) -> None:
+        self._emit(request)
+        self._finished.append(request)
+
+    def _reserve(self, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` blocks, LRU-evicting prefix-index entries under
+        pressure; None (not an exception) when the pool genuinely cannot
+        cover it — the admission backpressure path."""
+        if self.allocator.n_free < n and self.index is not None:
+            self.index.evict_lru(n)
+        try:
+            return self.allocator.alloc(n)
+        except OutOfBlocks:
+            return None
+
+    def _admit_one(self, slot_index: int, request: Request) -> bool:
+        """Admit ``request`` into ``slot_index``; False = not enough free
+        blocks (request stays at the head of the queue)."""
+        prompt = request.prompt
+        t = len(prompt)
+        horizon = min(t + request.max_new_tokens, self.max_len)
+        need_total = blocks_needed(horizon, self.block_len)
+        # a shared (or to-be-shared) trailing prompt block means the first
+        # decode write will copy-on-write: reserve the spare NOW so COW can
+        # never dead-end against an empty pool mid-decode
+        spare_needed = (
+            1 if (self.prefix_sharing and t % self.block_len != 0) else 0
+        )
+
+        hit = self.index.lookup(prompt) if self.index is not None else None
+        exact = (
+            hit is not None
+            and hit["n_tokens"] == t
+            and hit["first_token"] is not None
+        )
+        prefix_blocks: List[int] = []
+        p_len = 0
+        if hit is not None and not exact:
+            # block-aligned usable prefix; a whole-prompt-covering match
+            # without a replayable first token degrades to its last FULL
+            # block boundary (the suffix prefill needs >= 1 query token)
+            p_len = min(hit["n_tokens"], t - 1) // self.block_len * self.block_len
+            prefix_blocks = hit["blocks"][: p_len // self.block_len]
+
+        if exact:
+            shared = hit["blocks"]
+            grant = self._reserve(need_total - len(shared) + spare_needed)
+            if grant is None:
+                return False
+            self.allocator.link(shared)
+            spare = grant[:spare_needed]
+            chain = shared + grant[spare_needed:]
+            now = self.clock()
+            request.mark_prefilling(now)
+            first = int(hit["first_token"])
+            self.prefix_hits += 1
+            self.prefill_tokens_saved += t
+        elif prefix_blocks:
+            grant = self._reserve(need_total - len(prefix_blocks))
+            if grant is None:
+                return False
+            self.allocator.link(prefix_blocks)
+            spare: List[int] = []  # boundary block is private suffix
+            chain = prefix_blocks + grant
+            request.mark_prefilling(self.clock())
+            suffix = jnp.asarray([prompt[p_len:]], jnp.int32)
+            first_dev, self.pool = self._admit_shared(
+                self.params,
+                self.pool,
+                suffix,
+                jnp.asarray(prefix_blocks, jnp.int32),
+                jnp.asarray(grant, jnp.int32),
+            )
+            first = int(first_dev)
+            self.prefills += 1
+            self.prefill_tokens += t - p_len
+            self.prefix_hits += 1
+            self.prefill_tokens_saved += p_len
+        else:
+            grant = self._reserve(need_total + spare_needed)
+            if grant is None:
+                return False
+            spare = grant[:spare_needed]
+            chain = grant[spare_needed:]
+            request.mark_prefilling(self.clock())
+            first_dev, self.pool = self._admit_full(
+                self.params,
+                self.pool,
+                jnp.asarray([prompt], jnp.int32),
+                self._padded_chain(chain),
+            )
+            first = int(first_dev)
+            self.prefills += 1
+            self.prefill_tokens += t
+            if self.index is not None:
+                self.index.register(prompt, chain, first_token=first)
+
+        if self.spec_k:
+            # the draft tier keeps its own dense cache; it always prefills
+            # (cheap by construction) even when the target's prefill was
+            # shared away
+            self.draft_cache = self._draft_admit(
+                self.draft_params,
+                self.draft_cache,
+                jnp.asarray([prompt], jnp.int32),
+                slot_index,
+            )
+
+        now = self.clock()
+        request.mark_decoding(now)  # first token exists as of admission end
+        request.add_token(first)
+        if request.done:
+            request.finish(self.clock())
+            self._terminal(request)
+            # blocks were never table-installed; release the reservation
+            self.allocator.release(chain)
+            if spare:
+                self.allocator.release(spare)
+            return True
+        self.slots[slot_index] = _PagedSlot(
+            request=request,
+            pending_token=first,
+            pos=t,
+            chain=chain,
+            spare=spare,
+        )
+        self._tables[slot_index, :] = 0
+        self._tables[slot_index, : len(chain)] = chain
+        return True
+
+    def _backfill(self) -> None:
+        """FIFO backfill with block backpressure: the oldest queued request
+        admits first or nobody does — a failed reservation stops the scan
+        so later (smaller) requests cannot starve it."""
+        for s in range(self.n_slots):
+            if not self.queue:
+                break
+            if self.slots[s] is None:
+                if not self._admit_one(s, self.queue[0]):
+                    self.admissions_deferred += 1
+                    break
+                self.queue.pop(0)
+        self._assert_no_leaks()
+
+    # --- copy-on-write ----------------------------------------------------
+
+    def _cow_if_shared(self, slot_index: int, pos_lo: int, pos_hi: int) -> None:
+        """Before writing positions ``pos_lo..pos_hi``, copy any touched
+        chain block that is still shared (refcount > 1) into this slot's
+        reserved spare — the one-block copy-on-write."""
+        slot = self.slots[slot_index]
+        lo = pos_lo // self.block_len
+        hi = min(pos_hi // self.block_len, len(slot.chain) - 1)
+        for j in range(lo, hi + 1):
+            src = slot.chain[j]
+            if self.allocator.refcount(src) <= 1:
+                continue
+            if slot.spare:
+                dst = slot.spare.pop()
+            else:
+                grant = self._reserve(1)
+                if grant is None:
+                    raise OutOfBlocks(
+                        "copy-on-write with no spare and an empty pool —"
+                        " admission under-reserved"
+                    )
+                dst = grant[0]
+            self.pool = self._cow_copy(
+                self.pool, jnp.int32(src), jnp.int32(dst)
+            )
+            self.allocator.release([src])
+            slot.chain[j] = dst
+            self._tables[slot_index, j] = dst
+            self.cow_copies += 1
+
+    # --- decode -----------------------------------------------------------
+
+    def _finish_or_advance(self, s: int, emitted: List[int], now: float) -> None:
+        slot = self.slots[s]
+        for tok in emitted:
+            slot.request.add_token(tok)
+        if slot.request.done:
+            slot.request.finish(now)
+            self._terminal(slot.request)
+            self._release_slot(s)
+        else:
+            slot.pending_token = emitted[-1]
+            slot.pos += len(emitted)
+
+    def step(self) -> bool:
+        """One engine iteration: backfill freed slots, then one decode tick
+        — a single-token batched step, or a draft+verify speculative round
+        emitting up to ``spec_k`` tokens per row."""
+        before_prefills = self.prefills
+        self._backfill()
+        self.peak_active = max(self.peak_active, self.n_active)
+        occupied = [s for s in range(self.n_slots) if self.slots[s] is not None]
+        if not occupied:
+            return self.prefills != before_prefills
+        span = self.spec_k if self.spec_k else 1
+        for s in occupied:
+            self._cow_if_shared(
+                s, self.slots[s].pos, self.slots[s].pos + span - 1
+            )
+        tokens = jnp.asarray(
+            [
+                self.slots[s].pending_token if self.slots[s] is not None else 0
+                for s in range(self.n_slots)
+            ],
+            jnp.int32,
+        )
+        pos = jnp.asarray(
+            [
+                self.slots[s].pos if self.slots[s] is not None else 0
+                for s in range(self.n_slots)
+            ],
+            jnp.int32,
+        )
+        tables = jnp.asarray(self._tables)
+        now_fn = self.clock
+        if self.spec_k:
+            fed, self.draft_cache = self._propose(
+                self.draft_params, self.draft_cache, tokens, pos
+            )
+            outs, self.pool = self._verify(
+                self.params, self.pool, tables, fed, pos
+            )
+            self.decode_steps += 1
+            self.spec_rounds += 1
+            fed = jax.device_get(fed)
+            outs = jax.device_get(outs)
+            now = now_fn()
+            for s in occupied:
+                slot = self.slots[s]
+                budget = slot.request.max_new_tokens - len(slot.request.tokens)
+                emitted = spec_accept(
+                    fed[s], outs[s], budget, slot.request.eos_token_id
+                )
+                self.spec_proposed += self.spec_k - 1
+                self.spec_accepted += max(0, len(emitted) - 1)
+                self._finish_or_advance(s, emitted, now)
+        else:
+            nxt, self.pool = self._decode(
+                self.params, self.pool, tables, tokens, pos
+            )
+            self.decode_steps += 1
+            nxt = jax.device_get(nxt)
+            now = now_fn()
+            for s in occupied:
+                self._finish_or_advance(s, [int(nxt[s])], now)
+        self._assert_no_leaks()
+        if self.idle and self.emit_pool_every:
+            # drain boundary: a workload shorter than emit_pool_every steps
+            # would otherwise leave the run log with zero pool snapshots
+            self._emit_pool()
+        else:
+            self._maybe_emit_pool()
+        return True
+
+    def run(self, max_steps: Optional[int] = None) -> List[Request]:
+        steps = 0
+        while not self.idle:
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"engine did not drain within {max_steps} steps"
+                    f" ({self.n_active} active, {self.queue_len} queued)"
+                )
+            self.step()
+            steps += 1
+        return self.take_finished()
+
+    def evict_all(self, reason: str = "shutdown") -> List[Request]:
+        """Evict every queued and in-flight request, returning each
+        in-flight request's blocks to the free list EXACTLY once (the
+        refcount invariant is re-proven afterwards) and dropping the
+        prefix index's references so the pool drains to fully free."""
+        evicted: List[Request] = []
+        now = self.clock()
+        for request in self.queue:
+            request.evict(now, reason=reason)
+            self._emit(request)
+            evicted.append(request)
+        self.queue = []
+        for s in range(self.n_slots):
+            if self.slots[s] is None:
+                continue
+            request = self.slots[s].request
+            request.evict(now, reason=reason)
+            self._emit(request)
+            evicted.append(request)
+            self._release_slot(s)
+        if self.index is not None:
+            self.index.clear()
+        self._assert_no_leaks()
+        self._emit_pool()
+        return evicted
+
+    # --- memory + telemetry -----------------------------------------------
+
+    @property
+    def pool_bytes(self) -> int:
+        """Device bytes of the whole KV block pool (fixed for the engine's
+        lifetime — the paged analogue of ``SlotEngine.cache_bytes``)."""
+        from ..observe.memory import tree_bytes
+
+        return tree_bytes(self.pool)
+
+    @property
+    def cache_bytes(self) -> int:
+        # SlotEngine-compatible alias (spool loop + memory attribution)
+        return self.pool_bytes
+
+    @property
+    def occupied_cache_bytes(self) -> int:
+        """Bytes of blocks actually referenced — what the admitted requests
+        pin, vs the dense engine's n_slots * max_len regardless of load."""
+        used = self.allocator.n_usable - self.allocator.n_free
+        return (self.pool_bytes * used) // self.n_blocks
+
+    def kv_stats(self) -> Dict:
+        shared = sum(
+            1
+            for b in range(1, self.n_blocks)
+            if self.allocator.refcount(b) > 1
+        )
+        used = self.allocator.n_usable - self.allocator.n_free
+        return {
+            "n_blocks": self.n_blocks,
+            "block_len": self.block_len,
+            "blocks_free": self.allocator.n_free,
+            "blocks_used": used,
+            "blocks_shared": shared,
+            "pool_bytes": self.pool_bytes,
+            "prefix_hits_total": self.prefix_hits,
+            "prefill_tokens_saved_total": self.prefill_tokens_saved,
+            "cow_copies_total": self.cow_copies,
+            "admissions_deferred_total": self.admissions_deferred,
+        }
+
+    def _emit_pool(self) -> None:
+        if self.telemetry is None:
+            return
+        from ..observe.events import KVPoolEvent
+
+        self.telemetry.emit(
+            KVPoolEvent(label=self.label, rank=self.rank, **self.kv_stats())
+        )
+
+    def _maybe_emit_pool(self) -> None:
+        if (
+            self.telemetry is not None
+            and self.emit_pool_every
+            and self.decode_steps % self.emit_pool_every == 0
+        ):
+            self._emit_pool()
+
+    def stats(self) -> Dict:
+        out = {
+            "n_slots": self.n_slots,
+            "decode_steps": self.decode_steps,
+            "prefills": self.prefills,
+            "prefill_tokens": self.prefill_tokens,
+            "active": self.n_active,
+            "queued": self.queue_len,
+            "peak_active": self.peak_active,
+            "kv_cache_bytes": self.pool_bytes,
+            "kv_occupied_bytes": self.occupied_cache_bytes,
+        }
+        out.update(self.kv_stats())
+        if self.spec_k:
+            out.update(
+                {
+                    "spec_k": self.spec_k,
+                    "spec_rounds": self.spec_rounds,
+                    "spec_proposed": self.spec_proposed,
+                    "spec_accepted": self.spec_accepted,
+                    "spec_accept_rate": (
+                        self.spec_accepted / self.spec_proposed
+                        if self.spec_proposed
+                        else 0.0
+                    ),
+                }
+            )
+        return out

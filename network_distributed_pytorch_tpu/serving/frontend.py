@@ -212,10 +212,17 @@ class FileSpool:
     def ensure(self, requests: Iterable[Request]) -> int:
         """Idempotently enqueue a workload: requests already queued,
         claimed, or done are skipped (a restarted rank re-running the
-        deterministic workload generator enqueues nothing twice)."""
-        return self.ensure_docs(
-            {r.request_id: r.to_wire() for r in requests}
-        )
+        deterministic workload generator enqueues nothing twice). Stamps
+        the producer wall clock so the eventual claimer charges the
+        spool-sitting time to the request's queue phase."""
+        now = time.time()
+        docs = {}
+        for r in requests:
+            doc = r.to_wire()
+            if doc.get("spooled_unix") is None:
+                doc["spooled_unix"] = now
+            docs[r.request_id] = doc
+        return self.ensure_docs(docs)
 
     def manifest_ids(self) -> List[str]:
         try:
@@ -394,6 +401,17 @@ class FileSpool:
 
     # --- inspection -------------------------------------------------------
 
+    def queue_depth(self) -> int:
+        """Entries sitting UNCLAIMED in ``queue/`` right now — the
+        backlog gauge the serving autoscaler scales on (claimed-in-flight
+        work is a worker's problem; queued work is a capacity problem)."""
+        try:
+            return sum(
+                1 for n in os.listdir(self.queue_dir) if n.endswith(".json")
+            )
+        except OSError:
+            return 0
+
     def done_ids(self) -> List[str]:
         try:
             return sorted(
@@ -454,6 +472,12 @@ def serve_from_spool(
             if req is None:
                 break
             engine.submit(req)
+            if req.spooled_unix is not None and req.enqueued_t is not None:
+                # backdate the queue phase to the producer's enqueue: the
+                # spool-sitting wait is the latency an overloaded pool
+                # inflates, and hiding it would blind the SLO burn gauge
+                # the autoscaler scales on
+                req.enqueued_t -= max(0.0, time.time() - req.spooled_unix)
         if engine.idle:
             if spool.drained():
                 break

@@ -58,6 +58,11 @@ class Request:
     max_new_tokens: int
     eos_token_id: Optional[int] = None
     arrival_s: float = 0.0  # workload-relative arrival offset (frontend)
+    # wall clock when the PRODUCER spooled the request (None outside the
+    # file-spool path): lets a claiming worker charge the spool-sitting
+    # time to the queue phase, so end-to-end latency starts at enqueue —
+    # the quantity an overloaded pool inflates and an autoscaler needs
+    spooled_unix: Optional[float] = None
 
     state: str = QUEUED
     tokens: List[int] = field(default_factory=list)
@@ -139,6 +144,7 @@ class Request:
             max_new_tokens=self.max_new_tokens,
             eos_token_id=self.eos_token_id,
             arrival_s=self.arrival_s,
+            spooled_unix=self.spooled_unix,
             requeues=self.requeues + 1,
         )
 
@@ -199,6 +205,7 @@ class Request:
             "max_new_tokens": self.max_new_tokens,
             "eos_token_id": self.eos_token_id,
             "arrival_s": self.arrival_s,
+            "spooled_unix": self.spooled_unix,
             "requeues": self.requeues,
         }
 
@@ -213,6 +220,10 @@ class Request:
                 else int(doc["eos_token_id"])
             ),
             arrival_s=float(doc.get("arrival_s", 0.0)),
+            spooled_unix=(
+                None if doc.get("spooled_unix") is None
+                else float(doc["spooled_unix"])
+            ),
             requeues=int(doc.get("requeues", 0)),
         )
 

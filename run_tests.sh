@@ -73,6 +73,12 @@ set -e
 # fire before a chaos oom, the rank's post-mortem must name the top
 # buffer class in artifacts/oom_report.json, and a doubled-footprint
 # rerun must trip the hbm_peak_bytes gate.
+# The twelfth phase is the serving storm game day: a 10x Poisson burst
+# against one paged toy worker must push the live p99 past the SLO, the
+# telemetry-driven autoscaler must scale the pool up (typed autoscale
+# events, chips leased from the fleet scheduler), the post-scale trickle
+# must land back inside the SLO, every request must finish (zero lost),
+# and the drained pool must scale back down with every lease returned.
 # Advisory because shared CI boxes have
 # noisy step times; run gate.py without --advisory on dedicated perf
 # hardware to make it blocking.

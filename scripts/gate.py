@@ -48,6 +48,12 @@ Metrics compared (only those present in BOTH report and baseline):
   measured peak when ``memory_stats`` exists, the compile-time predicted
   peak otherwise; a fatter footprint is a regression even when throughput
   holds)
+- ``serving_tokens_per_s_per_chip`` higher is better (bench serving
+  phase — the paged engine's generated-token throughput per chip)
+- ``kv_capacity_ratio``      higher is better (bench serving phase —
+  peak concurrently-admitted requests, paged over dense, at equal KV
+  HBM; also gated against the ABSOLUTE ``kv_capacity_ratio_target``
+  floor bench.py records — 2x, the PR 19 guarantee class)
 
 A metric the current report carries but a stale baseline does not gets a
 clearly-labeled ``missing_baseline`` ADVISORY verdict (never a
@@ -149,12 +155,23 @@ METRICS: Dict[str, str] = {
     # more missed deadlines, or more chip-seconds burned by quarantined
     # crash-loopers all push it down
     "fleet_goodput": "higher",
+    # paged-serving arm (bench.py _phase_serving): generated-token
+    # throughput of the block-pool engine, and its concurrency win over
+    # the dense slot cache at equal KV HBM (also held to an absolute
+    # >= 2x floor via kv_capacity_ratio_target)
+    "serving_tokens_per_s_per_chip": "higher",
+    "kv_capacity_ratio": "higher",
 }
 
 # the calibration bound DESIGN.md states for cost-model predictions: a
 # prediction whose realized counterpart disagrees by more than this is a
 # gate regression even with no recorded baseline to ratchet against
 DEFAULT_COSTMODEL_ERROR_TARGET = 0.25
+
+# the concurrency floor DESIGN.md states for the paged KV cache: at equal
+# KV HBM the block pool must admit at least twice the concurrent requests
+# a dense slot cache holds (bench.py KV_CAPACITY_RATIO_TARGET)
+DEFAULT_KV_CAPACITY_RATIO_TARGET = 2.0
 
 BASELINE_NAME = "GATE_BASELINE.json"
 
@@ -268,6 +285,15 @@ def extract_metrics(doc: Dict) -> Dict[str, float]:
     v = doc.get("fleet_goodput")
     if isinstance(v, (int, float)) and v == v and v > 0:
         out.setdefault("fleet_goodput", float(v))
+    # paged-serving metrics: flat in bench baselines; a run report may
+    # carry them nested under a "serving" section (report.py's serving
+    # memory table rides elsewhere — these are the gateable scalars)
+    serving = doc.get("serving")
+    for src in (serving if isinstance(serving, dict) else {}, doc):
+        for key in ("serving_tokens_per_s_per_chip", "kv_capacity_ratio"):
+            v = src.get(key)
+            if isinstance(v, (int, float)) and v == v and v > 0:
+                out.setdefault(key, float(v))
     return out
 
 
@@ -505,6 +531,37 @@ def costmodel_target_verdict(
     ]
 
 
+def kv_capacity_target_verdict(
+    current: Dict[str, float], report: Dict, baseline_doc: Dict
+) -> List[Dict]:
+    """Absolute-floor verdict for the paged KV cache's concurrency win at
+    equal HBM, mirroring :func:`mfu_target_verdict`. Like the cost model's
+    bound, the target has a default (``DEFAULT_KV_CAPACITY_RATIO_TARGET``):
+    the >= 2x admission win over a dense slot cache is the paged engine's
+    stated guarantee class (DESIGN.md), so a pool that stops out-admitting
+    dense fails the gate even before a baseline records the ratio."""
+    ratio = current.get("kv_capacity_ratio")
+    if ratio is None:
+        return []
+    target = DEFAULT_KV_CAPACITY_RATIO_TARGET
+    for doc in (baseline_doc, report):
+        v = doc.get("kv_capacity_ratio_target")
+        if isinstance(v, (int, float)) and v == v and v > 0:
+            target = float(v)
+            break
+    return [
+        {
+            "metric": "kv_capacity_ratio_vs_target",
+            "direction": "higher",
+            "current": ratio,
+            "baseline": target,
+            "limit": target,
+            "ratio": ratio / target if target else 0.0,
+            "regressed": ratio < target,
+        }
+    ]
+
+
 def _platform_of(doc: Dict) -> Optional[str]:
     """Best-effort device provenance of a report/baseline: the bench
     attestation ``platform`` (or a hand-recorded ``device``) wins; a run
@@ -629,6 +686,7 @@ def main(argv=None) -> int:
     verdicts.extend(mfu_target_verdict(current, report, baseline_doc))
     verdicts.extend(data_load_share_verdict(current, report, baseline_doc))
     verdicts.extend(costmodel_target_verdict(current, report, baseline_doc))
+    verdicts.extend(kv_capacity_target_verdict(current, report, baseline_doc))
     verdicts.extend(
         device_mismatch_verdict(report, baseline_doc, args.strict_device)
     )

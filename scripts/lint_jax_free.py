@@ -44,6 +44,7 @@ CONTRACT = [
     ("resilience/scheduler.py", f"{PACKAGE}.resilience.scheduler"),
     ("resilience/supervisor.py", f"{PACKAGE}.resilience.supervisor"),
     ("serving/frontend.py", f"{PACKAGE}.serving.frontend"),
+    ("serving/blocks.py", f"{PACKAGE}.serving.blocks"),
     ("observe/live.py", f"{PACKAGE}.observe.live"),
     ("observe/health.py", f"{PACKAGE}.observe.health"),
 ]

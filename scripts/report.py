@@ -386,6 +386,111 @@ def render_request_section(slo: Dict) -> List[str]:
     return lines
 
 
+def kv_pool_summary_from_events(events: List[Dict]) -> Optional[Dict]:
+    """Paged-KV memory aggregate over ``kv_pool`` events
+    (``observe.KVPoolEvent``, emitted by ``serving.engine.PagedEngine``
+    every few ticks and at eviction). Per engine (rank, label): the LAST
+    snapshot (counters are monotonic, occupancy is current) plus the
+    high-water block usage across the run; totals for the table the gate
+    and a human both read. None when the run never served paged."""
+    pools = [e for e in events if e.get("event") == "kv_pool"]
+    if not pools:
+        return None
+    by_engine: Dict[Tuple, Dict] = {}
+    for e in pools:
+        key = (e.get("rank"), str(e.get("label", "serving")))
+        slot = by_engine.setdefault(key, {"min_free": None, "last": None})
+        free = e.get("blocks_free")
+        if isinstance(free, (int, float)) and (
+            slot["min_free"] is None or free < slot["min_free"]
+        ):
+            slot["min_free"] = int(free)
+        slot["last"] = e
+    engines = []
+    for (rank, label), slot in sorted(
+        by_engine.items(), key=lambda kv: (kv[0][0] is None, kv[0])
+    ):
+        last = slot["last"]
+        n_blocks = int(last.get("n_blocks", 0) or 0)
+        used = int(last.get("blocks_used", 0) or 0)
+        shared = int(last.get("blocks_shared", 0) or 0)
+        engines.append(
+            {
+                "rank": rank,
+                "label": label,
+                "pool_bytes": int(last.get("pool_bytes", 0) or 0),
+                "n_blocks": n_blocks,
+                "block_len": int(last.get("block_len", 0) or 0),
+                "blocks_free": int(last.get("blocks_free", 0) or 0),
+                "blocks_used": used,
+                "blocks_shared": shared,
+                # peak occupancy over the run, from the min free observed
+                "peak_blocks_used": (
+                    n_blocks - 1 - slot["min_free"]
+                    if slot["min_free"] is not None and n_blocks
+                    else None
+                ),
+                "prefix_hits_total": int(last.get("prefix_hits_total", 0) or 0),
+                "prefill_tokens_saved_total": int(
+                    last.get("prefill_tokens_saved_total", 0) or 0
+                ),
+                "cow_copies_total": int(last.get("cow_copies_total", 0) or 0),
+                "admissions_deferred_total": int(
+                    last.get("admissions_deferred_total", 0) or 0
+                ),
+            }
+        )
+    used = sum(e["blocks_used"] for e in engines)
+    shared = sum(e["blocks_shared"] for e in engines)
+    return {
+        "n_engines": len(engines),
+        "engines": engines,
+        "pool_bytes_total": sum(e["pool_bytes"] for e in engines),
+        "blocks_free_total": sum(e["blocks_free"] for e in engines),
+        "prefix_hits_total": sum(e["prefix_hits_total"] for e in engines),
+        "prefill_tokens_saved_total": sum(
+            e["prefill_tokens_saved_total"] for e in engines
+        ),
+        "cow_copies_total": sum(e["cow_copies_total"] for e in engines),
+        "admissions_deferred_total": sum(
+            e["admissions_deferred_total"] for e in engines
+        ),
+        # fraction of currently-referenced blocks that more than one chain
+        # owns — the live footprint prefix sharing is deduplicating
+        "prefix_shared_share": (shared / used) if used else 0.0,
+    }
+
+
+def render_kv_pool_section(kv: Dict) -> List[str]:
+    lines = ["", "serving KV memory (paged block pool)",
+             "------------------------------------"]
+    lines.append(
+        f"  {kv['n_engines']} engine(s): pool {_fmt_bytes(kv['pool_bytes_total'])}"
+        f" total, {kv['blocks_free_total']} block(s) free,"
+        f" {100.0 * kv['prefix_shared_share']:.1f}% of used blocks"
+        " prefix-shared"
+    )
+    lines.append(
+        f"  prefix hits {kv['prefix_hits_total']}"
+        f" ({kv['prefill_tokens_saved_total']} prefill token(s) saved),"
+        f" cow copies {kv['cow_copies_total']},"
+        f" admissions deferred {kv['admissions_deferred_total']}"
+    )
+    for e in kv["engines"]:
+        rank = "?" if e["rank"] is None else e["rank"]
+        peak = (
+            f"peak {e['peak_blocks_used']}" if e["peak_blocks_used"] is not None
+            else "peak n/a"
+        )
+        lines.append(
+            f"    rank {rank} {e['label']:<12} {_fmt_bytes(e['pool_bytes'])}"
+            f" = {e['n_blocks']} x {e['block_len']}-token blocks,"
+            f" used {e['blocks_used']} ({peak}),"
+            f" shared {e['blocks_shared']}"
+        )
+    return lines
+
+
 def fleet_summary_from_events(events: List[Dict]) -> Optional[Dict]:
     """Fleet control-plane aggregate over the scheduler's typed events
     (``job`` lifecycle, ``preempt``, ``schedule``, ``job_failed``): per-job
@@ -846,6 +951,10 @@ def render_report(events: List[Dict], name: str = "", skipped_lines: int = 0) ->
     slo = slo_summary_from_events(events)
     if slo:
         lines.extend(render_request_section(slo))
+
+    kv = kv_pool_summary_from_events(events)
+    if kv:
+        lines.extend(render_kv_pool_section(kv))
 
     fleet = fleet_summary_from_events(events)
     if fleet:
@@ -1914,6 +2023,10 @@ def run_report(
         # per-request serving SLOs (None when the run served nothing);
         # the gate's serving scalar is slo.p99_decode_ms_per_token
         "slo": slo_summary_from_events(merged.events),
+        # paged-KV block-pool memory (None when the run never served
+        # paged): pool bytes, blocks free, prefix-shared share, COW/defer
+        # counters — the serving entry in the memory observatory
+        "kv_pool": kv_pool_summary_from_events(merged.events),
         # fleet control-plane aggregate (None when the run scheduled no
         # jobs); the gate's fleet scalar is fleet.goodput (higher = better)
         "fleet": fleet_summary_from_events(merged.events),

@@ -1852,6 +1852,219 @@ def main(argv=None) -> int:
         f" {parts.get('n_rejoins')} rejoin(s), state matches the oracle)"
         f" report -> {geo_part_json}\n"
     )
+
+    # --- phase 12: the serving storm game day ----------------------------
+    # An elastic paged-serving pool under a 10x overload burst: one toy
+    # worker (real FileSpool lifecycle, real BlockPool admission gating)
+    # absorbs ~7 req/s; the storm offers ~70 for a burst, then settles to
+    # a sustainable trickle. The ServingAutoscaler must read the live
+    # plane's SLO burn (and the spool backlog), lease chips from a real
+    # FleetScheduler inventory, and spawn workers MID-STORM — all as
+    # typed events (AutoscaleEvent up, ScheduleEvent planner="lease") —
+    # then the post-scale trickle must land back inside the SLO and the
+    # drained workers must wind the pool down (AutoscaleEvent down,
+    # leases released). Zero manifested requests may be lost.
+    from network_distributed_pytorch_tpu.resilience.supervisor import (
+        AutoscalerConfig,
+        ServingAutoscaler,
+    )
+    from network_distributed_pytorch_tpu.serving.frontend import (
+        MANIFEST,
+        _atomic_write,
+    )
+
+    storm_dir = run_dir + "_storm"
+    shutil.rmtree(storm_dir, ignore_errors=True)
+    os.makedirs(storm_dir, exist_ok=True)
+    storm_spool_dir = os.path.join(storm_dir, "spool")
+    storm_slo_s = 0.9
+    burst = poisson_workload(WorkloadConfig(
+        n_requests=48, rate_rps=70.0, max_new_tokens=(6, 12), seed=112,
+    ))
+    trickle = poisson_workload(WorkloadConfig(
+        n_requests=16, rate_rps=3.0, max_new_tokens=(6, 12), seed=113,
+    ))
+    for r in trickle:
+        # renumber past the burst and push arrivals beyond the expected
+        # scale-up point: these are the recovery oracle's requests
+        r.request_id = "tail-" + r.request_id
+        r.arrival_s += 3.0
+    storm_workload = burst + trickle
+    storm_spool = FileSpool(storm_spool_dir)
+    # manifest the WHOLE storm up front (the drain oracle workers and the
+    # autoscaler poll), but enqueue each request only at its Poisson
+    # arrival time — an open-loop offered load, not a pre-filled batch
+    _atomic_write(
+        os.path.join(storm_spool.root, MANIFEST),
+        {"request_ids": sorted(r.request_id for r in storm_workload)},
+    )
+
+    def _storm_feed():
+        t0 = time.monotonic()
+        for r in sorted(storm_workload, key=lambda q: q.arrival_s):
+            dt = r.arrival_s - (time.monotonic() - t0)
+            if dt > 0:
+                time.sleep(dt)
+            storm_spool.ensure([r])
+
+    def storm_argv(worker_id, device_ranks):
+        return [
+            sys.executable, serve_worker,
+            "--rank", str(worker_id),
+            "--world", "3",
+            "--spool-dir", storm_spool_dir,
+            "--result-dir", os.path.join(storm_dir, "results"),
+            "--slots", "2",
+            "--step-seconds", "0.03",
+            "--paged", "--block-len", "4", "--pool-blocks", "12",
+            "--max-wall-s", "60",
+        ]
+
+    storm_telemetry = telemetry_for_run(
+        event_log=os.path.join(storm_dir, SUPERVISOR_LOG), stdout=False
+    )
+    storm_sched = FleetScheduler(
+        JobSpool(os.path.join(storm_dir, "jobs")),
+        config=FleetConfig(n_devices=4),
+        telemetry=storm_telemetry,
+    )
+    feeder = threading.Thread(target=_storm_feed, daemon=True)
+    feeder.start()
+    storm_summary = ServingAutoscaler(
+        argv_for_worker=storm_argv,
+        spool=storm_spool,
+        run_dir=storm_dir,
+        scheduler=storm_sched,
+        config=AutoscalerConfig(
+            min_workers=1, max_workers=3, chips_per_worker=1,
+            poll_s=0.05, queue_high=24, queue_sustain=4,
+            cooldown_s=0.8, burn_sustain=1, term_grace_s=2.0,
+            max_wall_s=60.0,
+            detector_config=DetectorConfig(
+                slo_target_s=storm_slo_s, slo_sustain=1, cooldown=1
+            ),
+            owner="storm-pool",
+        ),
+        telemetry=storm_telemetry,
+        log_dir=os.path.join(storm_dir, "logs"),
+    ).run()
+    feeder.join(timeout=30)
+    storm_telemetry.close()
+
+    problems = []
+    if not storm_summary["drained"]:
+        problems.append(f"storm pool never drained: {storm_summary}")
+    if storm_summary["workers_peak"] < 2:
+        problems.append(
+            f"pool never grew past one worker: {storm_summary}"
+        )
+    lost = (
+        set(storm_spool.manifest_ids()) - set(storm_spool.done_ids())
+    )
+    if lost:
+        problems.append(
+            f"{len(lost)} storm request(s) lost: {sorted(lost)[:4]}..."
+        )
+
+    # the typed event chain: burn -> scale-up, lease grant, drain -> down
+    ups, downs, grants, req_events = [], [], [], []
+    for name in sorted(os.listdir(storm_dir)):
+        if not name.endswith(".jsonl"):
+            continue
+        with open(os.path.join(storm_dir, name)) as f:
+            for line in f:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                kind = ev.get("event")
+                if kind == "autoscale" and ev.get("direction") == "up":
+                    ups.append(ev)
+                elif kind == "autoscale" and ev.get("direction") == "down":
+                    downs.append(ev)
+                elif (
+                    kind == "schedule"
+                    and ev.get("planner") == "lease"
+                    and (ev.get("world") or 0) >= 1
+                ):
+                    grants.append(ev)
+                elif kind == "request" and ev.get("state") == "finished":
+                    req_events.append(ev)
+    if not any(u.get("reason") == "slo_burn" for u in ups):
+        problems.append(
+            f"no slo_burn autoscale-up event (ups: "
+            f"{[u.get('reason') for u in ups]})"
+        )
+    if len(grants) < 2:
+        problems.append(
+            f"expected >= 2 chip-lease grants from the scheduler,"
+            f" saw {len(grants)}"
+        )
+    if not any(d.get("reason") == "drained" for d in downs):
+        problems.append("no drained scale-down event")
+    if storm_sched.leased("storm-pool"):
+        problems.append(
+            f"chips still leased after wind-down:"
+            f" {storm_sched.leased('storm-pool')}"
+        )
+
+    # recovery oracle: the burst must have breached the SLO (that is what
+    # burned), and the post-scale trickle must land back inside it
+    by_id = {ev.get("request_id"): ev for ev in req_events}
+    burst_tot = [
+        by_id[r.request_id].get("total_s") for r in burst
+        if by_id.get(r.request_id, {}).get("total_s") is not None
+    ]
+    tail_tot = [
+        by_id[r.request_id].get("total_s") for r in trickle
+        if by_id.get(r.request_id, {}).get("total_s") is not None
+    ]
+    if len(tail_tot) < len(trickle):
+        problems.append(
+            f"only {len(tail_tot)}/{len(trickle)} trickle requests have"
+            " terminal events"
+        )
+    if burst_tot and max(burst_tot) <= storm_slo_s:
+        problems.append(
+            f"the burst never breached the SLO (worst total"
+            f" {max(burst_tot):.2f}s <= {storm_slo_s}s) — no real storm"
+        )
+    if tail_tot and max(tail_tot) > storm_slo_s:
+        problems.append(
+            "post-scale p99 did not recover: worst trickle total"
+            f" {max(tail_tot):.2f}s > SLO {storm_slo_s}s"
+        )
+
+    storm_json = os.path.join(art_dir, "storm_report.json")
+    if not problems:
+        if report.main(
+            ["--run-dir", storm_dir, "--json-out", storm_json]
+        ) != 0:
+            return 1
+        with open(storm_json) as f:
+            storm_slo = (json.load(f)).get("slo")
+        if not isinstance(storm_slo, dict) or (
+            storm_slo.get("n_finished", 0) < len(storm_workload)
+        ):
+            problems.append(
+                f"merged storm report slo section incomplete: {storm_slo!r}"
+            )
+    if problems:
+        for prob in problems:
+            sys.stderr.write(f"# run_probe: FAIL: {prob}\n")
+        return 1
+    sys.stderr.write(
+        "# run_probe: serving storm game day ok"
+        f" ({len(storm_workload)} request(s) served, 0 lost;"
+        f" peak {storm_summary['workers_peak']} worker(s),"
+        f" {storm_summary['scale_ups']} scale-up(s)"
+        f" [{sorted({u.get('reason') for u in ups})}],"
+        f" {len(grants)} lease grant(s),"
+        f" {storm_summary['scale_downs']} scale-down(s);"
+        f" burst worst {max(burst_tot):.2f}s vs post-scale worst"
+        f" {max(tail_tot):.2f}s <= SLO {storm_slo_s}s)"
+        f" report -> {storm_json}\n"
+    )
     return 0
 
 

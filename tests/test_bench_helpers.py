@@ -208,6 +208,8 @@ def test_orchestrator_happy_path(monkeypatch, tmp_path):
         _ok("fp32arm", fp32_scanned_imgs_per_sec=300.0),
         _ok("overlap", overlap={"combiner_merged": True}),
         _ok("loader", loader_samples_per_s=200000.0, data_load_share=0.03),
+        _ok("serving", serving_tokens_per_s_per_chip=800.0,
+            kv_capacity_ratio=4.0, p99_decode_ms_per_token=2.0),
         None,
     ])])
     # first line precedes any backend touch and is already valid
@@ -239,12 +241,13 @@ def test_orchestrator_survives_hang_and_respawns(monkeypatch, tmp_path):
             _ok("probe", device="TPU v5e", platform="tpu", n_devices=1),
             "hang",  # flagship compile wedged in C++
         ]),
-        (["baseline", "gpt", "fp32arm", "overlap", "loader"], [
+        (["baseline", "gpt", "fp32arm", "overlap", "loader", "serving"], [
             _ok("baseline", baseline_imgs_per_sec=100.0),
             _ok("gpt", gpt={"step_time_ms": 50.0}),
             _ok("fp32arm", fp32_scanned_imgs_per_sec=300.0),
             _ok("overlap", overlap={"combiner_merged": True}),
             _ok("loader", loader_samples_per_s=200000.0),
+            _ok("serving", serving_tokens_per_s_per_chip=800.0),
             None,
         ]),
     ])
@@ -273,6 +276,7 @@ def test_orchestrator_cpu_fallback_after_two_init_failures(monkeypatch, tmp_path
             _ok("gpt", gpt={"step_time_ms": 400.0}),
             _ok("overlap", overlap={"combiner_merged": True}),
             _ok("loader", loader_samples_per_s=100000.0),
+            _ok("serving", serving_tokens_per_s_per_chip=80.0),
             None,
         ]),
     ])
@@ -318,6 +322,7 @@ def test_orchestrator_counts_silent_child_death_as_init_failure(monkeypatch, tmp
             _ok("gpt", gpt={"step_time_ms": 400.0}),
             _ok("overlap", overlap={"combiner_merged": True}),
             _ok("loader", loader_samples_per_s=100000.0),
+            _ok("serving", serving_tokens_per_s_per_chip=80.0),
             None,
         ]),
     ])
@@ -342,12 +347,13 @@ def test_first_event_budget_includes_init_grace(monkeypatch, tmp_path):
             _ok("probe", device="TPU v5e", platform="tpu", n_devices=1),
             "hang",  # flagship wedged -> kill -> respawn
         ]),
-        (["baseline", "gpt", "fp32arm", "overlap", "loader"], [
+        (["baseline", "gpt", "fp32arm", "overlap", "loader", "serving"], [
             _ok("baseline", baseline_imgs_per_sec=100.0),
             _ok("gpt", gpt={}),
             _ok("fp32arm", fp32_scanned_imgs_per_sec=300.0),
             _ok("overlap", overlap={}),
             _ok("loader", loader_samples_per_s=200000.0),
+            _ok("serving", serving_tokens_per_s_per_chip=800.0),
             None,
         ]),
     ])
@@ -375,12 +381,14 @@ def test_cpu_fallback_gets_fresh_init_failure_budget(monkeypatch, tmp_path):
             _ok("probe", device="cpu", platform="cpu", n_devices=8),
             "hang",                           # CPU child wedges on flagship
         ]),
-        (["baseline", "gpt", "fp32arm", "overlap", "loader"], [  # respawned
+        (["baseline", "gpt", "fp32arm", "overlap", "loader", "serving"], [
+            # respawned
             _ok("baseline", baseline_imgs_per_sec=25.0),
             _ok("gpt", gpt={}),
             _ok("fp32arm", fp32_scanned_imgs_per_sec=30.0),
             _ok("overlap", overlap={}),
             _ok("loader", loader_samples_per_s=100000.0),
+            _ok("serving", serving_tokens_per_s_per_chip=80.0),
             None,
         ]),
     ])
@@ -407,6 +415,7 @@ def test_orchestrator_waits_for_abandoned_drain(monkeypatch, tmp_path):
          "data": {"error": "_PhaseAbandoned: phase gpt exceeded ..."}},
         _ok("overlap", overlap={"combiner_merged": True}),
         _ok("loader", loader_samples_per_s=200000.0),
+        _ok("serving", serving_tokens_per_s_per_chip=800.0),
         {"phase": "__drain__", "ok": True,
          "data": {"drained": ["gpt"], "still_alive": []}},
         None,  # child exits on its own AFTER draining
@@ -430,6 +439,7 @@ def test_orchestrator_kills_immediately_on_giveup(monkeypatch, tmp_path):
             _ok("gpt", gpt={"step_time_ms": 50.0}),
             _ok("fp32arm", fp32_scanned_imgs_per_sec=300.0),
             _ok("loader", loader_samples_per_s=200000.0),
+            _ok("serving", serving_tokens_per_s_per_chip=800.0),
             "hang",  # overlap wedged — the LAST pending phase
         ]),
     ])
@@ -478,6 +488,7 @@ def test_midround_self_persists_on_full_tpu_run(monkeypatch, tmp_path):
         _ok("gpt", gpt={"step_time_ms": 50.0}),
         _ok("overlap", overlap={"combiner_merged": True}),
         _ok("loader", loader_samples_per_s=200000.0),
+        _ok("serving", serving_tokens_per_s_per_chip=800.0),
         None,
     ])])
     path = os.path.join(bench.HERE, "artifacts", "BENCH_MIDROUND.json")
@@ -498,6 +509,7 @@ def test_midround_self_persists_on_full_tpu_run(monkeypatch, tmp_path):
         _ok("gpt", gpt={"step_time_ms": 50.0}),
         _ok("overlap", overlap={"combiner_merged": True}),
         _ok("loader", loader_samples_per_s=100000.0),
+        _ok("serving", serving_tokens_per_s_per_chip=80.0),
         None,
     ])])
     assert not os.path.exists(
@@ -524,6 +536,7 @@ def test_init_hang_retries_once_then_engages_fallback(monkeypatch, tmp_path):
             _ok("gpt", gpt={"step_time_ms": 400.0}),
             _ok("fp32arm", fp32_scanned_imgs_per_sec=30.0),
             _ok("overlap", overlap={"combiner_merged": True}),
+            _ok("serving", serving_tokens_per_s_per_chip=80.0),
             None,
         ]),
     ])

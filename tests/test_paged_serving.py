@@ -1,0 +1,493 @@
+"""Paged KV serving (PR 19): the block pool, prefix sharing, speculative
+decoding, and the autoscaler's scheduler leases.
+
+The load-bearing claims, in test form:
+
+- **Allocator invariants** (jax-free): all-or-nothing allocation,
+  refcounted link/release, double-free raises, and ``check_owners``
+  catches every way the free-list and the owner chains can disagree.
+- **Bit-identity at a fraction of the HBM**: the paged engine — including
+  mid-flight admissions, prefix-shared admissions, and speculative
+  rounds — produces EXACTLY the tokens of sequential
+  ``models.gpt.generate`` calls and of the dense ``SlotEngine``. Paging,
+  sharing, and speculation change the memory layout and the dispatch
+  count, never the math.
+- **Shared-prefix admission**: 8 identical prompts prefill the device
+  ONCE; the other 7 admit from the prompt-hash index (zero forward
+  passes), and copy-on-write isolates their divergent suffixes.
+- **Exactly-once eviction + leak accounting**: every eviction path
+  returns each block exactly once; the per-tick invariant
+  ``free + Σ distinct chain entries == usable`` fails loudly when broken.
+- **Backpressure**: a pool too small for the offered load defers
+  admissions (strict FIFO) and still drains everything.
+- **Scheduler leases**: the autoscaler's chip-lease API on
+  ``resilience.scheduler.FleetScheduler`` grants from the free pool,
+  respects reservations, and releases idempotently.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from network_distributed_pytorch_tpu.models.gpt import generate, gpt_tiny
+from network_distributed_pytorch_tpu.serving import Request
+from network_distributed_pytorch_tpu.serving.blocks import (
+    GARBAGE_BLOCK,
+    BlockLeakError,
+    BlockPool,
+    OutOfBlocks,
+    PrefixIndex,
+    blocks_needed,
+    prefix_key,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name: str):
+    path = os.path.join(REPO, "scripts", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"_paged_test_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[f"_paged_test_{name}"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _CaptureTelemetry:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event):
+        self.events.append(event)
+
+
+# --- allocator units (jax-free) -------------------------------------------
+
+
+def test_blocks_needed_and_prefix_key():
+    assert blocks_needed(0, 4) == 0
+    assert blocks_needed(1, 4) == 1
+    assert blocks_needed(4, 4) == 1
+    assert blocks_needed(5, 4) == 2
+    # content-addressed: same tokens same key, regardless of container
+    assert prefix_key([1, 2, 3]) == prefix_key((1, 2, 3))
+    assert prefix_key([1, 2, 3]) != prefix_key([1, 2])
+
+
+def test_block_pool_alloc_link_release_refcounts():
+    pool = BlockPool(6, 4)  # 5 usable, block 0 is garbage
+    assert pool.n_usable == 5 and pool.n_free == 5
+    a = pool.alloc(2)
+    assert a == [1, 2]  # deterministic ascending order
+    assert all(pool.refcount(b) == 1 for b in a)
+    # all-or-nothing: an uncoverable request takes NOTHING
+    with pytest.raises(OutOfBlocks):
+        pool.alloc(4)
+    assert pool.n_free == 3
+    pool.link(a)
+    assert all(pool.refcount(b) == 2 for b in a)
+    assert pool.release(a) == []  # survivors keep the blocks
+    assert pool.release(a) == a  # last reference frees
+    assert pool.n_free == 5
+    with pytest.raises(BlockLeakError):
+        pool.release([1])  # double free
+    with pytest.raises(BlockLeakError):
+        pool.link([1])  # linking an unallocated block
+    # the garbage block is never a real reference
+    assert pool.release([GARBAGE_BLOCK]) == []
+
+
+def test_block_pool_check_owners_catches_discrepancies():
+    pool = BlockPool(5, 4)
+    chain = pool.alloc(2)
+    pool.check_owners([chain])  # consistent
+    with pytest.raises(BlockLeakError):
+        pool.check_owners([])  # allocated but unowned
+    with pytest.raises(BlockLeakError):
+        pool.check_owners([chain, chain])  # multiplicity != refcount
+    pool.link(chain)
+    pool.check_owners([chain, chain])
+    pool.release(chain)
+    pool.release(chain)
+    pool.check_owners([])
+
+
+def test_prefix_index_register_lookup_evict_lru():
+    pool = BlockPool(10, 4)
+    prompt = [1, 2, 3, 4, 5, 6]  # one full block + a partial
+    chain = pool.alloc(blocks_needed(len(prompt), 4))
+    idx = PrefixIndex(pool)
+    added = idx.register(prompt, chain, first_token=42)
+    assert added == 2  # the 4-token block prefix + the exact prompt
+    # exact hit replays the greedy first token; the index owns its refs
+    hit = idx.lookup(prompt)
+    assert hit["n_tokens"] == 6 and hit["first_token"] == 42
+    assert pool.refcount(chain[0]) == 3  # slot + 2 index entries
+    # a longer prompt sharing the first block matches at block granularity
+    hit = idx.lookup([1, 2, 3, 4, 9, 9, 9])
+    assert hit["n_tokens"] == 4 and hit["first_token"] is None
+    assert idx.lookup([7, 7, 7]) is None
+    pool.check_owners([chain] + idx.chains())
+    # release the slot's own reference, then LRU-evict the index dry
+    pool.release(chain)
+    idx.evict_lru(pool.n_usable)
+    assert len(idx) == 0 and pool.n_free == pool.n_usable
+    pool.check_owners([])
+
+
+def test_spec_accept_bitwise_semantics():
+    from network_distributed_pytorch_tpu.serving.engine import spec_accept
+
+    # greedy self-draft: every fed token matches the target's previous
+    # output, so the whole round lands (K-1 drafts + the bonus token)
+    assert spec_accept([5, 7, 8, 9], [7, 8, 9, 4], budget_left=10) == [
+        7, 8, 9, 4,
+    ]
+    # adversarial draft: fed[2]=6 contradicts the target's outs[1]=8 —
+    # the CORRECTED token 8 still lands, nothing after it does
+    assert spec_accept([5, 7, 6, 9], [7, 8, 9, 4], budget_left=10) == [7, 8]
+    # a first-proposal miss accepts exactly the one corrected token:
+    # precisely what a target-only decode step would have emitted
+    assert spec_accept([5, 1, 1, 1], [7, 8, 9, 4], budget_left=10) == [7]
+    # request budget truncates a fully-matching round
+    assert spec_accept([5, 7, 8, 9], [7, 8, 9, 4], budget_left=2) == [7, 8]
+    # EOS stops the round even when the draft kept matching
+    assert spec_accept(
+        [5, 7, 8, 9], [7, 8, 9, 4], budget_left=10, eos_token_id=8
+    ) == [7, 8]
+
+
+# --- engine parity (device) -----------------------------------------------
+
+
+def _serving_model(max_len=16, seed=0):
+    model = gpt_tiny(vocab_size=64, max_position_embeddings=max_len)
+    params = model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, max_len), jnp.int32)
+    )["params"]
+    return model, params
+
+
+def _mixed_requests(rng, n=5):
+    reqs = []
+    for i, budget in enumerate((4, 6, 3, 5, 4)[:n]):
+        prompt = [int(t) for t in rng.randint(0, 64, rng.randint(2, 7))]
+        reqs.append(
+            Request(
+                request_id=f"req-{i:04d}", prompt=prompt,
+                max_new_tokens=budget,
+            )
+        )
+    return reqs
+
+
+def _assert_bitwise_vs_generate(model, params, reqs, max_len):
+    for r in reqs:
+        ref = generate(
+            model.config, params, jnp.asarray([r.prompt], jnp.int32),
+            r.max_new_tokens, cache_len=max_len,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(r.tokens, np.int32), np.asarray(ref[0])
+        )
+
+
+def test_paged_engine_bit_identical_to_dense_and_generate(devices):
+    from network_distributed_pytorch_tpu.serving.engine import (
+        PagedEngine,
+        SlotEngine,
+    )
+
+    max_len = 16
+    model, params = _serving_model(max_len)
+    rng = np.random.RandomState(1)
+    reqs = _mixed_requests(rng)
+    engine = PagedEngine(
+        model.config, params, n_slots=2, max_len=max_len, block_len=4,
+    )
+    # same mid-flight admission schedule as the dense engine's bit-identity
+    # test: two admitted into slots freed by earlier completions
+    for r in reqs[:3]:
+        engine.submit(r)
+    engine.step()
+    engine.step()
+    for r in reqs[3:]:
+        engine.submit(r)
+    finished = engine.run(max_steps=200)
+    assert len(finished) == len(reqs)
+    _assert_bitwise_vs_generate(model, params, reqs, max_len)
+    # and bit-identical to the DENSE engine on the same workload
+    dense_reqs = [
+        Request(request_id=r.request_id, prompt=list(r.prompt),
+                max_new_tokens=r.max_new_tokens)
+        for r in reqs
+    ]
+    dense = SlotEngine(model.config, params, n_slots=2, max_len=max_len)
+    for r in dense_reqs:
+        dense.submit(r)
+    dense.run(max_steps=200)
+    assert {r.request_id: r.tokens for r in reqs} == {
+        r.request_id: r.tokens for r in dense_reqs
+    }
+    # the pool drained clean: every block back on the free list
+    assert engine.allocator.n_free == engine.allocator.n_usable or (
+        engine.index is not None and len(engine.index) > 0
+    )
+    engine.allocator.check_owners(engine._owner_chains())
+
+
+def test_spec_decoding_bitwise_self_draft_and_adversarial(devices):
+    from network_distributed_pytorch_tpu.serving.engine import PagedEngine
+
+    max_len = 16
+    model, params = _serving_model(max_len)
+    rng = np.random.RandomState(3)
+    reqs = _mixed_requests(rng)
+
+    def run_paged(spec_params):
+        rs = [
+            Request(request_id=r.request_id, prompt=list(r.prompt),
+                    max_new_tokens=r.max_new_tokens)
+            for r in reqs
+        ]
+        eng = PagedEngine(
+            model.config, params, n_slots=2, max_len=max_len, block_len=4,
+            draft_config=model.config if spec_params is not None else None,
+            draft_params=spec_params, spec_k=4 if spec_params is not None else 0,
+        )
+        for r in rs:
+            eng.submit(r)
+        eng.run(max_steps=300)
+        return eng, {r.request_id: r.tokens for r in rs}
+
+    plain, want = run_paged(None)
+    # self-draft: proposals are the target's own greedy tokens, so rounds
+    # accept fully up to budget/EOS truncation — bitwise AND strictly
+    # fewer target dispatches
+    self_spec, got = run_paged(params)
+    assert got == want
+    assert self_spec.spec_proposed > 0
+    assert self_spec.spec_accepted / self_spec.spec_proposed > 0.5
+    assert self_spec.decode_steps < plain.decode_steps
+    # adversarial draft (independently-initialized params): proposals are
+    # near-noise, acceptance collapses to the corrected-token prefix —
+    # and the emitted streams STILL match the target bitwise
+    _, adv_params = _serving_model(max_len, seed=7)
+    adv_spec, got = run_paged(adv_params)
+    assert got == want
+    assert adv_spec.spec_accepted < adv_spec.spec_proposed
+    accept_rate = adv_spec.spec_accepted / adv_spec.spec_proposed
+    assert accept_rate < 0.5  # a real draft would need distillation
+
+
+def test_shared_prefix_eight_requests_prefill_once(devices):
+    from network_distributed_pytorch_tpu.serving.engine import PagedEngine
+
+    max_len = 16
+    model, params = _serving_model(max_len)
+    prompt = [3, 1, 4, 1, 5, 9]  # not block-aligned: COW territory
+    cap = _CaptureTelemetry()
+    engine = PagedEngine(
+        model.config, params, n_slots=4, max_len=max_len, block_len=4,
+        telemetry=cap, emit_pool_every=1,
+    )
+    reqs = [
+        Request(request_id=f"s{i}", prompt=list(prompt), max_new_tokens=5)
+        for i in range(8)
+    ]
+    for r in reqs:
+        engine.submit(r)
+    finished = engine.run(max_steps=200)
+    assert len(finished) == 8
+    # ONE device prefill; the other seven replayed from the prefix index
+    assert engine.prefills == 1
+    assert engine.prefix_hits == 7
+    assert engine.prefill_tokens_saved == 7 * len(prompt)
+    # identical prompts decode identical tokens — and match the reference
+    _assert_bitwise_vs_generate(model, params, reqs, max_len)
+    assert len({tuple(r.tokens) for r in reqs}) == 1
+    # divergence isolation: the shared boundary block forced at least one
+    # copy-on-write when a sharer first wrote into it
+    assert engine.cow_copies >= 1
+    # the ledger reached the live plane: kv_pool events carry the counters
+    kv = [e.record() for e in cap.events if e.KIND == "kv_pool"]
+    assert kv and kv[-1]["prefix_hits_total"] == 7
+    assert kv[-1]["cow_copies_total"] == engine.cow_copies
+
+
+def test_eviction_exactly_once_and_leak_assertion(devices):
+    from network_distributed_pytorch_tpu.serving.engine import PagedEngine
+
+    max_len = 16
+    model, params = _serving_model(max_len)
+    cap = _CaptureTelemetry()
+    engine = PagedEngine(
+        model.config, params, n_slots=2, max_len=max_len, block_len=4,
+        telemetry=cap, check_leaks=True,
+    )
+    for i in range(3):
+        engine.submit(
+            Request(request_id=f"e{i}", prompt=[1, 2, i + 1],
+                    max_new_tokens=8)
+        )
+    engine.step()  # two admitted + ticked, one still queued
+    assert engine.allocator.n_free < engine.allocator.n_usable
+    evicted = engine.evict_all(reason="shutdown")
+    assert len(evicted) == 3 and engine.idle
+    # exactly-once release: the pool is whole again (index cleared too)
+    assert engine.allocator.n_free == engine.allocator.n_usable
+    assert engine.evict_all() == []  # idempotent on an empty engine
+    assert {e.record()["state"] for e in cap.events
+            if e.KIND == "request"} == {"evicted"}
+
+    # breaking the refcount ledger behind the engine's back trips the
+    # per-tick invariant loudly instead of corrupting KV silently
+    engine.submit(
+        Request(request_id="leak", prompt=[9, 9], max_new_tokens=8)
+    )
+    engine.step()
+    victim = next(s for s in engine.slots if s is not None)
+    engine.allocator.release(victim.chain)
+    with pytest.raises(BlockLeakError):
+        engine.step()
+
+
+def test_backpressure_defers_fifo_and_drains(devices):
+    from network_distributed_pytorch_tpu.serving.engine import PagedEngine
+
+    max_len = 16
+    model, params = _serving_model(max_len)
+    # 4 usable blocks; every request needs 3 (horizon 12 of block 4), so
+    # the pool admits strictly one at a time regardless of the 2 slots
+    engine = PagedEngine(
+        model.config, params, n_slots=2, max_len=max_len, block_len=4,
+        n_blocks=5, prefix_sharing=False,
+    )
+    reqs = [
+        Request(request_id=f"b{i}", prompt=[1 + i, 2, 3], max_new_tokens=9)
+        for i in range(4)
+    ]
+    for r in reqs:
+        engine.submit(r)
+    finished = engine.run(max_steps=400)
+    assert len(finished) == 4
+    assert engine.admissions_deferred > 0
+    assert engine.peak_active == 1  # the pool, not the slot count, gated
+    # FIFO under backpressure: completion order == submission order when
+    # every request has the same decode budget and one runs at a time
+    assert [r.request_id for r in finished] == [r.request_id for r in reqs]
+    _assert_bitwise_vs_generate(model, params, reqs, max_len)
+    assert engine.allocator.n_free == engine.allocator.n_usable
+
+
+# --- scheduler leases (jax-free) ------------------------------------------
+
+
+def test_fleet_scheduler_lease_grant_partial_and_release(tmp_path):
+    from network_distributed_pytorch_tpu.resilience.scheduler import (
+        FleetConfig,
+        FleetScheduler,
+        JobSpool,
+    )
+
+    cap = _CaptureTelemetry()
+    sched = FleetScheduler(
+        JobSpool(str(tmp_path / "jobs")),
+        config=FleetConfig(n_devices=4),
+        telemetry=cap,
+    )
+    got = sched.lease("serve-pool", 2, reason="scale_up")
+    assert got == [0, 1] and sched.leased("serve-pool") == [0, 1]
+    # partial grant: only what the free pool can cover
+    assert sched.lease("serve-pool", 5) == [2, 3]
+    assert sched.lease("serve-pool", 1) == []  # pool dry
+    # release a subset, then the rest; releasing again is a no-op
+    sched.lease_release("serve-pool", ranks=[1])
+    assert sched.leased("serve-pool") == [0, 2, 3]
+    sched.lease_release("serve-pool")
+    assert sched.leased("serve-pool") == []
+    sched.lease_release("serve-pool")
+    assert sched.lease("other", 4) == [0, 1, 2, 3]
+    grants = [
+        e.record() for e in cap.events
+        if e.KIND == "schedule" and e.record().get("planner") == "lease"
+    ]
+    assert any(g["world"] >= 1 for g in grants)
+    assert any(g["world"] == 0 for g in grants)  # the release events
+
+
+# --- live gauges + report + gate ------------------------------------------
+
+
+def test_kv_pool_event_feeds_live_gauges():
+    from network_distributed_pytorch_tpu.observe.events import KVPoolEvent
+    from network_distributed_pytorch_tpu.observe.live import (
+        MetricRegistry,
+        ingest_record,
+    )
+
+    reg = MetricRegistry()
+    ev = KVPoolEvent(
+        label="t", rank=0, n_blocks=33, block_len=8, blocks_free=10,
+        blocks_used=22, blocks_shared=6, pool_bytes=1 << 20,
+        prefix_hits_total=7, prefill_tokens_saved_total=56,
+        cow_copies_total=2, admissions_deferred_total=3,
+    )
+    ingest_record(reg, ev.record())
+    assert reg.get_gauge("live_kv_blocks_free", rank="0") == 10
+    assert reg.get_gauge("live_kv_prefix_hits_total", rank="0") == 7
+    assert reg.get_gauge("live_kv_cow_copies_total", rank="0") == 2
+    assert reg.get_gauge("live_kv_admissions_deferred_total", rank="0") == 3
+
+
+def test_report_kv_section_and_gate_capacity_floor(tmp_path):
+    report = _load_script("report")
+    events = [
+        {
+            "event": "kv_pool", "rank": 0, "label": "serve", "n_blocks": 33,
+            "block_len": 8, "blocks_free": 4, "blocks_used": 28,
+            "blocks_shared": 7, "pool_bytes": 1 << 20,
+            "prefix_hits_total": 5, "prefill_tokens_saved_total": 40,
+            "cow_copies_total": 2, "admissions_deferred_total": 1,
+            "t_wall": 100.0,
+        },
+        {
+            "event": "kv_pool", "rank": 0, "label": "serve", "n_blocks": 33,
+            "block_len": 8, "blocks_free": 32, "blocks_used": 0,
+            "blocks_shared": 0, "pool_bytes": 1 << 20,
+            "prefix_hits_total": 9, "prefill_tokens_saved_total": 72,
+            "cow_copies_total": 3, "admissions_deferred_total": 1,
+            "t_wall": 101.0,
+        },
+    ]
+    kv = report.kv_pool_summary_from_events(events)
+    # last snapshot wins for occupancy; min-free across the run gives peak
+    assert kv["blocks_free_total"] == 32 and kv["prefix_hits_total"] == 9
+    assert kv["engines"][0]["peak_blocks_used"] == 28
+    text = report.render_report(events, name="kv-test")
+    assert "serving KV memory" in text and "prefix-shared" in text
+
+    gate = _load_script("gate")
+    report_path = str(tmp_path / "report.json")
+    base_path = str(tmp_path / "baseline.json")
+    with open(base_path, "w") as f:
+        json.dump({"kv_capacity_ratio": 4.0, "kv_capacity_ratio_target": 2.0}, f)
+    # below the ABSOLUTE 2x floor -> regression even within tolerance math
+    with open(report_path, "w") as f:
+        json.dump({"kv_capacity_ratio": 1.5}, f)
+    assert gate.main(
+        ["--report", report_path, "--baseline", base_path,
+         "--root", str(tmp_path)]
+    ) == 1
+    with open(report_path, "w") as f:
+        json.dump({"kv_capacity_ratio": 4.1}, f)
+    assert gate.main(
+        ["--report", report_path, "--baseline", base_path,
+         "--root", str(tmp_path)]
+    ) == 0

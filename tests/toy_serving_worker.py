@@ -62,21 +62,34 @@ def toy_token(request: Request) -> int:
 class ToyEngine:
     """The SlotEngine's host-side scheduling, with :func:`toy_token` in
     place of the compiled decode step (same backfill-then-tick order, same
-    lifecycle transitions, same terminal RequestEvents)."""
+    lifecycle transitions, same terminal RequestEvents).
+
+    With ``pool`` set (a real ``serving.blocks.BlockPool``), admission is
+    gated by the PAGED allocator: a request only enters a slot when its
+    whole decode horizon's KV blocks can be granted, admission stops at
+    the first out-of-blocks request (strict FIFO backpressure, same rule
+    as ``PagedEngine``), blocks are returned exactly once on finish, and
+    the refcount-leak invariant is asserted after every tick — the paged
+    bookkeeping under storm load, minus the model."""
 
     def __init__(self, n_slots, telemetry=None, rank=None,
-                 step_seconds=0.0, label="toy_serving"):
+                 step_seconds=0.0, label="toy_serving",
+                 pool=None, block_len=4):
         self.n_slots = n_slots
         self.telemetry = telemetry
         self.rank = rank
         self.step_seconds = step_seconds
         self.label = label
         self.slots = [None] * n_slots
+        self.chains = [None] * n_slots  # paged mode: per-slot block chain
+        self.pool = pool
+        self.block_len = block_len
         self.queue = []
         self._finished = []
         self.submits = 0
         self.decode_steps = 0
         self.prefills = 0
+        self.admissions_deferred = 0
 
     def submit(self, request):
         request.mark_enqueued(time.monotonic())
@@ -106,6 +119,32 @@ class ToyEngine:
             )
         self._finished.append(request)
 
+    def _admit_blocks(self, r):
+        """Paged admission gate: all-or-nothing alloc for the request's
+        whole horizon. Returns the chain, or None on out-of-blocks."""
+        if self.pool is None:
+            return []
+        from network_distributed_pytorch_tpu.serving.blocks import (
+            OutOfBlocks, blocks_needed,
+        )
+
+        need = blocks_needed(
+            len(r.prompt) + r.max_new_tokens, self.block_len
+        )
+        try:
+            return self.pool.alloc(need)
+        except OutOfBlocks:
+            return None
+
+    def _release_blocks(self, s):
+        if self.pool is not None and self.chains[s]:
+            self.pool.release(self.chains[s])
+        self.chains[s] = None
+
+    def _check_leaks(self):
+        if self.pool is not None:
+            self.pool.check_owners([c for c in self.chains if c])
+
     def step(self):
         before = self.prefills
         now = time.monotonic()
@@ -113,18 +152,28 @@ class ToyEngine:
             if not self.queue:
                 break
             if self.slots[s] is None:
+                chain = self._admit_blocks(self.queue[0])
+                if chain is None:
+                    # out of KV blocks: the request stays at the queue
+                    # head (strict FIFO) until a finisher frees its chain
+                    self.admissions_deferred += 1
+                    break
                 r = self.queue.pop(0)
                 r.mark_prefilling(now)
                 self.prefills += 1
                 r.mark_decoding(time.monotonic())
                 r.add_token(toy_token(r))
                 if r.done:
+                    if self.pool is not None and chain:
+                        self.pool.release(chain)
                     r.finish(time.monotonic())
                     self._terminal(r)
                 else:
                     self.slots[s] = r
+                    self.chains[s] = chain
         occupied = [s for s in range(self.n_slots) if self.slots[s] is not None]
         if not occupied:
+            self._check_leaks()
             return self.prefills != before
         if self.step_seconds:
             time.sleep(self.step_seconds)
@@ -134,9 +183,11 @@ class ToyEngine:
             r = self.slots[s]
             r.add_token(toy_token(r))
             if r.done:
+                self._release_blocks(s)
                 r.finish(now)
                 self._terminal(r)
                 self.slots[s] = None
+        self._check_leaks()
         return True
 
 
@@ -149,6 +200,14 @@ def main() -> int:
     p.add_argument("--slots", type=int, default=2)
     p.add_argument("--step-seconds", type=float, default=0.005)
     p.add_argument("--max-wall-s", type=float, default=60.0)
+    p.add_argument(
+        "--paged", action="store_true",
+        help="gate admission with a real serving.blocks.BlockPool"
+             " (paged-allocator backpressure + leak checks)",
+    )
+    p.add_argument("--block-len", type=int, default=4)
+    p.add_argument("--pool-blocks", type=int, default=None,
+                   help="pool size; default sizes for slots*horizon")
     p.add_argument(
         "--die-after-claims", type=int, default=None, metavar="N",
         help="incarnation 0 only: SIGKILL self mid-decode once N requests"
@@ -166,9 +225,21 @@ def main() -> int:
     )
 
     spool = FileSpool(args.spool_dir, rank=args.rank, incarnation=incarnation)
+    pool = None
+    if args.paged:
+        from network_distributed_pytorch_tpu.serving.blocks import (  # noqa: E501
+            BlockPool,
+        )
+
+        # default: room for all slots at a 32-token horizon, + garbage
+        n_blocks = args.pool_blocks or (
+            args.slots * (32 // args.block_len + 1) + 1
+        )
+        pool = BlockPool(n_blocks, args.block_len)
     engine = ToyEngine(
         args.slots, telemetry=telemetry, rank=args.rank,
         step_seconds=args.step_seconds,
+        pool=pool, block_len=args.block_len,
     )
 
     if args.die_after_claims is not None and incarnation == 0:
@@ -199,7 +270,10 @@ def main() -> int:
             {"rank": args.rank, "world": args.world,
              "incarnation": incarnation,
              "decode_steps": engine.decode_steps,
-             "prefills": engine.prefills, **served},
+             "prefills": engine.prefills,
+             "paged": bool(args.paged),
+             "admissions_deferred": engine.admissions_deferred,
+             **served},
             f,
         )
     return 0
