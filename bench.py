@@ -1910,6 +1910,14 @@ def _record_gate_baseline(out: dict, status: dict) -> None:
         hbm = (doc.get("memory") or {}).get("hbm_peak_bytes")
         if isinstance(hbm, (int, float)) and hbm > 0:
             rec["hbm_peak_bytes"] = float(hbm)
+        # gradient-fidelity scalar (observe.fidelity via report.py): the
+        # worst shape-group's mean relative compression error. Zero
+        # (exact reducers) is the healthy value and records as such, so
+        # a later round whose compressed wire quietly degrades what it
+        # delivers regresses against this reference
+        fid = (doc.get("fidelity") or {}).get("rel_error")
+        if isinstance(fid, (int, float)) and fid >= 0:
+            rec["fidelity_rel_error"] = float(fid)
     except (OSError, ValueError):
         pass
     # loader-isolation arm (PR 12): native assembly samples/s is a

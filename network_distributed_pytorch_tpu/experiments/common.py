@@ -189,6 +189,7 @@ def train_loop(
 
     from ..data import device_prefetch
     from ..observe import FailureEvent, TrainHealthEvent
+    from ..observe.fidelity import FidelityTracker
     from ..observe.spans import recording, span
     from ..parallel.mesh import DATA_AXIS, data_sharding
     from ..utils.profiling import step_annotation, trace
@@ -211,6 +212,7 @@ def train_loop(
         bits_per_step=step.bits_per_step, log_every=log_every, telemetry=telemetry
     )
     memory_sampler = None
+    fidelity_tracker = None
     if health_every > 0 and telemetry is not None:
         from ..observe.memory import MemorySampler
 
@@ -311,6 +313,25 @@ def train_loop(
                                     label=run_name,
                                 )
                             )
+                            # per-group fidelity plane: same probe sample,
+                            # broken out per shape-group/bucket with the
+                            # wire-ledger join tags (observe.fidelity)
+                            fid = stats.get("fidelity")
+                            if fid:
+                                if fidelity_tracker is None:
+                                    tags = {}
+                                    r = getattr(step, "reducer", None)
+                                    if hasattr(r, "fidelity_group_tags"):
+                                        tags = r.fidelity_group_tags(
+                                            state.params
+                                        )
+                                    fidelity_tracker = FidelityTracker(
+                                        tags, rank=rank, label=run_name
+                                    )
+                                for ev in fidelity_tracker.events(
+                                    logger._step, fid, epoch=epoch
+                                ):
+                                    telemetry.emit(ev)
                         except Exception as e:  # advisory, never fatal
                             telemetry.emit(
                                 FailureEvent(
@@ -622,6 +643,7 @@ def adaptive_train_loop(
     import time as _time
 
     from ..observe import FailureEvent, TrainHealthEvent
+    from ..observe.fidelity import FidelityTracker
     from ..observe.spans import recording, span
     from ..parallel import comm
     from ..resilience.controller import EpochHealth
@@ -642,6 +664,7 @@ def adaptive_train_loop(
     )
 
     memory_sampler = None
+    fidelity_tracker = None
     if health_every > 0 and telemetry is not None:
         from ..observe.memory import MemorySampler
 
@@ -702,7 +725,10 @@ def adaptive_train_loop(
         # the training state across the switch. Shared by the boundary
         # observe and the mid-epoch alert nudge — the nudge spends the
         # same single-recompile budget, just before the epoch edge.
-        nonlocal base, state, guard, compile_grace
+        nonlocal base, state, guard, compile_grace, fidelity_tracker
+        # new rung => new reducer => new fidelity group keys; drop the
+        # tracker so the next probe rebuilds it from the new layout
+        fidelity_tracker = None
         realized = base.bits_per_step / 8
         new_base = step_factory(controller.overrides)
         carried_model = base.eval_model_state(state)
@@ -772,6 +798,24 @@ def adaptive_train_loop(
                                         label=run_name,
                                     )
                                 )
+                                fid = stats.get("fidelity")
+                                if fid:
+                                    if fidelity_tracker is None:
+                                        tags = {}
+                                        r = getattr(base, "reducer", None)
+                                        if hasattr(
+                                            r, "fidelity_group_tags"
+                                        ):
+                                            tags = r.fidelity_group_tags(
+                                                state.params
+                                            )
+                                        fidelity_tracker = FidelityTracker(
+                                            tags, rank=rank, label=run_name
+                                        )
+                                    for ev in fidelity_tracker.events(
+                                        gstep, fid, epoch=epoch
+                                    ):
+                                        telemetry.emit(ev)
                             except Exception as e:  # advisory, never fatal
                                 telemetry.emit(
                                     FailureEvent(

@@ -50,6 +50,13 @@ that loop as a first-class subsystem instead of scattered fragments:
   loss plateau, step-time drift, bandwidth collapse, serving SLO burn,
   HBM headroom) emitting typed ``AlertEvent`` records back into the
   control plane.
+- :mod:`observe.fidelity`  — the gradient-fidelity plane: the
+  host-side tracker turning health-probe per-group compression
+  diagnostics into typed ``FidelityEvent`` records (EF growth, replica/
+  anchor drift), the per-group report aggregation behind the gate's
+  ``fidelity_rel_error``, and the accuracy-per-byte frontier
+  (``artifacts/fidelity_frontier.json``) joining loss against cumulative
+  ledger bytes per fallback-ladder rung.
 - :mod:`observe.memory`    — the device-memory plane: the compile-time
   HBM footprint audit (``_jax_compat.compiled_memory`` joined onto
   ``CompileEvent``), the live ``device.memory_stats()`` sampler emitting
@@ -72,6 +79,7 @@ from . import (  # noqa: F401
     costmodel,
     critpath,
     fabric,
+    fidelity,
     health,
     live,
     memory,
@@ -90,6 +98,7 @@ from .events import (  # noqa: F401
     EpochEvent,
     Event,
     FailureEvent,
+    FidelityEvent,
     JobEvent,
     JobFailedEvent,
     KVPoolEvent,

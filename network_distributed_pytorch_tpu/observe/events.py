@@ -630,12 +630,56 @@ class MemoryEvent(Event):
 
 
 @dataclass
+class FidelityEvent(Event):
+    """One per-group gradient-fidelity sample (:mod:`observe.fidelity`):
+    the compression-side twin of the wire ledger, riding the same
+    off-hot-path ``--health-every`` probe cadence as
+    :class:`TrainHealthEvent` but attributed per shape-group / bucket
+    instead of collapsed to one scalar. ``group`` is the fidelity group
+    key (``grads``, ``grads.b{i}``, ``powersgd.g{k}:{n}x{m}r{r}``,
+    ``powersgd.rank1``); ``tag`` is the wire-ledger tag the group's bytes
+    are priced under in the SAME step, so a fidelity record and a
+    :class:`CollectiveEvent` join exactly (orphan tags are a test
+    failure, mirroring ``check_fault_registry``). ``rel_error`` /
+    ``cosine_sim`` compare the compressed against the exact gradient for
+    the group (exact reducers identically 0.0 / 1.0 by construction);
+    ``ef_norm`` / ``ef_growth`` track the group's error-feedback memory
+    and its per-sample growth rate; ``quantized_share`` is the fraction
+    of the group's wire bytes sent below f32 (the bf16 wire dtype);
+    ``replica_drift`` / ``anchor_drift`` carry the inner-replica
+    divergence and site-anchor distance for hierarchical/DiLoCo states
+    (identically zero for exact data-parallel reducers, whose replicas
+    agree bitwise). Guarantee class (DESIGN.md): sampled,
+    merge-tolerance, never bitwise. Silent on stdout; the live
+    aggregator turns these into ``live_fidelity_rel_error{group=}`` /
+    ``live_ef_norm{group=}`` / ``live_replica_drift`` gauges feeding the
+    EF blow-up and fidelity-collapse detectors."""
+
+    KIND: ClassVar[str] = "fidelity"
+
+    step: int
+    group: str
+    tag: str = ""
+    epoch: int = 0
+    rel_error: float = 0.0
+    cosine_sim: float = 1.0
+    ef_norm: float = 0.0
+    ef_growth: float = 0.0
+    quantized_share: float = 0.0
+    replica_drift: float = 0.0
+    anchor_drift: float = 0.0
+    rank: Optional[int] = None
+    label: str = ""
+
+
+@dataclass
 class AlertEvent(Event):
     """A streaming-detector verdict (:mod:`observe.health`): an EWMA
     detector watching the live event stream decided a signal left its
     healthy envelope. ``alert`` names the detector (``grad_spike`` /
     ``loss_plateau`` / ``step_time_drift`` / ``bandwidth_collapse`` /
-    ``slo_burn``), ``severity`` is ``warn`` or ``critical`` (critical
+    ``slo_burn`` / ``ef_blowup`` / ``fidelity_collapse``), ``severity``
+    is ``warn`` or ``critical`` (critical
     grad-norm alerts are the sustained-NaN-precursor signal the supervisor
     may restart on), and ``value``/``threshold`` carry the measurement
     that fired so the record is auditable. Alerts flow BACK into the

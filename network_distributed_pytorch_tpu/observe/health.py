@@ -38,6 +38,20 @@ Thresholds (see DESIGN.md "Live telemetry" for the rationale):
   (critical) — the OOM *precursor* the supervisor and the
   FallbackController can act on (e.g. nudging to a lower PowerSGD rank)
   before the allocator dies.
+- ``fidelity_collapse``: one group's per-sample relative compression error
+  (:class:`observe.events.FidelityEvent`) exceeds
+  ``fidelity_factor`` × its own EWMA baseline (and the absolute
+  ``fidelity_floor`` — a dead-zero exact group materializing error pages
+  too); per-GROUP detectors so the alert blames the shape-group/bucket.
+  Fires with a short sustain (``fidelity_sustain``) — deliberately long
+  BEFORE the loss-plateau budget, because compression distortion leads
+  loss damage by design (the EF chain absorbs it until it can't).
+- ``ef_blowup``: one group's error-feedback memory norm exceeds
+  ``ef_factor`` × its own EWMA baseline — the compressor is falling
+  behind the gradient and the residual is compounding; critical beyond
+  ``ef_critical_factor``. Both fidelity detectors freeze their baseline
+  while firing (no self-silencing), like the spike/collapse family, and
+  can ``nudge()`` the FallbackController back UP the ladder.
 """
 
 from __future__ import annotations
@@ -120,6 +134,19 @@ class DetectorConfig:
     headroom_critical_frac: float = 0.95
     headroom_sustain: int = 2
     headroom_min_obs: int = 2
+    # fidelity collapse (per-group relative compression error)
+    fidelity_alpha: float = 0.1
+    fidelity_factor: float = 3.0  # value > factor x own EWMA baseline
+    fidelity_floor: float = 0.05  # absolute floor: zero-baseline groups too
+    fidelity_critical: float = 0.5  # half the gradient mass lost => critical
+    fidelity_sustain: int = 2  # pages LONG before loss_plateau's 10+20 budget
+    fidelity_min_obs: int = 1
+    # EF memory blow-up (per-group error-feedback norm)
+    ef_alpha: float = 0.1
+    ef_factor: float = 5.0
+    ef_critical_factor: float = 25.0
+    ef_sustain: int = 2
+    ef_min_obs: int = 3
     # outer staleness (site-local steps / divergence budget during a
     # cross-site partition) — thresholdy, not statistical: the budget is
     # a hard contract, so the detector fires on fractions of it
@@ -365,6 +392,102 @@ class HbmHeadroomDetector(_Detector):
         return None
 
 
+class FidelityCollapseDetector(_Detector):
+    """Per-group compression-fidelity watch: the sampled relative error
+    (``FidelityEvent.rel_error``) leaving its own learned envelope. The
+    effective threshold is ``max(fidelity_factor × EWMA, fidelity_floor)``
+    — the floor catches exact (zero-baseline) groups suddenly
+    materializing error, where any multiplicative bound is vacuous.
+    Severity escalates to critical past the absolute ``fidelity_critical``
+    (the compressor is discarding a macroscopic share of the gradient).
+    Fires on a ``fidelity_sustain``-sample streak — an order of magnitude
+    earlier than the loss-plateau budget, by design: distortion leads loss
+    damage while the EF chain still absorbs it."""
+
+    name = "fidelity_collapse"
+
+    def __init__(self, cfg: DetectorConfig):
+        super().__init__(cfg.fidelity_sustain, cfg.cooldown)
+        self._cfg = cfg
+        self._ewma = Ewma(cfg.fidelity_alpha)
+
+    def _check(self, value: float):
+        cfg = self._cfg
+        if not math.isfinite(value) or value < 0.0:
+            return ("critical", float("inf"), "non-finite compression error")
+        base = self._ewma.mean
+        bound = cfg.fidelity_floor
+        if base is not None and self._ewma.n >= cfg.fidelity_min_obs:
+            bound = max(bound, cfg.fidelity_factor * base)
+        verdict = None
+        if value > bound:
+            if value >= cfg.fidelity_critical:
+                verdict = (
+                    "critical",
+                    cfg.fidelity_critical,
+                    f"rel compression error {value:.3g} >= "
+                    f"{cfg.fidelity_critical:g} absolute (gradient mass "
+                    f"being discarded)",
+                )
+            else:
+                verdict = (
+                    "warn",
+                    bound,
+                    f"rel compression error {value:.3g} > envelope "
+                    f"{bound:.3g} (baseline {base if base is not None else 0.0:.3g})",
+                )
+        # the collapsed samples must not poison the healthy baseline
+        if verdict is None:
+            self._ewma.update(value)
+        return verdict
+
+
+class EfBlowupDetector(_Detector):
+    """Per-group error-feedback blow-up watch: the EF memory norm
+    (``FidelityEvent.ef_norm``) running away from its own EWMA baseline —
+    the compressor is persistently dropping more than the next step
+    recovers, so the residual compounds instead of telescoping. Purely
+    multiplicative (EF norms are scale-full quantities); a dead-zero
+    baseline (exact groups) never fires."""
+
+    name = "ef_blowup"
+
+    def __init__(self, cfg: DetectorConfig):
+        super().__init__(cfg.ef_sustain, cfg.cooldown)
+        self._cfg = cfg
+        self._ewma = Ewma(cfg.ef_alpha)
+
+    def _check(self, value: float):
+        cfg = self._cfg
+        if not math.isfinite(value) or value < 0.0:
+            return ("critical", float("inf"), "non-finite EF memory norm")
+        base = self._ewma.mean
+        verdict = None
+        if (
+            base is not None
+            and base > 1e-12
+            and self._ewma.n >= cfg.ef_min_obs
+            and value > cfg.ef_factor * base
+        ):
+            if value > cfg.ef_critical_factor * base:
+                verdict = (
+                    "critical",
+                    cfg.ef_critical_factor * base,
+                    f"EF norm {value:.3g} > {cfg.ef_critical_factor:g}x "
+                    f"baseline {base:.3g} (residual compounding)",
+                )
+            else:
+                verdict = (
+                    "warn",
+                    cfg.ef_factor * base,
+                    f"EF norm {value:.3g} > {cfg.ef_factor:g}x baseline "
+                    f"{base:.3g}",
+                )
+        if verdict is None:
+            self._ewma.update(value)
+        return verdict
+
+
 class OuterStalenessDetector(_Detector):
     """Divergence-budget burn during a cross-site partition: the value is
     the staleness FRACTION (site-local steps / ``--max-local-steps``).
@@ -423,6 +546,11 @@ class HealthMonitor:
         self._slo = SloBurnRateDetector(self.config)
         self._hbm: Dict[Optional[int], HbmHeadroomDetector] = {}
         self._staleness: Dict[Optional[int], OuterStalenessDetector] = {}
+        # keyed per fidelity GROUP (shape-group/bucket), not per rank —
+        # the probe all-reduce-means the sample, so ranks agree and the
+        # interesting attribution axis is which layer group degraded
+        self._fidelity: Dict[str, FidelityCollapseDetector] = {}
+        self._ef: Dict[str, EfBlowupDetector] = {}
         self.alerts: List[AlertEvent] = []
 
     def _keep(self, alert: Optional[AlertEvent]) -> List[AlertEvent]:
@@ -514,6 +642,38 @@ class HealthMonitor:
                 rank=rank, step=step,
             )
         )
+
+    def observe_fidelity(
+        self,
+        group: str,
+        rel_error: float,
+        rank: Optional[int] = None,
+        step: Optional[int] = None,
+    ) -> List[AlertEvent]:
+        """Per-group compression-error watch; the alert message leads with
+        the group key so blame lands on the shape-group/bucket, mirroring
+        the per-edge bandwidth attribution."""
+        det = self._fidelity.setdefault(
+            group, FidelityCollapseDetector(self.config)
+        )
+        alert = det.observe(rel_error, rank=rank, step=step)
+        if alert is not None:
+            alert.message = f"group {group}: {alert.message}"
+        return self._keep(alert)
+
+    def observe_ef_norm(
+        self,
+        group: str,
+        ef_norm: float,
+        rank: Optional[int] = None,
+        step: Optional[int] = None,
+    ) -> List[AlertEvent]:
+        """Per-group EF blow-up watch; same group-first blame convention."""
+        det = self._ef.setdefault(group, EfBlowupDetector(self.config))
+        alert = det.observe(ef_norm, rank=rank, step=step)
+        if alert is not None:
+            alert.message = f"group {group}: {alert.message}"
+        return self._keep(alert)
 
     def fired_by_kind(self) -> Dict[str, int]:
         out: Dict[str, int] = {}

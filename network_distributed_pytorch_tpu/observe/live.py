@@ -268,6 +268,34 @@ def ingest_record(
                 registry.gauge(
                     metric, v, help=f"last sampled {field}", rank=rlabel
                 )
+    elif kind == "fidelity":
+        group = str(rec.get("group", "?"))
+        for field, metric, helptxt in (
+            ("rel_error", "live_fidelity_rel_error",
+             "last sampled per-group relative compression error"),
+            ("ef_norm", "live_ef_norm",
+             "last sampled per-group error-feedback memory norm"),
+            ("ef_growth", "live_ef_growth",
+             "per-group EF-norm growth since the previous sample"),
+            ("cosine_sim", "live_fidelity_cosine_sim",
+             "last sampled per-group compressed-vs-exact cosine similarity"),
+        ):
+            v = rec.get(field)
+            if isinstance(v, (int, float)):
+                registry.gauge(
+                    metric, v, help=helptxt, rank=rlabel, group=group
+                )
+        # whole-state drift scalars ride every group's event identically;
+        # ungrouped gauges (last writer wins, the values agree)
+        for field, metric, helptxt in (
+            ("replica_drift", "live_replica_drift",
+             "RMS per-worker parameter divergence from the replica mean"),
+            ("anchor_drift", "live_anchor_drift",
+             "mean-parameter distance from the last applied outer anchor"),
+        ):
+            v = rec.get(field)
+            if isinstance(v, (int, float)):
+                registry.gauge(metric, v, help=helptxt, rank=rlabel)
     elif kind == "memory":
         for field, metric in (
             ("bytes_in_use", "live_hbm_bytes"),
@@ -717,6 +745,18 @@ class LiveAggregator:
             if isinstance(gn, (int, float)):
                 fired += self.monitor.observe_grad_norm(
                     float(gn), rank=r, step=rec.get("step")
+                )
+        elif kind == "fidelity":
+            group = str(rec.get("group", "?"))
+            rel = rec.get("rel_error")
+            ef = rec.get("ef_norm")
+            if isinstance(rel, (int, float)):
+                fired += self.monitor.observe_fidelity(
+                    group, float(rel), rank=r, step=rec.get("step")
+                )
+            if isinstance(ef, (int, float)):
+                fired += self.monitor.observe_ef_norm(
+                    group, float(ef), rank=r, step=rec.get("step")
                 )
         elif kind == "memory":
             in_use = rec.get("bytes_in_use")

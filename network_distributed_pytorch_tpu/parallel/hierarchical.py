@@ -153,6 +153,73 @@ class HierarchicalReducer:
             )
         return state, out, memory, inner_bits + outer_bits
 
+    def compression_error(
+        self, state, send: PyTree, axis_name: AxisName = None
+    ) -> jax.Array:
+        """Relative compression error of the OUTER reducer — the only lossy
+        stage (the inner exact mean is bitwise). Delegates to the outer
+        reducer's own collective-free probe, so a hierarchical rung reports
+        its slow-fabric distortion rather than silently reporting zero (or,
+        worse, an inner-stage number that is zero by construction)."""
+        del axis_name  # the probe is collective-free on either fabric
+        if hasattr(self.outer, "compression_error"):
+            return self.outer.compression_error(state, send, None)
+        return jnp.zeros((), jnp.float32)
+
+    # ---- fidelity --------------------------------------------------------
+
+    def _inner_groups(self, grads_template: PyTree):
+        """(group, tag) pairs for the exact inner payload — mirrors the
+        dtype grouping :meth:`ledger_entries` prices (``inner.grads`` /
+        ``inner.grads.d{gi}``) so the fidelity↔ledger join stays exact."""
+        leaves = jax.tree_util.tree_leaves(grads_template)
+        groups: dict = {}
+        for i, leaf in enumerate(leaves):
+            groups.setdefault(str(jnp.dtype(leaf.dtype)), []).append(i)
+        multi = len(groups) > 1
+        return [
+            (f"inner.grads.d{gi}" if multi else "inner.grads", idx)
+            for gi, (_, idx) in enumerate(sorted(groups.items()))
+        ]
+
+    def fidelity_group_tags(self, grads_template: PyTree) -> dict:
+        """Static ``fidelity group -> wire-ledger tag`` map: the exact inner
+        payload (group == tag, zero error by construction) plus the outer
+        reducer's own groups re-keyed under ``outer.`` — matching the
+        ``outer.{tag}`` re-tagging :meth:`ledger_entries` applies."""
+        tags = {name: name for name, _ in self._inner_groups(grads_template)}
+        if hasattr(self.outer, "fidelity_group_tags"):
+            for g, t in self.outer.fidelity_group_tags(grads_template).items():
+                tags[f"outer.{g}"] = f"outer.{t}"
+        return tags
+
+    def fidelity_stats(
+        self,
+        state,
+        send: PyTree,
+        memories: Optional[PyTree] = None,
+        axis_name: AxisName = None,
+    ) -> dict:
+        """Per-group fidelity diagnostics (health-probe shape, one entry per
+        :meth:`fidelity_group_tags` key): the inner exact groups are zeros /
+        ones by construction; the outer groups are the outer reducer's OWN
+        collective-free diagnostics re-keyed under ``outer.``."""
+        del axis_name
+        stats: dict = {
+            name: {
+                "rel_error": jnp.zeros((), jnp.float32),
+                "cosine_sim": jnp.ones((), jnp.float32),
+                "ef_norm": jnp.zeros((), jnp.float32),
+                "quantized_share": jnp.zeros((), jnp.float32),
+            }
+            for name, _ in self._inner_groups(send)
+        }
+        if hasattr(self.outer, "fidelity_stats"):
+            outer = self.outer.fidelity_stats(state, send, memories, None)
+            for g, v in outer.items():
+                stats[f"outer.{g}"] = v
+        return stats
+
     # ---- analytics -------------------------------------------------------
 
     def bits_by_fabric(self, grads_template: PyTree) -> dict:
@@ -209,6 +276,53 @@ class HierarchicalReducer:
                 dataclasses.replace(e, tag=f"outer.{e.tag}", axis=self.outer_axis)
             )
         return entries
+
+
+def replica_drift_stats(params: PyTree, anchors: Optional[PyTree] = None) -> dict:
+    """Replica/anchor drift for the fidelity plane, from a per-worker
+    parameter tree (leading ``num_devices`` axis, the
+    :class:`HierarchicalState.params` / ``LocalSGDState.params`` layout):
+
+    - ``replica_drift``: RMS divergence of the per-worker copies from their
+      mean, relative to the mean's norm — how far sites/replicas have walked
+      apart since the last sync (identically zero for exact data-parallel
+      states, where every copy is the same buffer broadcast).
+    - ``anchor_drift``: distance of the mean params from ``anchors`` (the
+      last applied outer update), relative to the anchor norm — how much
+      displacement the next outer sync must carry. Zero when ``anchors`` is
+      ``None`` (no outer loop to drift from).
+
+    Pure local math over replicated/host-visible trees — collective-free,
+    jit-safe, scalars only."""
+    leaves = jax.tree_util.tree_leaves(params)
+    if not leaves:
+        return {
+            "replica_drift": jnp.zeros((), jnp.float32),
+            "anchor_drift": jnp.zeros((), jnp.float32),
+        }
+    eps = jnp.float32(1e-30)
+    dev_sq = jnp.zeros((), jnp.float32)
+    mean_sq = jnp.zeros((), jnp.float32)
+    means = []
+    for leaf in leaves:
+        f = leaf.astype(jnp.float32)
+        mu = jnp.mean(f, axis=0)
+        means.append(mu)
+        dev_sq = dev_sq + jnp.sum(jnp.square(f - mu[None])) / f.shape[0]
+        mean_sq = mean_sq + jnp.sum(jnp.square(mu))
+    replica = jnp.sqrt(dev_sq) / jnp.maximum(jnp.sqrt(mean_sq), eps)
+    if anchors is None:
+        anchor = jnp.zeros((), jnp.float32)
+    else:
+        a_leaves = jax.tree_util.tree_leaves(anchors)
+        diff_sq = jnp.zeros((), jnp.float32)
+        a_sq = jnp.zeros((), jnp.float32)
+        for mu, a in zip(means, a_leaves):
+            af = a.astype(jnp.float32)
+            diff_sq = diff_sq + jnp.sum(jnp.square(mu - af))
+            a_sq = a_sq + jnp.sum(jnp.square(af))
+        anchor = jnp.sqrt(diff_sq) / jnp.maximum(jnp.sqrt(a_sq), eps)
+    return {"replica_drift": replica, "anchor_drift": anchor}
 
 
 # ---------------------------------------------------------------------------

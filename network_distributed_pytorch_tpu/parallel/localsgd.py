@@ -728,3 +728,34 @@ def make_streaming_diloco_train_fn(
         fns, bits_per_phase, num_fragments, sync_every, mesh, axis_name,
         reducer, {},
     )
+
+
+def drift_stats(state) -> dict:
+    """Replica/anchor drift scalars for the fidelity plane
+    (:mod:`..observe.fidelity`), dispatched on the round-carry type:
+
+    - :class:`LocalSGDState`: params are genuinely per-worker between syncs,
+      so ``replica_drift`` is measured; there is no outer anchor
+      (``anchor_drift`` is zero).
+    - :class:`StreamingDiLoCoState`: per-worker params AND a replicated
+      per-leaf anchor tree — both drifts are measured; ``anchor_drift`` is
+      the displacement the next fragment syncs must carry.
+    - :class:`DiLoCoState`: params are replicated at every observable round
+      boundary (the sync re-snaps them), so both drifts are identically
+      zero there — mid-round divergence is invisible outside the compiled
+      scan by design. The hierarchical carry
+      (:func:`..parallel.hierarchical.replica_drift_stats` on
+      ``HierarchicalState``) is the surface that exposes live cross-site
+      divergence.
+
+    Collective-free local math; same ``{replica_drift, anchor_drift}``
+    schema as :func:`~.hierarchical.replica_drift_stats`.
+    """
+    from .hierarchical import replica_drift_stats
+
+    if isinstance(state, LocalSGDState):
+        return replica_drift_stats(state.params)
+    if isinstance(state, StreamingDiLoCoState):
+        return replica_drift_stats(state.params, state.anchors)
+    zero = jnp.zeros((), jnp.float32)
+    return {"replica_drift": zero, "anchor_drift": zero}

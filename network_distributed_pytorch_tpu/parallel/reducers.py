@@ -242,6 +242,72 @@ class ExactReducer:
         del state, send, axis_name
         return jnp.zeros((), jnp.float32)
 
+    def fidelity_group_tags(self, grads_template: PyTree) -> "dict":
+        """Static map ``fidelity group key -> wire-ledger tag`` for this
+        layout. Exact reductions group per backward-order bucket, and the
+        group key IS the ledger tag (``grads`` / ``grads.b{i}``) — the
+        fidelity ledger and the wire ledger join on identical strings, so
+        every :class:`~..observe.events.FidelityEvent` this reducer feeds is
+        byte-priced by ``ledger_entries`` in the same step."""
+        leaves = jax.tree_util.tree_leaves(grads_template)
+        if not leaves:
+            return {}
+        if self.packed and self.bucket_bytes is not None:
+            return {
+                f"grads.b{bi}": f"grads.b{bi}"
+                for bi in range(len(self._buckets(leaves)))
+            }
+        return {"grads": "grads"}
+
+    def fidelity_stats(
+        self,
+        state: dict,
+        send: PyTree,
+        memories: Optional[PyTree] = None,
+        axis_name: Optional[str] = None,
+    ) -> "dict":
+        """Per-group fidelity diagnostics for the health probe: one entry per
+        :meth:`fidelity_group_tags` key, each a dict of scalar arrays
+        (``rel_error``, ``cosine_sim``, ``ef_norm``, ``quantized_share``).
+
+        An exact reduction loses nothing by construction, so ``rel_error`` is
+        identically zero and ``cosine_sim`` identically one per group; the
+        per-group EF norm is measured from ``memories`` anyway (the trainer
+        contract keeps it zero) so a violation shows up instead of being
+        assumed away. Collective-free: pure local norms, jit-safe with
+        static group keys."""
+        del state, axis_name
+        leaves = jax.tree_util.tree_leaves(send)
+        mem_leaves = (
+            jax.tree_util.tree_leaves(memories) if memories is not None else None
+        )
+
+        def _ef(idxs) -> jax.Array:
+            if mem_leaves is None:
+                return jnp.zeros((), jnp.float32)
+            sq = sum(
+                jnp.sum(jnp.square(mem_leaves[i].astype(jnp.float32)))
+                for i in idxs
+            )
+            return jnp.sqrt(sq)
+
+        def _group(idxs) -> dict:
+            return {
+                "rel_error": jnp.zeros((), jnp.float32),
+                "cosine_sim": jnp.ones((), jnp.float32),
+                "ef_norm": _ef(idxs),
+                "quantized_share": jnp.zeros((), jnp.float32),
+            }
+
+        if not leaves:
+            return {}
+        if self.packed and self.bucket_bytes is not None:
+            return {
+                f"grads.b{bi}": _group(idxs)
+                for bi, idxs in enumerate(self._buckets(leaves))
+            }
+        return {"grads": _group(list(range(len(leaves))))}
+
     def ledger_entries(self, grads_template: PyTree, axis: str = "", n_workers: int = 1):
         """Wire-ledger itemization of one exact reduction: the whole gradient
         as one flat-packed all-reduce (or, unpacked, one per-tensor all-reduce
@@ -572,6 +638,115 @@ class PowerSGDReducer:
         return jnp.sqrt(_sq(residual)) / jnp.maximum(
             jnp.sqrt(_sq(send)), jnp.float32(1e-30)
         )
+
+    # ---- fidelity --------------------------------------------------------
+
+    def _fidelity_group_names(
+        self, metas: List[_MatrixMeta], groups: List[List[int]]
+    ) -> List[str]:
+        """One stable display key per shape bucket: ``powersgd.g{k}:{n}x{m}r{r}``
+        in :meth:`_shape_groups` insertion order — the same batching the
+        compressed hot path actually runs, so a per-group blow-up blames the
+        exact batched matmul that produced it."""
+        names = []
+        for k, poss in enumerate(groups):
+            meta = metas[poss[0]]
+            names.append(f"powersgd.g{k}:{meta.n}x{meta.m}r{meta.r}")
+        return names
+
+    def fidelity_group_tags(self, grads_template: PyTree) -> "dict":
+        """Static map ``fidelity group key -> wire-ledger tag``. Compressed
+        shape groups all ride the single flat-packed P collective, so they
+        map to ``powersgd.P`` (byte-priced by :meth:`ledger_entries` every
+        step); the uncompressed fallthrough maps to ``powersgd.rank1``. The
+        fidelity plane keeps per-group resolution while still joining the
+        wire ledger tag-exactly."""
+        leaves = jax.tree_util.tree_leaves(grads_template)
+        metas = self._metas(leaves)
+        groups = self._shape_groups(metas)
+        tags = {
+            name: "powersgd.P"
+            for name in self._fidelity_group_names(metas, groups)
+        }
+        rank1_idx, _ = self._split(leaves)
+        if rank1_idx:
+            tags["powersgd.rank1"] = "powersgd.rank1"
+        return tags
+
+    def fidelity_stats(
+        self,
+        state: PowerSGDState,
+        send: PyTree,
+        memories: Optional[PyTree] = None,
+        axis_name: Optional[str] = None,
+    ) -> "dict":
+        """Per-shape-group fidelity diagnostics for the health probe: one
+        entry per :meth:`fidelity_group_tags` key, each a dict of scalar
+        arrays (``rel_error``, ``cosine_sim``, ``ef_norm``,
+        ``quantized_share``).
+
+        Like :meth:`compression_error`, runs ONE diagnostic compression round
+        with ``axis_name=None`` (collective-free: the P/Q exchanges collapse
+        to local matmuls) and reads the per-leaf residual off ``new_memory``;
+        the state advance is discarded so the probe never perturbs the
+        warm-start Q buffer. Per group: relative L2 error
+        ``‖M − P̂Qᵀ‖/‖M‖``, cosine similarity ``⟨M, P̂Qᵀ⟩/(‖M‖·‖P̂Qᵀ‖)``,
+        the EF-memory norm over the group's leaves (from ``memories`` when
+        given), and the bf16-wire quantization share (1 when
+        ``compression_dtype`` narrows the wire, else 0 — static by config).
+        The rank-1 fallthrough group is exact by construction."""
+        leaves = jax.tree_util.tree_leaves(send)
+        metas = self._metas(leaves)
+        groups = self._shape_groups(metas)
+        names = self._fidelity_group_names(metas, groups)
+        _, _, residual_tree, _ = self.reduce(state, send, axis_name)
+        res_leaves = jax.tree_util.tree_leaves(residual_tree)
+        mem_leaves = (
+            jax.tree_util.tree_leaves(memories) if memories is not None else None
+        )
+        quantized = jnp.float32(
+            1.0 if self.compression_dtype is not None else 0.0
+        )
+
+        def _sq(arrs) -> jax.Array:
+            if not arrs:
+                return jnp.zeros((), jnp.float32)
+            return sum(jnp.sum(jnp.square(a.astype(jnp.float32))) for a in arrs)
+
+        def _ef(idxs) -> jax.Array:
+            if mem_leaves is None:
+                return jnp.zeros((), jnp.float32)
+            return jnp.sqrt(_sq([mem_leaves[i] for i in idxs]))
+
+        eps = jnp.float32(1e-30)
+        stats: dict = {}
+        for name, poss in zip(names, groups):
+            idxs = [metas[p].leaf_index for p in poss]
+            sends = [leaves[i].astype(jnp.float32) for i in idxs]
+            outs = [
+                leaves[i].astype(jnp.float32)
+                - res_leaves[i].astype(jnp.float32)
+                for i in idxs
+            ]
+            send_norm = jnp.sqrt(_sq(sends))
+            out_norm = jnp.sqrt(_sq(outs))
+            res_norm = jnp.sqrt(_sq([res_leaves[i] for i in idxs]))
+            dot = sum(jnp.sum(s * o) for s, o in zip(sends, outs))
+            stats[name] = {
+                "rel_error": res_norm / jnp.maximum(send_norm, eps),
+                "cosine_sim": dot / jnp.maximum(send_norm * out_norm, eps),
+                "ef_norm": _ef(idxs),
+                "quantized_share": quantized,
+            }
+        rank1_idx, _ = self._split(leaves)
+        if rank1_idx:
+            stats["powersgd.rank1"] = {
+                "rel_error": jnp.zeros((), jnp.float32),
+                "cosine_sim": jnp.ones((), jnp.float32),
+                "ef_norm": _ef(rank1_idx),
+                "quantized_share": quantized,
+            }
+        return stats
 
     def _reduce(
         self,

@@ -535,7 +535,12 @@ def make_health_fn(
     """The training-health probe behind ``TrainHealthEvent``: a separately
     jitted ``(state, batch) -> {grad_norm, ef_memory_norm,
     powersgd_rel_error, loss}`` dispatch, called every ``health_every``
-    steps by the training loops — OFF the hot path.
+    steps by the training loops — OFF the hot path. Reducers exposing
+    ``fidelity_stats`` add a nested ``"fidelity"`` sub-dict — per
+    shape-group/bucket ``{rel_error, cosine_sim, ef_norm,
+    quantized_share}`` scalars with static group keys that join the wire
+    ledger's tags (``FidelityEvent``, :mod:`..observe.fidelity`); the flat
+    legacy keys are unchanged.
 
     Sampling cost (documented in DESIGN.md): one extra forward+backward on
     the probe batch (the gradient is recomputed — the compiled step's
@@ -568,12 +573,25 @@ def make_health_fn(
             rel = reducer.compression_error(state.reducer_state, send, None)
         else:
             rel = jnp.zeros((), jnp.float32)
-        return {
+        out = {
             "grad_norm": jnp.sqrt(all_reduce_mean(gn2, ax)),
             "ef_memory_norm": jnp.sqrt(all_reduce_mean(en2, ax)),
             "powersgd_rel_error": all_reduce_mean(rel, ax),
             "loss": all_reduce_mean(loss, ax),
         }
+        # per-group fidelity diagnostics (observe.fidelity): same
+        # collective-free diagnostic round, broken out per shape-group /
+        # bucket with static keys, each scalar averaged across workers —
+        # nested so the flat keys above keep their exact legacy meaning
+        if hasattr(reducer, "fidelity_stats"):
+            fid = reducer.fidelity_stats(
+                state.reducer_state, send, state.memories, None
+            )
+            out["fidelity"] = {
+                group: {k: all_reduce_mean(v, ax) for k, v in vals.items()}
+                for group, vals in fid.items()
+            }
+        return out
 
     if mesh is None:
         # lint: no-donate — diagnostic probe reads the LIVE training state

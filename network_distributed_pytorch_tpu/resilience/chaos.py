@@ -58,6 +58,13 @@ Fault kinds and where they bite:
                        by ``factor`` (default 1000) — an optimizer blow-up
                        precursor the live plane's EWMA spike detector must
                        catch and alert on (observe.health)
+``fidelity_degrade``   ONE fidelity group's sampled relative compression
+                       error is multiplied by ``factor`` (default 1000);
+                       ``group`` names the shape-group/bucket key
+                       (``FidelityEvent.group``) to degrade — the phase-13
+                       game day's fault: the live plane, the report table,
+                       and the controller nudge must each blame exactly
+                       that group (observe.fidelity)
 ``oom``                the step dies with a ``RESOURCE_EXHAUSTED``-shaped
                        allocator error (HBM exhausted mid-step) — the
                        guarded step's OOM forensics path must dump
@@ -114,7 +121,7 @@ COMM_FAULTS = (
     "comm_throttle", "comm_stall", "comm_flap", "comm_slow_edge",
     "comm_partition", "comm_heal",
 )
-HEALTH_FAULTS = ("grad_spike",)
+HEALTH_FAULTS = ("grad_spike", "fidelity_degrade")
 # memory faults bite at the step boundary like STEP_FAULTS, but are their
 # own group so jax-free workers (the toy game-day worker) can pop them
 # without also claiming the transient/NaN kinds
@@ -152,6 +159,7 @@ INJECTION_SITES: Dict[str, str] = {
     "comm_partition": "comm-hook",      # CommFaultInjector fence hook
     "comm_heal": "comm-hook",           # CommFaultInjector fence hook
     "grad_spike": "health-probe",       # health sampler (TrainHealthEvent)
+    "fidelity_degrade": "health-probe", # health sampler (FidelityEvent group)
     "oom": "step",                      # ChaosStep (allocator-death branch)
 }
 

@@ -290,16 +290,27 @@ class FallbackController:
           (``bandwidth_collapse`` / ``step_time_drift``), descends ONE
           rung immediately — the same single-recompile budget as a
           boundary decision, just paid early.
+        - A fidelity-shaped alert (``fidelity_collapse`` / ``ef_blowup``,
+          any severity) ASCENDS one rung immediately: the gradient plane
+          is reporting that the current rung's compression is destroying
+          the update, so the fix is MORE fidelity (more bytes), the exact
+          opposite of every comm-shaped verdict. A controller already at
+          the top rung holds (there is no higher-fidelity config to buy).
         - Any other ``warn`` alert pre-charges the degraded streak: the
           next boundary ``observe`` needs one fewer degraded epoch to
           descend. No decision is returned.
-        - At most one nudge-descend per epoch (the boundary hysteresis
-          still owns the cadence), and after a nudge-descend the SAME
+        - At most one nudge per epoch in either direction (the boundary
+          hysteresis still owns the cadence), and after a nudge the SAME
           epoch's boundary ``observe`` is a no-op — the epoch's decision
           budget is spent. ``nudged_epoch`` exposes which epoch that was.
         """
         if self._nudged_epoch == epoch:
             return None
+        if alert in ("fidelity_collapse", "ef_blowup"):
+            if self.index <= 0:
+                return None
+            self._nudged_epoch = epoch
+            return self._move(-1, f"alert:{alert}:{severity}", epoch)
         immediate = severity == "critical" or alert in (
             "bandwidth_collapse",
             "step_time_drift",

@@ -16,7 +16,10 @@ cd "$(dirname "$0")"
 
 # observability lint: no bare print() outside the observe stdout sink —
 # every human banner must flow through telemetry so the console and the
-# structured JSONL log cannot drift apart
+# structured JSONL log cannot drift apart. The same script enforces the
+# observe/ clock discipline (time.monotonic() for durations), covering
+# observe/fidelity.py with no carve-outs: fidelity stats are keyed by
+# step index and joined to the wire ledger by tag, never by timestamp.
 python scripts/lint_no_print.py
 
 # donation lint: every hot jax.jit in experiments//parallel//serving/ must
@@ -79,6 +82,14 @@ set -e
 # events, chips leased from the fleet scheduler), the post-scale trickle
 # must land back inside the SLO, every request must finish (zero lost),
 # and the drained pool must scale back down with every lease returned.
+# The thirteenth phase is the gradient-fidelity game day: a chaos
+# fidelity_degrade latches a x1000 compression error onto ONE wire-ledger
+# bucket, which must be blamed at the exact shape-group by live alert,
+# report fidelity table, and an alert-triggered controller ascend
+# independently (the fidelity page landing before any loss plateau); the
+# rung switch splits artifacts/fidelity_frontier.json into >= 2
+# accuracy-per-byte segments, and the advisory gate at the end reads the
+# new fidelity_rel_error metric off the recorded report.
 # Advisory because shared CI boxes have
 # noisy step times; run gate.py without --advisory on dedicated perf
 # hardware to make it blocking.

@@ -54,6 +54,11 @@ Metrics compared (only those present in BOTH report and baseline):
   peak concurrently-admitted requests, paged over dense, at equal KV
   HBM; also gated against the ABSOLUTE ``kv_capacity_ratio_target``
   floor bench.py records — 2x, the PR 19 guarantee class)
+- ``fidelity_rel_error``     lower is better (report ``fidelity``
+  section — the worst shape-group's MEAN relative compression error
+  from the gradient-fidelity audit, ``observe.fidelity``; exact
+  reducers report an identically-zero value, so 0 records like
+  alerts_fired and any drift upward is a fidelity regression)
 
 A metric the current report carries but a stale baseline does not gets a
 clearly-labeled ``missing_baseline`` ADVISORY verdict (never a
@@ -161,6 +166,13 @@ METRICS: Dict[str, str] = {
     # >= 2x floor via kv_capacity_ratio_target)
     "serving_tokens_per_s_per_chip": "higher",
     "kv_capacity_ratio": "higher",
+    # gradient-fidelity audit (report ``fidelity.rel_error``,
+    # observe.fidelity): the worst shape-group's mean relative
+    # compression error over the run's health-probe samples. Zero IS the
+    # healthy value (exact reducers), so 0 records like alerts_fired; a
+    # rung/config change that quietly degrades what the compressed wire
+    # delivers regresses here even while throughput metrics hold
+    "fidelity_rel_error": "lower",
 }
 
 # the calibration bound DESIGN.md states for cost-model predictions: a
@@ -294,6 +306,18 @@ def extract_metrics(doc: Dict) -> Dict[str, float]:
             v = src.get(key)
             if isinstance(v, (int, float)) and v == v and v > 0:
                 out.setdefault(key, float(v))
+    # gradient-fidelity scalar: nested under the report's "fidelity"
+    # section (scripts/report.py via observe.fidelity.fidelity_summary),
+    # flat in bench baselines. Zero (exact reducers) is the healthy
+    # value, so >= 0 records like alerts_fired
+    fid = doc.get("fidelity")
+    if isinstance(fid, dict):
+        v = fid.get("rel_error")
+        if isinstance(v, (int, float)) and v == v and v >= 0:
+            out["fidelity_rel_error"] = float(v)
+    v = doc.get("fidelity_rel_error")
+    if isinstance(v, (int, float)) and v == v and v >= 0:
+        out.setdefault("fidelity_rel_error", float(v))
     return out
 
 
@@ -718,6 +742,8 @@ def main(argv=None) -> int:
                 f" baseline attests '{v['baseline']}' — every relative"
                 f" comparison above crosses hardware -> {status}"
                 + ("" if v["regressed"] else " (pass --strict-device to fail)")
+                + "; per-round device provenance is consolidated in"
+                " artifacts/bench_history.json (scripts/bench_history.py)"
             )
             continue
         if v.get("missing_baseline"):

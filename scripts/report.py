@@ -1636,10 +1636,74 @@ def render_memory_section(memory: Dict) -> List[str]:
     return lines
 
 
+def render_fidelity_section(fid: Optional[Dict]) -> List[str]:
+    """The gradient-fidelity table: one row per shape group / bucket with
+    its wire-ledger tag, mean/max relative error, worst cosine similarity,
+    and EF-memory high-water marks — blame lands on ``worst_group``, the
+    same key the live ``fidelity_collapse`` alert names. Empty when the
+    run emitted no fidelity samples (exact runs still emit zeros, so an
+    absent section means the probe never ran, not that fidelity was
+    perfect)."""
+    if not fid or not fid.get("samples"):
+        return []
+    lines = ["", "gradient fidelity (per shape group / bucket)",
+             "-" * 44]
+    lines.append(
+        f"  {'group':<26} {'ledger tag':<16} {'mean err':>9} {'max err':>9}"
+        f" {'min cos':>8} {'max EF':>9} {'quantized':>9}"
+    )
+    for name in sorted(fid["groups"]):
+        g = fid["groups"][name]
+        lines.append(
+            f"  {name:<26} {g['tag']:<16} {g['mean_rel_error']:>9.4g}"
+            f" {g['max_rel_error']:>9.4g} {g['min_cosine_sim']:>8.4f}"
+            f" {g['max_ef_norm']:>9.4g}"
+            f" {100 * g['quantized_share']:>8.1f}%"
+        )
+    worst = fid.get("worst_group")
+    if worst:
+        lines.append(
+            f"  worst group: {worst} (mean rel error"
+            f" {fid['rel_error']:.4g} — the gate's fidelity_rel_error,"
+            " lower = better)"
+        )
+    rd, ad = fid.get("replica_drift") or {}, fid.get("anchor_drift") or {}
+    if rd.get("max") or ad.get("max"):
+        lines.append(
+            f"  replica drift last {rd.get('last', 0.0):.4g} / max"
+            f" {rd.get('max', 0.0):.4g}; anchor drift last"
+            f" {ad.get('last', 0.0):.4g} / max {ad.get('max', 0.0):.4g}"
+        )
+    return lines
+
+
+def render_frontier_section(frontier: Optional[Dict]) -> List[str]:
+    """The accuracy-per-byte frontier: per-rung loss bought per wire byte
+    spent (empty when the run logged no steps)."""
+    if not frontier or not frontier.get("rungs"):
+        return []
+    lines = ["", "accuracy-per-byte frontier (loss vs ledger bytes by rung)",
+             "-" * 57]
+    for r in frontier["rungs"]:
+        lines.append(
+            f"  {r['rung']:<12} steps {r['start_step']:>4}-{r['end_step']:<4}"
+            f" loss {r['loss_start']:.4f} -> {r['loss_end']:.4f}"
+            f"  {_fmt_bytes(r['bytes']):>12}"
+            f"  {r['loss_drop_per_gb']:+.3f} loss/GB"
+        )
+    lines.append(
+        f"  total {_fmt_bytes(frontier['total_bytes'])} wire ->"
+        f" final loss {frontier['final_loss']:.4f}"
+        f" over {frontier['steps']} step(s)"
+    )
+    return lines
+
+
 # Chrome-trace lanes, one pid per rank (Perfetto renders pid -1, the
 # supervisor, as its own process track)
 _TID_SPANS, _TID_STEPS, _TID_COLLECTIVES, _TID_FAILURES = 0, 1, 2, 3
 _TID_MEMORY = 4
+_TID_FIDELITY = 5
 
 
 def chrome_trace(events: List[Dict]) -> Dict:
@@ -1725,6 +1789,23 @@ def chrome_trace(events: List[Dict]) -> Dict:
                 "pid": pid, "tid": _TID_MEMORY, "ts": us(e["t_run"]),
                 "args": args,
             })
+        elif kind == "fidelity" and isinstance(
+            e.get("rel_error"), (int, float)
+        ):
+            # one Perfetto counter track per fidelity group: relative
+            # compression error (and EF norm) over run time — the visual
+            # twin of the report's fidelity table, so a degraded bucket
+            # is a visible step change on its own track
+            pids[pid] = "supervisor" if pid < 0 else f"rank {pid}"
+            args = {"rel_error": e["rel_error"]}
+            if isinstance(e.get("ef_norm"), (int, float)):
+                args["ef_norm"] = e["ef_norm"]
+            trace_events.append({
+                "ph": "C", "cat": "fidelity",
+                "name": f"fidelity {e.get('group', '?')}",
+                "pid": pid, "tid": _TID_FIDELITY, "ts": us(e["t_run"]),
+                "args": args,
+            })
     # Perfetto flow arrows across rank tracks at each collective: every
     # step's exposed-comm slices are ring-chained rank r -> rank r+1 (the
     # same (src, dst) charging the fabric matrix uses), so the UI draws
@@ -1776,7 +1857,7 @@ def chrome_trace(events: List[Dict]) -> Dict:
         for tid, tname in (
             (_TID_SPANS, "spans"), (_TID_STEPS, "steps"),
             (_TID_COLLECTIVES, "collectives"), (_TID_FAILURES, "failures"),
-            (_TID_MEMORY, "memory"),
+            (_TID_MEMORY, "memory"), (_TID_FIDELITY, "fidelity"),
         ):
             meta.append({
                 "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
@@ -1877,6 +1958,19 @@ def run_report(
     sections.extend(render_memory_section(memory))
     comm_buckets = bucket_attribution(bandwidth, overlap)
     sections.extend(render_bucket_section(comm_buckets))
+
+    # the gradient-fidelity plane: per-group compression audit joined
+    # against the wire ledger's tags, plus the accuracy-per-byte frontier
+    # (loss bought per ledger byte, segmented by fallback-ladder rung)
+    from network_distributed_pytorch_tpu.observe import (
+        fidelity as fidelity_mod,
+    )
+
+    fid = fidelity_mod.fidelity_summary(merged.events)
+    frontier = fidelity_mod.frontier_from_events(merged.events)
+    sections.extend(render_fidelity_section(fid))
+    sections.extend(render_frontier_section(frontier))
+
     hierarchy = hierarchy_summary(bandwidth)
     sections.extend(render_hierarchy_section(hierarchy))
     partitions = partition_summary(merged.events)
@@ -2035,6 +2129,16 @@ def run_report(
         # CPU run keeps predicted and marks measured unavailable); the
         # gate's scalar is memory.hbm_peak_bytes (lower = leaner)
         "memory": memory,
+        # the gradient-fidelity audit (None when the probe never sampled):
+        # per-group compression error keyed by the SAME shape-group /
+        # bucket keys the wire ledger prices; the gate's scalar is
+        # fidelity.rel_error — the worst group's mean relative error
+        # (lower = higher fidelity)
+        "fidelity": fid if fid.get("samples") else None,
+        # the accuracy-per-byte frontier: loss trajectory joined against
+        # cumulative ledger bytes per fallback-ladder rung (also persisted
+        # next to --json-out as fidelity_frontier.json)
+        "fidelity_frontier": frontier if frontier.get("steps") else None,
     }
     return text, report
 
@@ -2057,6 +2161,7 @@ _COMPARE_ROWS = (
     ("bandwidth.total.achieved_bytes_per_s", "achieved bw", _fmt_rate),
     ("mfu_headline", "MFU headline", lambda v: f"{v:.4f}"),
     ("memory.hbm_peak_bytes", "HBM peak", _fmt_bytes),
+    ("fidelity.rel_error", "fidelity rel err", lambda v: f"{v:.4g}"),
     ("alerts.fired", "alerts fired", lambda v: f"{v:.0f}"),
     ("policy.descends", "policy descends", lambda v: f"{v:.0f}"),
     ("recovery_latency_s", "recovery latency", lambda v: f"{v:.2f} s"),
@@ -2407,6 +2512,13 @@ def main(argv=None) -> int:
             )
             fabric_mod.save_matrix(report["fabric_matrix"], matrix_path)
             sys.stderr.write(f"# report: wrote {matrix_path}\n")
+        if report.get("fidelity_frontier"):
+            frontier_path = os.path.join(
+                os.path.dirname(json_out) or ".", "fidelity_frontier.json"
+            )
+            with open(frontier_path, "w") as f:
+                json.dump(report["fidelity_frontier"], f, indent=1)
+            sys.stderr.write(f"# report: wrote {frontier_path}\n")
 
     for path in args.logs:
         events, skipped = load_events_counted(path)

@@ -60,6 +60,18 @@ memory section with a MEASURED peak, and a rerun with the footprint
 doubled (``--hbm-mult 2.0``) gated against the first run's peak must
 make ``gate.py`` exit nonzero on ``hbm_peak_bytes``.
 
+A thirteenth phase is the gradient-FIDELITY game day: a 2-rank run with
+the fidelity plane on (two wire-ledger buckets, each a fidelity group
+keyed by its own ``toy.grads.b{k}`` tag) starts pinned on the compress
+rung and takes a chaos ``fidelity_degrade`` that latches a x1000
+relative-error multiplier onto ONE bucket; the degraded bucket must be
+blamed three independent ways — a ``fidelity_collapse`` alert naming the
+group (before any loss-plateau page), the report's fidelity table's
+``worst_group``, and an ``alert:fidelity_collapse`` controller ascend —
+while the rung switch splits ``artifacts/fidelity_frontier.json`` into
+>= 2 accuracy-per-byte segments and ``gate.py`` fails the degraded
+``fidelity_rel_error`` against a clean baseline.
+
 A third phase supervises a 2-rank spool-SERVING fleet
 (``tests/toy_serving_worker.py`` over the real ``serving/`` request
 lifecycle + FileSpool) into ``artifacts/toy_run_serve/``: rank 1 kills
@@ -2064,6 +2076,260 @@ def main(argv=None) -> int:
         f" burst worst {max(burst_tot):.2f}s vs post-scale worst"
         f" {max(tail_tot):.2f}s <= SLO {storm_slo_s}s)"
         f" report -> {storm_json}\n"
+    )
+
+    # --- phase 13: the gradient-fidelity game day ------------------------
+    # A 2-rank run with the fidelity plane on (--fidelity-groups 2: two
+    # wire-ledger buckets, each a fidelity group keyed by its OWN
+    # ``toy.grads.b{k}`` tag — the identity join) starts pinned on the
+    # compress rung (--controller-start 1) and takes a chaos
+    # ``fidelity_degrade`` that LATCHES a x1000 relative-error multiplier
+    # onto bucket toy.grads.b1 on every rank. The degraded bucket must be
+    # blamed three independent ways: the supervisor-side
+    # FidelityCollapseDetector fires a ``fidelity_collapse`` alert whose
+    # message names the group (BEFORE any loss-plateau page — distortion
+    # leads loss damage), the merged report's fidelity table ranks it
+    # ``worst_group`` while the clean bucket stays inside its envelope,
+    # and the alerts.jsonl feedback leg nudges the FallbackController
+    # back UP the ladder with an ``alert:fidelity_collapse`` trigger.
+    # The rung switch splits the accuracy-per-byte frontier
+    # (artifacts/fidelity_frontier.json) into >= 2 byte-priced segments,
+    # every fidelity group joins the wire ledger by tag, and gate.py must
+    # FAIL the degraded ``fidelity_rel_error`` against a clean baseline
+    # yet PASS a compatible one.
+    fid_dir = run_dir + "_fidelity"
+    shutil.rmtree(fid_dir, ignore_errors=True)
+    os.makedirs(fid_dir, exist_ok=True)
+    fid_steps = 40
+    fid_step_s = max(args.step_seconds, 0.03)  # alert must land mid-run
+    degrade_step = 8  # 4 clean samples first (health-every 2): EWMA baseline
+    fid_plan = os.path.join(fid_dir, "chaos_plan.json")
+    ChaosPlan([
+        FaultSpec(
+            kind="fidelity_degrade", step=degrade_step, rank=None,
+            payload={"group": "toy.grads.b1", "factor": 1000.0},
+        ),
+    ]).save(fid_plan)
+
+    def fid_argv_for_rank(rank, world_size, incarnation):
+        return [
+            sys.executable, worker,
+            "--rank", str(rank),
+            "--world", str(world_size),
+            "--steps", str(fid_steps),
+            "--state-dir", os.path.join(fid_dir, "state"),
+            "--result-dir", os.path.join(fid_dir, "results"),
+            "--step-seconds", str(fid_step_s),
+            "--health-every", "2",
+            "--fidelity-groups", "2",
+            "--controller-start", "1",
+            "--chaos-plan", fid_plan,
+        ]
+
+    fid_telemetry = telemetry_for_run(
+        event_log=os.path.join(fid_dir, SUPERVISOR_LOG), stdout=False
+    )
+    fid_result = Supervisor(
+        argv_for_rank=fid_argv_for_rank,
+        world_size=args.world,
+        config=SupervisorConfig(
+            max_restarts=1, backoff_base_s=0.05, poll_interval_s=0.05,
+            metrics_port=0,  # arms the aggregator (the fidelity detectors)
+        ),
+        telemetry=fid_telemetry,
+        run_dir=fid_dir,
+    ).run()
+    fid_telemetry.close()
+    problems = []
+    if not fid_result.success:
+        problems.append(f"fidelity game-day run failed: {fid_result}")
+
+    fid_json = os.path.join(art_dir, "fidelity_report.json")
+    if report.main(["--run-dir", fid_dir, "--json-out", fid_json]) != 0:
+        return 1
+    with open(fid_json) as f:
+        fid_doc = json.load(f)
+
+    # blame leg 1: the live alert — fidelity_collapse fired after the
+    # injection (not before: that would be a false positive), its message
+    # names the degraded bucket, and it paged before any loss-plateau
+    fid_alerts = (fid_doc.get("alerts") or {}).get("by_kind") or {}
+    if not fid_alerts.get("fidelity_collapse"):
+        problems.append(f"no fidelity_collapse alert (alerts: {fid_alerts})")
+    collapse_steps, plateau_steps, named = [], [], 0
+    try:
+        with open(os.path.join(fid_dir, SUPERVISOR_LOG)) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("event") != "alert":
+                    continue
+                step = rec.get("step")
+                if rec.get("alert") == "fidelity_collapse":
+                    if isinstance(step, int):
+                        collapse_steps.append(step)
+                    if "toy.grads.b1" in str(rec.get("message", "")):
+                        named += 1
+                elif rec.get("alert") == "loss_plateau":
+                    if isinstance(step, int):
+                        plateau_steps.append(step)
+    except OSError:
+        pass
+    if not collapse_steps:
+        problems.append("no fidelity_collapse record in the supervisor shard")
+    elif min(collapse_steps) < degrade_step:
+        problems.append(
+            f"fidelity_collapse fired at step {min(collapse_steps)},"
+            f" BEFORE the degrade at {degrade_step} (false positive)"
+        )
+    if not named:
+        problems.append(
+            "no fidelity_collapse alert message names the degraded bucket"
+            " toy.grads.b1"
+        )
+    if collapse_steps and plateau_steps and (
+        min(collapse_steps) >= min(plateau_steps)
+    ):
+        problems.append(
+            f"fidelity alert (step {min(collapse_steps)}) did not precede"
+            f" the loss-plateau alert (step {min(plateau_steps)})"
+        )
+
+    # blame leg 2: the report's fidelity table — the degraded bucket is
+    # worst_group (the gate scalar's source) and the clean bucket stayed
+    # inside its envelope, so the blame is specific, not run-wide
+    fid = fid_doc.get("fidelity") or {}
+    if fid.get("worst_group") != "toy.grads.b1":
+        problems.append(
+            f"report fidelity worst_group is {fid.get('worst_group')!r},"
+            " expected 'toy.grads.b1'"
+        )
+    fid_rel = fid.get("rel_error")
+    if not (isinstance(fid_rel, (int, float)) and fid_rel > 1.0):
+        problems.append(
+            f"degraded fidelity_rel_error not macroscopic: {fid_rel!r}"
+        )
+    clean = (fid.get("groups") or {}).get("toy.grads.b0") or {}
+    clean_mean = clean.get("mean_rel_error")
+    if not (isinstance(clean_mean, (int, float)) and clean_mean < 0.05):
+        problems.append(
+            f"clean bucket toy.grads.b0 left its envelope too"
+            f" (mean_rel_error {clean_mean!r}) — blame is not specific"
+        )
+
+    # the ledger join: every fidelity group's tag is byte-priced in the
+    # SAME report's wire ledger (orphan keys would break the frontier)
+    ledger_tags = {
+        row.get("tag")
+        for row in (fid_doc.get("bandwidth") or {}).get("by_tag") or []
+    }
+    if not fid.get("groups"):
+        problems.append("report fidelity section has no groups")
+    orphans = sorted(
+        g for g, info in (fid.get("groups") or {}).items()
+        if info.get("tag") not in ledger_tags
+    )
+    if orphans:
+        problems.append(
+            f"fidelity groups missing from the wire ledger: {orphans}"
+            f" (ledger tags: {sorted(t for t in ledger_tags if t)})"
+        )
+
+    # blame leg 3: the feedback leg — the controller climbed OUT of the
+    # compress rung on the fidelity alert (ordinary throughput recovery
+    # is disabled under --controller-start, so only this trigger ascends)
+    ascends = [
+        p for p in (fid_doc.get("policy") or {}).get("decisions", [])
+        if p.get("action") == "ascend"
+        and str(p.get("trigger", "")).startswith("alert:fidelity_collapse")
+    ]
+    if not ascends:
+        problems.append(
+            "no alert:fidelity_collapse ascend PolicyEvent — the fidelity"
+            " alert never bought the wire back"
+        )
+
+    # the live plane carries the per-group gauge, latched at the fault
+    fid_agg = LiveAggregator(fid_dir)
+    fid_agg.poll()
+    gauge_bad = fid_agg.registry.get_gauge(
+        "live_fidelity_rel_error", rank="0", group="toy.grads.b1"
+    )
+    if not (isinstance(gauge_bad, (int, float)) and gauge_bad > 1.0):
+        problems.append(
+            f"live_fidelity_rel_error gauge for the degraded bucket reads"
+            f" {gauge_bad!r}, expected the latched x1000 error"
+        )
+
+    # the accuracy-per-byte frontier: the ascend splits the trajectory
+    # into >= 2 rung segments, each joined to real ledger bytes
+    frontier_path = os.path.join(art_dir, "fidelity_frontier.json")
+    try:
+        with open(frontier_path) as f:
+            frontier = json.load(f)
+    except (OSError, ValueError) as exc:
+        frontier = None
+        problems.append(f"no readable fidelity frontier: {exc}")
+    if frontier is not None:
+        rungs = frontier.get("rungs") or []
+        if len(rungs) < 2:
+            problems.append(
+                f"frontier has {len(rungs)} rung segment(s), expected >= 2"
+                " (the fidelity ascend must split the trajectory)"
+            )
+        elif not all((r.get("bytes") or 0) > 0 for r in rungs):
+            problems.append(
+                f"frontier rung segment without ledger bytes: {rungs}"
+            )
+        elif [r.get("rung") for r in rungs][:2] != ["compress", "baseline"]:
+            problems.append(
+                f"frontier rung order {[r.get('rung') for r in rungs]}"
+                " does not show the compress -> baseline ascend"
+            )
+
+    if "fidelity_rel_error" not in gate.extract_metrics(fid_doc):
+        problems.append(
+            f"gate cannot extract fidelity_rel_error from {fid_json}"
+        )
+    if problems:
+        for prob in problems:
+            sys.stderr.write(f"# run_probe: FAIL: {prob}\n")
+        return 1
+
+    # the gate legs: the degraded report must FAIL against a clean
+    # fidelity baseline (lower is better) and PASS against its own value
+    fid_baseline = os.path.join(fid_dir, "gate_baseline.json")
+    with open(fid_baseline, "w") as f:
+        json.dump({"fidelity_rel_error": 0.02}, f)  # the toy clean error
+    if gate.main([
+        "--report", fid_json, "--baseline", fid_baseline, "--root", REPO,
+    ]) == 0:
+        sys.stderr.write(
+            "# run_probe: FAIL: gate passed a x1000 fidelity regression"
+            f" ({fid_json} vs clean baseline 0.02)\n"
+        )
+        return 1
+    with open(fid_baseline, "w") as f:
+        json.dump({"fidelity_rel_error": float(fid_rel)}, f)
+    if gate.main([
+        "--report", fid_json, "--baseline", fid_baseline, "--root", REPO,
+    ]) != 0:
+        sys.stderr.write(
+            "# run_probe: FAIL: gate rejected a report against its own"
+            " fidelity_rel_error\n"
+        )
+        return 1
+    sys.stderr.write(
+        "# run_probe: fidelity game day ok (fidelity_collapse at step"
+        f" {min(collapse_steps)} blamed 'toy.grads.b1' in {named} alert(s)"
+        f" with {len(plateau_steps)} loss-plateau page(s);"
+        f" worst_group mean {fid_rel:.3g} vs clean {clean_mean:.3g};"
+        f" {len(ascends)} fidelity ascend(s);"
+        f" frontier {len(frontier['rungs'])} rung(s),"
+        f" {frontier.get('total_bytes', 0) / 1e6:.1f} MB priced)"
+        f" report -> {fid_json}\n"
     )
     return 0
 

@@ -71,6 +71,9 @@ from network_distributed_pytorch_tpu.observe import (  # noqa: E402
     span,
     telemetry_for_run,
 )
+from network_distributed_pytorch_tpu.observe.fidelity import (  # noqa: E402
+    FidelityTracker,
+)
 from network_distributed_pytorch_tpu.observe.memory import (  # noqa: E402
     OOM_REPORT_NAME,
     build_oom_report,
@@ -149,6 +152,14 @@ TOY_INNER_FABRIC = "ICI(v5e)"
 # live plane's EWMA spike detector has an almost-zero-variance envelope and
 # a chaos ``grad_spike`` (factor 1000 by default) is unambiguously critical
 TOY_GRAD_NORM = 1.0
+# --fidelity-groups: the toy fidelity plane's clean per-group baselines. A
+# flat rel_error well UNDER the FidelityCollapseDetector's absolute floor
+# (0.05), so the clean run never pages; a chaos ``fidelity_degrade``
+# (factor 1000) lifts one group to 20 — unambiguously over both the floor
+# and 3x the learned envelope. The EF norm is a flat nonzero baseline so
+# the EfBlowupDetector has a real (non-dead-zero) envelope to learn.
+TOY_FIDELITY_REL_ERROR = 0.02
+TOY_FIDELITY_EF_NORM = 0.1
 # the toy memory plane: a made-up HBM limit and a compile-time footprint
 # split (the CompileEvent fields observe.memory would attach on a real
 # backend), both scaled by --hbm-mult so a probe can "double the model" and
@@ -282,6 +293,24 @@ def main() -> int:
              " bytes_in_use ramps toward the toy HBM limit (the headroom"
              " detector's OOM-precursor feed)",
     )
+    p.add_argument(
+        "--fidelity-groups", type=int, default=0, metavar="K",
+        help="emit K toy fidelity groups (toy.grads.b0..b{K-1}) per"
+             " --health-every sample, with matching per-bucket"
+             " CollectiveEvents so every FidelityEvent tag is byte-priced"
+             " by the toy wire ledger (the ledger<->fidelity join). A"
+             " chaos fidelity_degrade fault multiplies the NAMED group's"
+             " rel_error by its factor payload from its step onward (a"
+             " standing degradation, like a genuinely broken bucket) —"
+             " the phase-13 game-day feed",
+    )
+    p.add_argument(
+        "--controller-start", type=int, default=0, metavar="I",
+        help="start the toy FallbackController at ladder index I instead"
+             " of 0 — the phase-13 game day starts at the compress rung so"
+             " a fidelity_collapse alert has a higher-fidelity rung to"
+             " ascend TO",
+    )
     args = p.parse_args()
 
     incarnation = incarnation_from_env()
@@ -352,6 +381,26 @@ def main() -> int:
                         payload_bytes=b,
                     )
                 )
+        elif args.fidelity_groups > 0:
+            # the bucketed toy wire: one CollectiveEvent per fidelity
+            # group, so every FidelityEvent tag below is byte-priced by
+            # the same ledger (the ledger<->fidelity join the phase-13
+            # game day and test_fidelity assert on). Bytes split evenly
+            # with the remainder on the last bucket, summing exactly to
+            # the active rung's payload.
+            n_g = args.fidelity_groups
+            base_b = rung_bytes_now // n_g
+            for k in range(n_g):
+                b = base_b if k < n_g - 1 else rung_bytes_now - base_b * (
+                    n_g - 1
+                )
+                telemetry.emit(
+                    CollectiveEvent(
+                        label="toy", tag=f"toy.grads.b{k}", layer="reducer",
+                        op="all-reduce", axis="data", dtype="float32",
+                        payload_bytes=b,
+                    )
+                )
         else:
             telemetry.emit(
                 CollectiveEvent(
@@ -364,7 +413,7 @@ def main() -> int:
         # collective, the cost fields observe.mfu joins at report time, and
         # the active rung's comm_config so the cost-model observatory can
         # identify WHICH config this run executed (join_realized)
-        n_hlo_coll = 3 if hier else 1
+        n_hlo_coll = 3 if hier else max(1, args.fidelity_groups)
         telemetry.emit(
             CompileEvent(
                 label="toy",
@@ -445,8 +494,18 @@ def main() -> int:
                 Rung("baseline", {}),
                 Rung("compress", {"reducer": "powersgd", "reducer_rank": 1}),
             ],
-            descend_after=1, recover_after=2, recover_factor=0.6,
+            descend_after=1, recover_factor=0.6,
+            # when the phase-13 game day pins the start rung, ordinary
+            # throughput recovery is disabled: the ONLY way back up the
+            # ladder is a fidelity-alert nudge, which is exactly the
+            # isolation the game day asserts on (otherwise a "recovered"
+            # ascend at the first epoch boundary would vacate the rung
+            # before the injected fault's alert could claim the credit)
+            recover_after=(10 ** 6 if args.controller_start > 0 else 2),
             telemetry=telemetry, rank=args.rank,
+            # the phase-13 game day starts on the compress rung so a
+            # fidelity alert has somewhere to ascend TO
+            start_index=max(0, min(args.controller_start, 1)),
         )
         epoch_times = []
         epoch_degraded = 0
@@ -454,6 +513,20 @@ def main() -> int:
 
     def _rung_bytes(index):
         return payload_bytes if index == 0 else payload_bytes // 8
+
+    # the toy fidelity plane: one group per --fidelity-groups bucket, each
+    # group key identical to the toy.grads.b{k} ledger tag emitted above
+    # (identity tag map — the toy wire is its own join). A fidelity_degrade
+    # chaos fault LATCHES a multiplier onto its named group: a genuinely
+    # broken bucket stays broken, so the supervisor's sustain-2 collapse
+    # detector sees consecutive degraded samples from a single injection.
+    fid_degrade = {}
+    fid_tracker = None
+    if args.fidelity_groups > 0 and telemetry is not None:
+        groups = [f"toy.grads.b{k}" for k in range(args.fidelity_groups)]
+        fid_tracker = FidelityTracker(
+            {g: g for g in groups}, rank=args.rank, label="toy"
+        )
 
     # simulated comm plane (--sim-fabric): the modeled allreduce wall time
     # of the active rung's payload, amortized over the rung's sync period.
@@ -681,7 +754,7 @@ def main() -> int:
             if telemetry is not None:
                 telemetry.emit(
                     StepEvent(
-                        step=i, epoch=0, loss=1.0 / (i + 1),
+                        step=i, epoch=i // EPOCH_LEN, loss=1.0 / (i + 1),
                         step_time_s=step_time,
                         bits_cumulative=8 * total_step_bytes * (i + 1),
                     )
@@ -693,11 +766,20 @@ def main() -> int:
             ):
                 # synthetic health sample: a flat grad-norm baseline the
                 # spike detector can learn in 3 observations; the chaos
-                # grad_spike fault multiplies the reading at its step
+                # grad_spike fault multiplies the reading at its step,
+                # while fidelity_degrade latches a rel_error multiplier
+                # onto its named group from this step onward
                 grad_norm = TOY_GRAD_NORM
                 spec = plan.pop(HEALTH_FAULTS, i, args.rank, incarnation)
                 if spec is not None:
-                    grad_norm *= float(spec.payload.get("factor", 1000.0))
+                    if spec.kind == "fidelity_degrade":
+                        fid_degrade[
+                            str(spec.payload.get("group", "toy.grads.b0"))
+                        ] = float(spec.payload.get("factor", 1000.0))
+                    else:
+                        grad_norm *= float(
+                            spec.payload.get("factor", 1000.0)
+                        )
                 telemetry.emit(
                     TrainHealthEvent(
                         step=i, epoch=i // EPOCH_LEN, grad_norm=grad_norm,
@@ -705,6 +787,25 @@ def main() -> int:
                         loss=1.0 / (i + 1), rank=args.rank, label="toy",
                     )
                 )
+                if fid_tracker is not None:
+                    # flat clean baseline well under the detector's 0.05
+                    # absolute floor; a degraded group jumps to 20 —
+                    # unambiguous blame at a single group key
+                    stats = {}
+                    for g in groups:
+                        rel = TOY_FIDELITY_REL_ERROR * fid_degrade.get(
+                            g, 1.0
+                        )
+                        stats[g] = {
+                            "rel_error": rel,
+                            "cosine_sim": max(0.0, 1.0 - rel),
+                            "ef_norm": TOY_FIDELITY_EF_NORM,
+                            "quantized_share": 0.0,
+                        }
+                    for ev in fid_tracker.events(
+                        i, stats, epoch=i // EPOCH_LEN
+                    ):
+                        telemetry.emit(ev)
                 # the synthetic memory ramp: occupancy climbs 50% -> 97%
                 # of the toy limit, one rung per health sample, so the
                 # supervisor's HbmHeadroomDetector EWMA crosses warn
